@@ -1,0 +1,157 @@
+"""ray_tpu — a TPU-native distributed task/actor framework.
+
+Ray-capability surface (reference: python/ray/__init__.py) rebuilt
+TPU-first: tasks + actors + ObjectRef dataflow on a batched device-tensor
+scheduler; collectives via XLA/ICI sharding instead of NCCL.
+
+    import ray_tpu as ray
+
+    ray.init()
+
+    @ray.remote
+    def f(x):
+        return x * 2
+
+    ray.get(f.remote(21))  # 42
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private import worker as _worker
+from ray_tpu._private.config import GLOBAL_CONFIG as _config  # noqa: F401
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,  # noqa: F401
+                                  PlacementGroupID, TaskID, WorkerID)
+from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.actor import (ActorClass, ActorHandle, get_actor,  # noqa: F401
+                           kill)
+from ray_tpu.remote_function import RemoteFunction, remote  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "cancel", "kill", "get_actor", "ObjectRef", "ActorHandle", "method",
+    "available_resources", "cluster_resources", "nodes",
+    "get_runtime_context", "__version__",
+]
+
+
+def init(*args, **kwargs):
+    """Start the runtime. Idempotent with ignore_reinit_error=True.
+
+    Reference: ray.init (python/ray/_private/worker.py).
+    """
+    return _worker.init(*args, **kwargs)
+
+
+def shutdown():
+    _worker.shutdown()
+
+
+def is_initialized() -> bool:
+    return _worker.is_initialized()
+
+
+def put(value: Any) -> ObjectRef:
+    return _worker.get_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    worker = _worker.get_worker()
+    if isinstance(refs, ObjectRef):
+        return worker.get([refs], timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError("ray_tpu.get() takes an ObjectRef or a list of them, "
+                        f"got {type(refs).__name__}")
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError("ray_tpu.get() list elements must be ObjectRefs, "
+                            f"got {type(r).__name__}")
+    return worker.get(list(refs), timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None,
+         fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    if isinstance(refs, ObjectRef):
+        raise TypeError("ray_tpu.wait() takes a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError(f"num_returns={num_returns} exceeds {len(refs)} refs")
+    if num_returns <= 0:
+        raise ValueError("num_returns must be >= 1")
+    return _worker.get_worker().wait(list(refs), num_returns, timeout)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    _worker.get_worker().cancel_task(ref, force=force)
+
+
+def method(num_returns: int = 1):
+    """Decorator to set per-method defaults on actor methods."""
+    def deco(f):
+        f.__ray_tpu_num_returns__ = num_returns
+        return f
+    return deco
+
+
+def available_resources() -> dict:
+    stats = _worker.get_worker().scheduler.stats()
+    out: dict = {}
+    from ray_tpu._private.task_spec import RESOURCE_NAMES
+    for node in stats.get("nodes", []):
+        for name, avail in zip(RESOURCE_NAMES, node["available"]):
+            out[name] = out.get(name, 0.0) + avail
+    return out
+
+
+def cluster_resources() -> dict:
+    stats = _worker.get_worker().scheduler.stats()
+    out: dict = {}
+    from ray_tpu._private.task_spec import RESOURCE_NAMES
+    for node in stats.get("nodes", []):
+        for name, cap in zip(RESOURCE_NAMES, node["capacity"]):
+            out[name] = out.get(name, 0.0) + cap
+    return out
+
+
+def nodes() -> List[dict]:
+    stats = _worker.get_worker().scheduler.stats()
+    return [
+        {"NodeID": i, "Alive": any(c > 0 for c in n["capacity"]),
+         "Resources": dict(zip(("CPU", "TPU", "memory", "custom"),
+                               n["capacity"]))}
+        for i, n in enumerate(stats.get("nodes", []))
+    ]
+
+
+class RuntimeContext:
+    """Reference: ray.runtime_context.RuntimeContext."""
+
+    @property
+    def job_id(self) -> JobID:
+        return _worker.get_worker().job_id
+
+    @property
+    def task_id(self) -> TaskID:
+        return _worker.get_worker().current_task_id
+
+    @property
+    def worker_id(self) -> WorkerID:
+        return _worker.get_worker().worker_id
+
+    def get_job_id(self) -> str:
+        return self.job_id.hex()
+
+    def get_task_id(self) -> str:
+        return self.task_id.hex()
+
+    def was_current_actor_restarted(self) -> bool:
+        return False
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

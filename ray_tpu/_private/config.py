@@ -1,0 +1,146 @@
+"""Typed config registry with environment-variable overrides.
+
+TPU-native equivalent of the reference's RAY_CONFIG macro table
+(ray: src/ray/common/ray_config_def.h + ray_config.h): every knob is a
+typed entry, overridable via ``RAY_TPU_<name>`` env vars or an init-time
+``_system_config`` dict, and a frozen snapshot can be exported for
+device-visible kernel parameters (tick sizes, bin-pack weights).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: lambda s: int(s, 0),
+    float: float,
+    str: str,
+}
+
+
+@dataclasses.dataclass
+class _Entry:
+    name: str
+    type: type
+    default: Any
+    doc: str
+    value: Any = None
+
+    def __post_init__(self):
+        self.value = self.default
+
+
+class ConfigRegistry:
+    """All runtime knobs. Resolution order: explicit set > env var > default."""
+
+    def __init__(self):
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+        self._frozen = False
+
+    def define(self, name: str, type_: type, default: Any, doc: str = "") -> None:
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"config {name!r} already defined")
+            entry = _Entry(name, type_, default, doc)
+            env = os.environ.get(_ENV_PREFIX + name.upper())
+            if env is not None:
+                entry.value = _PARSERS[type_](env)
+            self._entries[name] = entry
+
+    def get(self, name: str) -> Any:
+        return self._entries[name].value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            if self._frozen:
+                raise RuntimeError(
+                    "config is frozen after ray_tpu.init(); pass _system_config "
+                    "to init() instead"
+                )
+            entry = self._entries[name]
+            if not isinstance(value, entry.type) and entry.type is not str:
+                value = entry.type(value)
+            entry.value = value
+
+    def apply_system_config(self, system_config: Dict[str, Any] | str) -> None:
+        if isinstance(system_config, str):
+            system_config = json.loads(system_config)
+        for k, v in system_config.items():
+            self.set(k, v)
+
+    def freeze(self) -> None:
+        self._frozen = True
+
+    def unfreeze(self) -> None:
+        self._frozen = False
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {k: e.value for k, e in self._entries.items()}
+
+    def __getattr__(self, name: str) -> Any:
+        entries = object.__getattribute__(self, "_entries")
+        if name in entries:
+            return entries[name].value
+        raise AttributeError(name)
+
+
+GLOBAL_CONFIG = ConfigRegistry()
+_d = GLOBAL_CONFIG.define
+
+# -- core ------------------------------------------------------------------
+_d("num_workers", int, 0, "worker threads/processes; 0 = os.cpu_count()")
+_d("worker_mode", str, "thread", "worker execution backend: thread | process")
+_d("inline_object_max_bytes", int, 100 * 1024,
+   "objects at or under this size are stored in the owner's in-process "
+   "memory store (reference inlines <100KB into task specs)")
+_d("object_store_memory", int, 256 * 1024 * 1024,
+   "shared-memory object store arena bytes per node")
+_d("object_spill_dir", str, "", "directory for spilled objects; empty = session dir")
+_d("object_spill_threshold", float, 0.8,
+   "fraction of object store usage that triggers spilling of primary copies")
+_d("max_direct_call_object_size", int, 100 * 1024, "alias of inline max")
+
+# -- scheduler (device-resident kernel parameters) -------------------------
+_d("sched_tick_interval_s", float, 0.0005, "min seconds between scheduler ticks")
+_d("sched_arena_capacity", int, 1 << 20,
+   "task arena slots resident on device (ring buffer, compacted)")
+_d("sched_max_edges", int, 1 << 22, "dependency CSR edge capacity")
+_d("sched_num_resources", int, 4,
+   "width R of the resource vectors (cpu, tpu, mem, custom)")
+_d("sched_max_nodes", int, 64, "node capacity matrix rows held on device")
+_d("sched_hybrid_threshold", float, 0.5,
+   "prefer-local until node load exceeds this fraction (hybrid policy analog)")
+_d("sched_backend", str, "auto",
+   "scheduler tick backend: auto | jax | numpy (numpy for tiny graphs)")
+_d("sched_jax_min_batch", int, 512,
+   "below this many pending tasks the numpy tick is used (auto mode)")
+
+# -- fault tolerance -------------------------------------------------------
+_d("task_max_retries", int, 3, "default retries for tasks on worker failure")
+_d("actor_max_restarts", int, 0, "default actor restarts")
+_d("max_lineage_bytes", int, 64 * 1024 * 1024, "owner lineage cap")
+_d("health_check_period_s", float, 1.0, "control-plane health check period")
+_d("health_check_timeout_s", float, 5.0, "mark node dead after this")
+
+# -- logging / observability ----------------------------------------------
+_d("log_dir", str, "", "session log dir; empty = /tmp/ray_tpu/session_*/logs")
+_d("metrics_export_port", int, 0, "prometheus text endpoint port; 0 = disabled")
+_d("event_buffer_size", int, 65536, "profile/trace event ring size per worker")
+
+# -- testing / fault injection --------------------------------------------
+_d("testing_inject_task_failure_prob", float, 0.0,
+   "probability a task raises a simulated worker failure (chaos testing)")
+_d("testing_tick_delay_s", float, 0.0, "artificial scheduler tick delay")

@@ -1,0 +1,119 @@
+"""In-process memory store + pluggable shared-memory backend.
+
+Reference surfaces:
+  - CoreWorkerMemoryStore (ray: src/ray/core_worker/store_provider/memory_store/)
+    — small objects live in the owner process, get() without IPC.
+  - Plasma store (ray: src/ray/object_manager/plasma/) — large objects in a
+    per-node shared-memory arena with create→seal lifecycle and eviction.
+
+Here the MemoryStore is the always-present in-process tier; a node-level
+SharedMemoryStore (ray_tpu/_private/runtime/shm_store.py) holds large
+objects for multi-process mode. Errors are stored as first-class values so
+ray.get re-raises them (reference: RayError in the object store).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class _Entry:
+    __slots__ = ("value", "is_exception", "size", "insert_time")
+
+    def __init__(self, value: Any, is_exception: bool, size: int):
+        self.value = value
+        self.is_exception = is_exception
+        self.size = size
+        self.insert_time = time.monotonic()
+
+
+class MemoryStore:
+    """Thread-safe in-process object store with readiness callbacks."""
+
+    def __init__(self):
+        self._objects: Dict[ObjectID, _Entry] = {}
+        self._lock = threading.Condition()
+        self._callbacks: Dict[ObjectID, List[Callable[[], None]]] = {}
+
+    # -- write -------------------------------------------------------------
+    def put(self, object_id: ObjectID, value: Any, *, is_exception: bool = False,
+            size: int = 0) -> None:
+        with self._lock:
+            self._objects[object_id] = _Entry(value, is_exception, size)
+            callbacks = self._callbacks.pop(object_id, [])
+            self._lock.notify_all()
+        for cb in callbacks:
+            cb()
+
+    # -- read --------------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_entry(self, object_id: ObjectID) -> Optional[_Entry]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def wait_and_get(self, object_ids: List[ObjectID],
+                     timeout: Optional[float]) -> List[_Entry]:
+        """Block until all ids present (or timeout); returns entries in order."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                missing = [o for o in object_ids if o not in self._objects]
+                if not missing:
+                    return [self._objects[o] for o in object_ids]
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"{len(missing)} objects not ready within timeout: "
+                        f"{[m.hex()[:16] for m in missing[:3]]}"
+                    )
+                self._lock.wait(timeout=remaining)
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int,
+             timeout: Optional[float]) -> Set[ObjectID]:
+        """Return the set of ready ids once num_returns are ready or timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = {o for o in object_ids if o in self._objects}
+                if len(ready) >= num_returns:
+                    return ready
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return ready
+                self._lock.wait(timeout=remaining)
+
+    def add_ready_callback(self, object_id: ObjectID, cb: Callable[[], None]):
+        fire = False
+        with self._lock:
+            if object_id in self._objects:
+                fire = True
+            else:
+                self._callbacks.setdefault(object_id, []).append(cb)
+        if fire:
+            cb()
+
+    # -- lifecycle ---------------------------------------------------------
+    def delete(self, object_ids: List[ObjectID]) -> None:
+        with self._lock:
+            for o in object_ids:
+                self._objects.pop(o, None)
+                self._callbacks.pop(o, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(e.size for e in self._objects.values())

@@ -1,0 +1,16 @@
+"""Scheduling subsystem.
+
+The policy boundary mirrors the reference's ClusterTaskManager /
+ILocalTaskManager split (ray: src/ray/raylet/scheduling/): submission
+enters through ``SchedulerBase.submit``; readiness tracking + node
+assignment happen behind the boundary; dispatch callbacks execute tasks.
+
+Two interchangeable implementations:
+  - ``local.EventScheduler``   — per-event dict-based (reference-style
+    O(1)-per-task decisions); the semantics oracle.
+  - ``tensor.TensorScheduler`` — the north star: pending DAG held as
+    device tensors, one fused tick computes ready set + assignments.
+"""
+
+from ray_tpu._private.scheduler.base import PendingTask, SchedulerBase  # noqa: F401
+from ray_tpu._private.scheduler.local import EventScheduler  # noqa: F401

@@ -1,0 +1,46 @@
+"""Scheduler interface shared by the event-driven oracle and the
+tensorized device-resident implementation."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.task_spec import TaskSpec
+
+
+@dataclasses.dataclass
+class PendingTask:
+    spec: TaskSpec
+    deps: List[ObjectID]           # unresolved top-level ObjectRef args
+    execute: Callable[["PendingTask", int], None]  # (task, node_index) -> None
+    # filled by the scheduler:
+    node_index: int = -1
+    cancelled: bool = False
+
+
+class SchedulerBase:
+    """Submission boundary. Implementations must be thread-safe."""
+
+    def submit(self, task: PendingTask) -> None:
+        raise NotImplementedError
+
+    def notify_object_ready(self, object_id: ObjectID) -> None:
+        """An object a pending task depends on became available."""
+        raise NotImplementedError
+
+    def notify_task_finished(self, task_id: TaskID, node_index: int,
+                             resources: Dict[str, float]) -> None:
+        """Resources released on the node that ran the task."""
+        raise NotImplementedError
+
+    def cancel(self, task_id: TaskID) -> bool:
+        """Remove a queued task. Returns True if it had not started."""
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        raise NotImplementedError

@@ -1,0 +1,215 @@
+"""Event-driven scheduler — the semantics oracle.
+
+Per-event O(1) decisions in the style of the reference's
+ClusterTaskManager::QueueAndScheduleTask + LocalTaskManager dispatch
+(ray: src/ray/raylet/scheduling/cluster_task_manager.cc,
+local_task_manager.cc): tasks wait for dependencies, then for resources,
+then dispatch. Node selection uses the hybrid policy analog: prefer the
+least-loaded feasible node, preferring node 0 (local) until its load
+crosses the configured threshold.
+
+The tensorized scheduler (scheduler/tensor.py) must make decisions
+consistent with this one; property tests drive both with the same task
+graphs.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.scheduler.base import PendingTask, SchedulerBase
+from ray_tpu._private.task_spec import resources_to_vector
+
+
+class NodeState:
+    __slots__ = ("capacity", "available", "node_id")
+
+    def __init__(self, capacity: Tuple[float, ...], node_id=None):
+        self.capacity = list(capacity)
+        self.available = list(capacity)
+        self.node_id = node_id
+
+    def fits(self, demand: Tuple[float, ...]) -> bool:
+        return all(a >= d for a, d in zip(self.available, demand))
+
+    def feasible(self, demand: Tuple[float, ...]) -> bool:
+        return all(c >= d for c, d in zip(self.capacity, demand))
+
+    def allocate(self, demand: Tuple[float, ...]) -> None:
+        for i, d in enumerate(demand):
+            self.available[i] -= d
+
+    def release(self, demand: Tuple[float, ...]) -> None:
+        for i, d in enumerate(demand):
+            self.available[i] = min(self.available[i] + d, self.capacity[i])
+
+    def load(self) -> float:
+        """Fraction of the binding resource in use."""
+        worst = 0.0
+        for c, a in zip(self.capacity, self.available):
+            if c > 0:
+                worst = max(worst, (c - a) / c)
+        return worst
+
+
+class EventScheduler(SchedulerBase):
+    def __init__(self, nodes: List[NodeState],
+                 dispatcher: Callable[[PendingTask], None],
+                 store_contains: Optional[Callable[[ObjectID], bool]] = None):
+        """dispatcher runs the task (typically enqueues to an executor pool);
+        it must call notify_task_finished when done. store_contains is
+        checked under the scheduler lock so an object becoming ready
+        concurrently with submit() cannot be missed."""
+        self._nodes = nodes
+        self._dispatch = dispatcher
+        self._store_contains = store_contains or (lambda oid: False)
+        self._lock = threading.Lock()
+        # object_id -> tasks waiting on it
+        self._waiters: Dict[ObjectID, List[PendingTask]] = {}
+        self._dep_count: Dict[TaskID, int] = {}
+        self._tasks: Dict[TaskID, PendingTask] = {}
+        self._ready: Deque[PendingTask] = collections.deque()
+        self._infeasible: List[PendingTask] = []
+        self._num_submitted = 0
+        self._num_dispatched = 0
+        self._num_finished = 0
+
+    # -- SchedulerBase -----------------------------------------------------
+    def submit(self, task: PendingTask) -> None:
+        to_dispatch = []
+        with self._lock:
+            self._num_submitted += 1
+            self._tasks[task.spec.task_id] = task
+            remaining = 0
+            for dep in task.deps:
+                if self._store_contains(dep):
+                    continue
+                self._waiters.setdefault(dep, []).append(task)
+                remaining += 1
+            if remaining == 0:
+                self._ready.append(task)
+            else:
+                self._dep_count[task.spec.task_id] = remaining
+            to_dispatch = self._drain_ready_locked()
+        self._run_dispatch(to_dispatch)
+
+    def notify_object_ready(self, object_id: ObjectID) -> None:
+        to_dispatch = []
+        with self._lock:
+            for task in self._waiters.pop(object_id, []):
+                tid = task.spec.task_id
+                if tid not in self._dep_count:
+                    continue
+                self._dep_count[tid] -= 1
+                if self._dep_count[tid] == 0:
+                    del self._dep_count[tid]
+                    self._ready.append(task)
+            to_dispatch = self._drain_ready_locked()
+        self._run_dispatch(to_dispatch)
+
+    def notify_task_finished(self, task_id: TaskID, node_index: int,
+                             resources: Dict[str, float]) -> None:
+        to_dispatch = []
+        with self._lock:
+            self._num_finished += 1
+            self._tasks.pop(task_id, None)
+            if 0 <= node_index < len(self._nodes):
+                self._nodes[node_index].release(resources_to_vector(resources))
+            to_dispatch = self._drain_ready_locked()
+        self._run_dispatch(to_dispatch)
+
+    def cancel(self, task_id: TaskID) -> bool:
+        with self._lock:
+            task = self._tasks.get(task_id)
+            if task is None or task.node_index >= 0:
+                return False  # unknown or already dispatched
+            task.cancelled = True
+            self._tasks.pop(task_id, None)
+            self._dep_count.pop(task_id, None)
+            try:
+                self._ready.remove(task)
+            except ValueError:
+                pass
+            return True
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "submitted": self._num_submitted,
+                "dispatched": self._num_dispatched,
+                "finished": self._num_finished,
+                "waiting_deps": len(self._dep_count),
+                "ready_queue": len(self._ready),
+                "infeasible": len(self._infeasible),
+                "nodes": [
+                    {"available": list(n.available), "capacity": list(n.capacity)}
+                    for n in self._nodes
+                ],
+            }
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._ready.clear()
+            self._waiters.clear()
+            self._dep_count.clear()
+
+    # -- node management (used by the virtual cluster test util) -----------
+    def add_node(self, node: NodeState) -> int:
+        with self._lock:
+            self._nodes.append(node)
+            return len(self._nodes) - 1
+
+    def remove_node(self, node_index: int) -> None:
+        with self._lock:
+            self._nodes[node_index].capacity = [0.0] * len(
+                self._nodes[node_index].capacity)
+            self._nodes[node_index].available = [0.0] * len(
+                self._nodes[node_index].available)
+
+    # -- internals ---------------------------------------------------------
+    def _drain_ready_locked(self) -> List[PendingTask]:
+        """Pop ready tasks whose resources fit; assign nodes (hybrid policy)."""
+        out = []
+        threshold = GLOBAL_CONFIG.sched_hybrid_threshold
+        deferred: List[PendingTask] = []
+        while self._ready:
+            task = self._ready.popleft()
+            if task.cancelled:
+                continue
+            demand = task.spec.resource_vector()
+            idx = self._pick_node(demand, threshold)
+            if idx is None:
+                if not any(n.feasible(demand) for n in self._nodes):
+                    self._infeasible.append(task)
+                else:
+                    deferred.append(task)
+                continue
+            self._nodes[idx].allocate(demand)
+            task.node_index = idx
+            self._num_dispatched += 1
+            out.append(task)
+        self._ready.extend(deferred)
+        return out
+
+    def _pick_node(self, demand: Tuple[float, ...],
+                   threshold: float) -> Optional[int]:
+        # hybrid: local (node 0) until its load crosses threshold, then the
+        # least-loaded remote node that fits
+        if self._nodes and self._nodes[0].fits(demand) \
+                and self._nodes[0].load() < threshold:
+            return 0
+        best, best_load = None, float("inf")
+        for i, n in enumerate(self._nodes):
+            if n.fits(demand):
+                ld = n.load()
+                if ld < best_load:
+                    best, best_load = i, ld
+        return best
+
+    def _run_dispatch(self, tasks: List[PendingTask]) -> None:
+        for task in tasks:
+            self._dispatch(task)

@@ -1,0 +1,129 @@
+"""Object serialization with zero-copy out-of-band buffers.
+
+Role of the reference's python/ray/_private/serialization.py: pickle
+protocol 5 with out-of-band PickleBuffers so large numpy/jax arrays are
+serialized as (metadata, raw buffer list) and can be placed in shared
+memory or handed to the device without a copy. ObjectRefs found inside
+values are recorded so the owner can track borrowers (reference:
+ReferenceCounter borrower protocol).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+
+class SerializedObject:
+    """Pickled metadata + out-of-band buffers; total_bytes is the store cost."""
+
+    __slots__ = ("meta", "buffers", "contained_refs")
+
+    def __init__(self, meta: bytes, buffers: List[memoryview], contained_refs: list):
+        self.meta = meta
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        return len(self.meta) + sum(len(b) for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten to one contiguous blob: [meta_len][meta][nbuf][len,buf]*."""
+        out = io.BytesIO()
+        out.write(len(self.meta).to_bytes(8, "little"))
+        out.write(self.meta)
+        out.write(len(self.buffers).to_bytes(4, "little"))
+        for b in self.buffers:
+            out.write(len(b).to_bytes(8, "little"))
+            out.write(b)
+        return out.getvalue()
+
+    @classmethod
+    def from_bytes(cls, blob: memoryview | bytes) -> "SerializedObject":
+        view = memoryview(blob)
+        meta_len = int.from_bytes(view[:8], "little")
+        off = 8
+        meta = bytes(view[off : off + meta_len])
+        off += meta_len
+        nbuf = int.from_bytes(view[off : off + 4], "little")
+        off += 4
+        buffers = []
+        for _ in range(nbuf):
+            blen = int.from_bytes(view[off : off + 8], "little")
+            off += 8
+            buffers.append(view[off : off + blen])
+            off += blen
+        return cls(meta, buffers, [])
+
+
+_custom_serializers: Dict[type, Tuple[Callable, Callable]] = {}
+
+
+def register_serializer(cls: type, *, serializer: Callable, deserializer: Callable):
+    """ray.util.register_serializer equivalent."""
+    _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: type):
+    _custom_serializers.pop(cls, None)
+
+
+class _Pickler(pickle.Pickler):
+    def __init__(self, file, contained_refs: list):
+        super().__init__(file, protocol=5, buffer_callback=self._buffer_cb)
+        self._oob: List[memoryview] = []
+        self._contained_refs = contained_refs
+
+    def _buffer_cb(self, buf: pickle.PickleBuffer):
+        self._oob.append(buf.raw())
+        return False  # out-of-band
+
+    def reducer_override(self, obj):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        if type(obj) in _custom_serializers:
+            ser, deser = _custom_serializers[type(obj)]
+            return (_reconstruct_custom, (type(obj), ser(obj)))
+        if isinstance(obj, ObjectRef):
+            self._contained_refs.append(obj)
+        return NotImplemented
+
+
+def _reconstruct_custom(cls, payload):
+    return _custom_serializers[cls][1](payload)
+
+
+def serialize(value: Any) -> SerializedObject:
+    contained_refs: list = []
+    f = io.BytesIO()
+    p = _Pickler(f, contained_refs)
+    # jax arrays: move to host numpy once so the buffer is mmap-able
+    value = _device_to_host(value)
+    p.dump(value)
+    return SerializedObject(f.getvalue(), p._oob, contained_refs)
+
+
+def deserialize(obj: SerializedObject) -> Any:
+    buffers = [pickle.PickleBuffer(b) for b in obj.buffers]
+    return pickle.loads(obj.meta, buffers=buffers)
+
+
+def _device_to_host(value: Any) -> Any:
+    """Convert jax.Array leaves to numpy (zero-copy when already on host)."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover
+        return value
+    if isinstance(value, jax.Array):
+        return np.asarray(value)
+    if isinstance(value, tuple):
+        return tuple(_device_to_host(v) for v in value)
+    if isinstance(value, list):
+        return [_device_to_host(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _device_to_host(v) for k, v in value.items()}
+    return value

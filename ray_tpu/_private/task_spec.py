@@ -1,0 +1,80 @@
+"""Task specification — the unit handed to the scheduler.
+
+Reference surface: ray src/ray/common/task/task_spec.h (TaskSpecification)
++ proto common.proto TaskSpec. Includes the SchedulingClass notion: tasks
+with identical (function, resource demand) share a scheduling class so
+worker leases can be reused across them (the reference's #1 throughput
+mechanism; our batched scheduler groups by the same key).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, ObjectID, PlacementGroupID, TaskID
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+# Resource vector layout used by the tensorized scheduler. Keep in sync with
+# config sched_num_resources.
+RESOURCE_CPU = 0
+RESOURCE_TPU = 1
+RESOURCE_MEM = 2
+RESOURCE_CUSTOM = 3
+RESOURCE_NAMES = ("CPU", "TPU", "memory", "custom")
+
+
+def resources_to_vector(resources: Dict[str, float]) -> Tuple[float, ...]:
+    vec = [0.0, 0.0, 0.0, 0.0]
+    for k, v in resources.items():
+        if k == "CPU":
+            vec[RESOURCE_CPU] = v
+        elif k in ("TPU", "GPU"):  # GPU accepted as an alias for portability
+            vec[RESOURCE_TPU] = v
+        elif k == "memory":
+            vec[RESOURCE_MEM] = v
+        else:
+            vec[RESOURCE_CUSTOM] += v
+    return tuple(vec)
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: TaskID
+    name: str
+    func: Optional[Callable]  # resolved callable (single-process) or None
+    func_descriptor: str      # stable name for scheduling class / registry
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+    num_returns: int = 1
+    resources: Dict[str, float] = dataclasses.field(default_factory=lambda: {"CPU": 1})
+    max_retries: int = 0
+    retry_exceptions: Any = False  # False | True | list of exception types
+    task_type: TaskType = TaskType.NORMAL_TASK
+    actor_id: Optional[ActorID] = None
+    actor_seq: int = 0
+    scheduling_strategy: Any = None
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    runtime_env: Optional[dict] = None
+    serialized_func: Optional[bytes] = None  # for process workers
+    attempt_number: int = 0
+    generator: bool = False  # streaming generator task
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i)
+                for i in range(self.num_returns)]
+
+    def scheduling_class(self) -> Tuple:
+        """Tasks in the same class can reuse leases / batch together."""
+        return (self.func_descriptor, tuple(sorted(self.resources.items())))
+
+    def resource_vector(self) -> Tuple[float, ...]:
+        return resources_to_vector(self.resources)

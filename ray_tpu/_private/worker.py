@@ -1,0 +1,500 @@
+"""Core worker: task submission + execution engine + object access.
+
+Reference surfaces: ray src/ray/core_worker/core_worker.cc (CoreWorker:
+SubmitTask/Put/Get/Wait, ownership), task_manager.cc (TaskManager:
+pending tasks, retries, lineage), python/ray/_private/worker.py (the
+module-level API: init/shutdown/get/put/wait/cancel).
+
+Single-process architecture (phase P1): the driver and all workers share
+one process; workers are threads in an executor pool; the scheduler is
+pluggable (event-driven oracle or device-tensor scheduler). Multi-process
+node runtime (phase P3) swaps the executor pool for forked worker
+processes + the shm object store, keeping this module's semantics.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu import exceptions as rex
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID,
+                                  _Counter)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import MemoryStore
+from ray_tpu._private.ref_counting import ReferenceCounter
+from ray_tpu._private.scheduler.base import PendingTask, SchedulerBase
+from ray_tpu._private.scheduler.local import EventScheduler, NodeState
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+
+logger = logging.getLogger(__name__)
+
+global_worker: Optional["Worker"] = None
+_init_lock = threading.Lock()
+
+
+class _TaskContext(threading.local):
+    """Per-thread execution context (reference: WorkerContext)."""
+
+    def __init__(self):
+        self.task_id: Optional[TaskID] = None
+        self.put_counter = 0
+        self.actor_id: Optional[ActorID] = None
+        self.cancel_requested = False
+
+
+class TaskManager:
+    """Owner-side pending-task table: retries + lineage.
+
+    Reference: src/ray/core_worker/task_manager.cc — AddPendingTask,
+    retry-on-failure resubmission, lineage kept while returned objects
+    remain in scope (capped by max_lineage_bytes).
+    """
+
+    def __init__(self, worker: "Worker"):
+        self._worker = worker
+        self._lock = threading.RLock()
+        self._pending: Dict[TaskID, Tuple[TaskSpec, List[ObjectID]]] = {}
+        self._lineage: Dict[TaskID, TaskSpec] = {}
+        self._lineage_bytes = 0
+        self.num_retries = 0
+
+    def add_pending(self, spec: TaskSpec, deps: List[ObjectID]) -> None:
+        with self._lock:
+            self._pending[spec.task_id] = (spec, deps)
+
+    def complete(self, task_id: TaskID) -> None:
+        with self._lock:
+            entry = self._pending.pop(task_id, None)
+            if entry is not None:
+                spec, _ = entry
+                # retain lineage for reconstruction while returns in scope
+                self._lineage[task_id] = spec
+                self._lineage_bytes += 256  # coarse estimate per spec
+                if self._lineage_bytes > GLOBAL_CONFIG.max_lineage_bytes:
+                    self._evict_lineage_locked()
+
+    def should_retry(self, spec: TaskSpec, exc: BaseException) -> bool:
+        if spec.attempt_number >= spec.max_retries:
+            return False
+        if isinstance(exc, (rex.WorkerCrashedError, rex.OutOfMemoryError)):
+            return True  # system failures always retriable up to max_retries
+        retry_exc = spec.retry_exceptions
+        if retry_exc is True:
+            return True
+        if isinstance(retry_exc, (list, tuple)):
+            return isinstance(exc, tuple(retry_exc))
+        return False
+
+    def get_lineage(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._lock:
+            return self._lineage.get(task_id)
+
+    def evict_lineage(self, task_id: TaskID) -> None:
+        with self._lock:
+            if self._lineage.pop(task_id, None) is not None:
+                self._lineage_bytes -= 256
+
+    def _evict_lineage_locked(self):
+        while self._lineage_bytes > GLOBAL_CONFIG.max_lineage_bytes // 2 \
+                and self._lineage:
+            self._lineage.pop(next(iter(self._lineage)))
+            self._lineage_bytes -= 256
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+class Worker:
+    """The in-process runtime: one per driver/worker process."""
+
+    def __init__(self, *, num_cpus: Optional[float] = None,
+                 num_workers: Optional[int] = None,
+                 scheduler_factory: Optional[Callable] = None,
+                 job_id: Optional[JobID] = None):
+        self.job_id = job_id or JobID.from_random()
+        self.worker_id = WorkerID.from_random()
+        self.alive = True
+        self._context = _TaskContext()
+        self._driver_task_id = TaskID.of(self.job_id)
+        self._task_seq = _Counter()
+
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter(self._on_object_out_of_scope)
+        self.task_manager = TaskManager(self)
+
+        nworkers = num_workers or GLOBAL_CONFIG.num_workers or os.cpu_count() or 4
+        self.num_workers = nworkers
+        capacity_cpu = num_cpus if num_cpus is not None else float(nworkers)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=nworkers, thread_name_prefix="ray_tpu_worker")
+
+        # node 0 = "this node"; virtual cluster tests add more
+        node = NodeState((capacity_cpu, _detect_tpu_count(), 1e18, 1e18))
+        contains = self.memory_store.contains
+        if scheduler_factory is not None:
+            self.scheduler: SchedulerBase = scheduler_factory(
+                [node], self._dispatch, contains)
+        else:
+            self.scheduler = EventScheduler([node], self._dispatch, contains)
+
+        # actors: ActorID -> _ActorRuntime (see actor.py)
+        self.actors: Dict[ActorID, Any] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.dead_actors: set = set()
+        self._actors_lock = threading.Lock()
+
+        self._running_tasks: Dict[TaskID, threading.Event] = {}
+        self._running_lock = threading.Lock()
+
+        # deferred unref queue: ObjectRef.__del__ may fire during GC while
+        # runtime locks are held, so deletions drain on a dedicated thread
+        import collections
+        self._unref_queue: collections.deque = collections.deque()
+        self._unref_event = threading.Event()
+        self._unref_thread = threading.Thread(
+            target=self._unref_loop, daemon=True, name="ray_tpu_unref")
+        self._unref_thread.start()
+
+    # ------------------------------------------------------------------
+    # Context helpers
+    # ------------------------------------------------------------------
+    @property
+    def current_task_id(self) -> TaskID:
+        return self._context.task_id or self._driver_task_id
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.of(self.job_id, seq=self._task_seq.next())
+
+    def next_put_id(self) -> ObjectID:
+        self._context.put_counter += 1
+        return ObjectID.for_put(self.current_task_id, self._context.put_counter)
+
+    # ------------------------------------------------------------------
+    # Object plane: put / get / wait
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError(
+                "Calling put() on an ObjectRef is not allowed: the ref can be "
+                "passed around directly (reference semantics).")
+        object_id = self.next_put_id()
+        self.reference_counter.add_owned_object(object_id)
+        self.memory_store.put(object_id, value)
+        return ObjectRef(object_id, self.worker_id)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        ids = [r.object_id() for r in refs]
+        try:
+            entries = self.memory_store.wait_and_get(ids, timeout)
+        except TimeoutError as e:
+            raise rex.GetTimeoutError(str(e)) from None
+        out = []
+        for entry in entries:
+            if entry.is_exception:
+                exc = entry.value
+                if isinstance(exc, rex.TaskError):
+                    raise exc.as_instanceof_cause()
+                raise exc
+            out.append(entry.value)
+        return out
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        ids = [r.object_id() for r in refs]
+        ready_set = self.memory_store.wait(ids, num_returns, timeout)
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.object_id() in ready_set and len(ready) < num_returns
+             else not_ready).append(r)
+        return ready, not_ready
+
+    def run_callback_when_ready(self, object_id: ObjectID, cb: Callable[[], None]):
+        self.memory_store.add_ready_callback(object_id, cb)
+
+    # ------------------------------------------------------------------
+    # Task submission
+    # ------------------------------------------------------------------
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        return_ids = spec.return_ids()
+        for oid in return_ids:
+            self.reference_counter.add_owned_object(oid, lineage_task=spec.task_id)
+
+        deps = _top_level_deps(spec.args, spec.kwargs)
+        self.reference_counter.add_submitted_task_references(deps)
+        self.task_manager.add_pending(spec, deps)
+
+        # drop deps already available locally
+        unresolved = [d for d in deps if not self.memory_store.contains(d)]
+        pending = PendingTask(spec=spec, deps=unresolved,
+                              execute=lambda t, n: None)
+        self.scheduler.submit(pending)
+        return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False) -> None:
+        task_id = ref.task_id()
+        if self.scheduler.cancel(task_id):
+            err = rex.TaskCancelledError(task_id)
+            spec_returns = 1  # at minimum the ref being cancelled
+            self.memory_store.put(ref.object_id(), err, is_exception=True)
+            self.task_manager.complete(task_id)
+            return
+        with self._running_lock:
+            ev = self._running_tasks.get(task_id)
+        if ev is not None:
+            ev.set()  # cooperative flag checked via was_current_task_cancelled
+            if force:
+                _async_raise_in_task(task_id)
+
+    def was_current_task_cancelled(self) -> bool:
+        task_id = self._context.task_id
+        if task_id is None:
+            return False
+        with self._running_lock:
+            ev = self._running_tasks.get(task_id)
+        return ev.is_set() if ev else False
+
+    # ------------------------------------------------------------------
+    # Execution (dispatcher target)
+    # ------------------------------------------------------------------
+    def _dispatch(self, pending: PendingTask) -> None:
+        boot = getattr(pending.spec, "_actor_boot", None)
+        if boot is not None:
+            self._pool.submit(self._boot_actor, pending, boot)
+        else:
+            self._pool.submit(self._execute_task, pending)
+
+    def _boot_actor(self, pending: PendingTask, boot) -> None:
+        try:
+            boot(pending, pending.node_index)
+        except Exception:
+            logger.exception("actor bootstrap failed")
+
+    def _execute_task(self, pending: PendingTask) -> None:
+        spec = pending.spec
+        # retries keep the ORIGINAL return ids so existing refs resolve
+        return_ids = getattr(spec, "_retry_return_ids", None) or spec.return_ids()
+        cancel_ev = threading.Event()
+        with self._running_lock:
+            self._running_tasks[spec.task_id] = cancel_ev
+
+        prev_task = self._context.task_id
+        prev_put = self._context.put_counter
+        self._context.task_id = spec.task_id
+        self._context.put_counter = 0
+        try:
+            args, kwargs, dep_error = self._resolve_args(spec)
+            if dep_error is not None:
+                self._store_error(spec, return_ids, dep_error)
+                return
+            if cancel_ev.is_set():
+                self._store_error(spec, return_ids,
+                                  rex.TaskCancelledError(spec.task_id))
+                return
+            self._maybe_inject_failure()
+            try:
+                result = spec.func(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                self._handle_task_failure(spec, return_ids, e)
+                return
+            self._store_returns(spec, return_ids, result)
+        finally:
+            self._context.task_id = prev_task
+            self._context.put_counter = prev_put
+            with self._running_lock:
+                self._running_tasks.pop(spec.task_id, None)
+            deps = _top_level_deps(spec.args, spec.kwargs)
+            self.reference_counter.remove_submitted_task_references(deps)
+            self.scheduler.notify_task_finished(
+                spec.task_id, pending.node_index, spec.resources)
+
+    def _resolve_args(self, spec: TaskSpec):
+        """Replace top-level ObjectRefs by values (reference semantics: only
+        top-level args are awaited/inlined; nested refs pass through)."""
+        dep_error = None
+
+        def resolve(v):
+            nonlocal dep_error
+            if isinstance(v, ObjectRef):
+                entry = self.memory_store.get_entry(v.object_id())
+                if entry is None:
+                    # scheduler guaranteed readiness; treat as lost
+                    dep_error = rex.ObjectLostError(v.hex())
+                    return None
+                if entry.is_exception:
+                    dep_error = entry.value
+                    return None
+                return entry.value
+            return v
+
+        args = tuple(resolve(a) for a in spec.args)
+        kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+        return args, kwargs, dep_error
+
+    def _store_returns(self, spec: TaskSpec, return_ids: List[ObjectID], result):
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result) if result is not None else []
+            if len(values) != spec.num_returns:
+                err = ValueError(
+                    f"task {spec.name} declared num_returns={spec.num_returns} "
+                    f"but returned {len(values)} values")
+                self._store_error(spec, return_ids, err)
+                return
+        for oid, v in zip(return_ids, values):
+            self.memory_store.put(oid, v)
+            self.scheduler.notify_object_ready(oid)
+        self.task_manager.complete(spec.task_id)
+
+    def _handle_task_failure(self, spec: TaskSpec, return_ids, exc: BaseException):
+        if self.task_manager.should_retry(spec, exc):
+            spec.attempt_number += 1
+            spec.task_id = self.next_task_id()  # retries get a fresh attempt id
+            self.task_manager.num_retries += 1
+            logger.warning("retrying task %s (attempt %d/%d): %s", spec.name,
+                           spec.attempt_number, spec.max_retries, exc)
+            # resubmit under the ORIGINAL return ids
+            spec._retry_return_ids = return_ids  # type: ignore[attr-defined]
+            deps = _top_level_deps(spec.args, spec.kwargs)
+            unresolved = [d for d in deps if not self.memory_store.contains(d)]
+            self.scheduler.submit(PendingTask(spec=spec, deps=unresolved,
+                                              execute=lambda t, n: None))
+            return
+        if isinstance(exc, rex.TaskCancelledError):
+            self._store_error(spec, return_ids, exc)
+        else:
+            tb = "".join(traceback.format_exception(type(exc), exc,
+                                                    exc.__traceback__))
+            self._store_error(spec, return_ids,
+                              rex.TaskError(spec.name, exc, tb))
+
+    def _store_error(self, spec: TaskSpec, return_ids, exc: BaseException):
+        for oid in return_ids:
+            self.memory_store.put(oid, exc, is_exception=True)
+            self.scheduler.notify_object_ready(oid)
+        self.task_manager.complete(spec.task_id)
+
+    def _maybe_inject_failure(self):
+        prob = GLOBAL_CONFIG.testing_inject_task_failure_prob
+        if prob > 0.0:
+            import random
+            if random.random() < prob:
+                raise rex.WorkerCrashedError("injected failure (chaos test)")
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    def defer_unref(self, object_id: ObjectID) -> None:
+        self._unref_queue.append(object_id)
+        self._unref_event.set()
+
+    def _unref_loop(self) -> None:
+        while self.alive:
+            self._unref_event.wait(timeout=0.5)
+            self._unref_event.clear()
+            while self._unref_queue:
+                try:
+                    oid = self._unref_queue.popleft()
+                except IndexError:
+                    break
+                try:
+                    self.reference_counter.remove_local_reference(oid)
+                except Exception:
+                    logger.exception("unref failed for %s", oid)
+
+    def _on_object_out_of_scope(self, object_id: ObjectID) -> None:
+        self.memory_store.delete([object_id])
+        self.task_manager.evict_lineage(object_id.task_id())
+
+    def shutdown(self) -> None:
+        self.alive = False
+        with self._actors_lock:
+            actors = list(self.actors.values())
+        for rt in actors:
+            try:
+                rt.stop(no_restart=True)
+            except Exception:
+                pass
+        self.scheduler.shutdown()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _top_level_deps(args, kwargs) -> List[ObjectID]:
+    deps = [a.object_id() for a in args if isinstance(a, ObjectRef)]
+    deps.extend(v.object_id() for v in kwargs.values()
+                if isinstance(v, ObjectRef))
+    return deps
+
+
+def _detect_tpu_count() -> float:
+    try:
+        import jax
+        return float(len([d for d in jax.devices()
+                          if d.platform not in ("cpu",)]))
+    except Exception:
+        return 0.0
+
+
+def _async_raise_in_task(task_id: TaskID) -> None:
+    """Best-effort forced cancellation in thread mode."""
+    # thread-level force-kill is unsafe; cooperative cancellation only.
+    logger.warning("force cancel requested for %s; thread workers support "
+                   "cooperative cancellation only", task_id)
+
+
+# ----------------------------------------------------------------------
+# Module-level API used by ray_tpu/__init__.py
+# ----------------------------------------------------------------------
+
+def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
+         scheduler: Optional[str] = None, ignore_reinit_error: bool = False,
+         _system_config: Optional[dict] = None, **kwargs) -> "Worker":
+    global global_worker
+    with _init_lock:
+        if global_worker is not None and global_worker.alive:
+            if ignore_reinit_error:
+                return global_worker
+            raise RuntimeError("ray_tpu.init() called twice; pass "
+                               "ignore_reinit_error=True to allow")
+        if _system_config:
+            GLOBAL_CONFIG.unfreeze()
+            GLOBAL_CONFIG.apply_system_config(_system_config)
+        scheduler_factory = None
+        backend = scheduler or GLOBAL_CONFIG.sched_backend
+        if backend in ("jax", "tensor"):
+            from ray_tpu._private.scheduler.tensor import TensorScheduler
+            scheduler_factory = (
+                lambda nodes, dispatch, contains:
+                TensorScheduler(nodes, dispatch, contains))
+        GLOBAL_CONFIG.freeze()
+        global_worker = Worker(num_cpus=num_cpus, num_workers=num_workers,
+                               scheduler_factory=scheduler_factory)
+        return global_worker
+
+
+def shutdown() -> None:
+    global global_worker
+    with _init_lock:
+        if global_worker is not None:
+            global_worker.shutdown()
+            global_worker = None
+        GLOBAL_CONFIG.unfreeze()
+
+
+def is_initialized() -> bool:
+    return global_worker is not None and global_worker.alive
+
+
+def get_worker(auto_init: bool = True) -> Worker:
+    if global_worker is None or not global_worker.alive:
+        if not auto_init:
+            raise RuntimeError("ray_tpu.init() has not been called")
+        init()
+    return global_worker  # type: ignore

@@ -1,0 +1,540 @@
+"""Actors: stateful workers with ordered method execution.
+
+Reference surfaces: python/ray/actor.py (ActorClass/ActorHandle/
+ActorMethod), src/ray/core_worker/transport/actor_task_submitter (per-
+actor ordered queues, seq numbers), src/ray/gcs/gcs_server/
+gcs_actor_manager.cc (lifecycle FSM: PENDING_CREATION → ALIVE →
+[RESTARTING →] DEAD).
+
+Semantics kept:
+  - creation is scheduled like a task (resources honored); method calls
+    go DIRECTLY to the actor's ordered inbox, bypassing the scheduler —
+    the reference's actor-task fast path.
+  - per-caller FIFO ordering (single inbox thread); max_concurrency > 1
+    relaxes ordering like threaded actors; async def methods run on an
+    asyncio loop (async actors).
+  - method exceptions do NOT kill the actor; __init__ failure marks the
+    actor DEAD; ray_tpu.kill() → ActorDiedError for pending calls;
+    max_restarts recreates state via lineage (re-running __init__).
+  - default resource behavior: actors take 1 CPU for *creation* then hold
+    0 while alive, unless resources were explicitly requested, in which
+    case they are held for the actor's lifetime.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import functools
+import inspect
+import queue
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as rex
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.task_spec import TaskSpec, TaskType, resources_to_vector
+from ray_tpu.remote_function import _DEFAULT_OPTIONS, _build_resources
+
+_ACTOR_OPTIONS = dict(_DEFAULT_OPTIONS)
+_ACTOR_OPTIONS.update(dict(
+    max_restarts=0,
+    max_task_retries=0,
+    max_concurrency=1,
+    max_pending_calls=-1,
+    lifetime=None,  # None | "detached"
+    namespace="default",
+))
+
+
+class ActorState(enum.Enum):
+    DEPENDENCIES_UNREADY = 0
+    PENDING_CREATION = 1
+    ALIVE = 2
+    RESTARTING = 3
+    DEAD = 4
+
+
+class _Call:
+    __slots__ = ("method_name", "args", "kwargs", "return_ids", "num_returns",
+                 "task_id")
+
+    def __init__(self, method_name, args, kwargs, return_ids, num_returns,
+                 task_id):
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+        self.return_ids = return_ids
+        self.num_returns = num_returns
+        self.task_id = task_id
+
+
+class _ActorRuntime:
+    """Host-side actor executor: ordered inbox + worker thread(s)."""
+
+    def __init__(self, worker, actor_id: ActorID, cls, init_args, init_kwargs,
+                 opts: Dict[str, Any], creation_spec: TaskSpec,
+                 creation_node_index: int):
+        self.worker = worker
+        self.actor_id = actor_id
+        self.cls = cls
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.opts = opts
+        self.state = ActorState.PENDING_CREATION
+        self.instance = None
+        self.inbox: "queue.Queue[Optional[_Call]]" = queue.Queue()
+        self.init_done = threading.Event()
+        self.death_cause: Optional[BaseException] = None
+        self.num_restarts = 0
+        self.num_executed = 0
+        self.name: Optional[str] = opts.get("name")
+        self.namespace: str = opts.get("namespace") or "default"
+        self.detached = opts.get("lifetime") == "detached"
+        self._creation_spec = creation_spec
+        self._creation_node_index = creation_node_index
+        self._explicit_resources = bool(
+            opts.get("resources") or opts.get("num_tpus")
+            or (opts.get("num_cpus") not in (None, 1.0, 1)))
+        self._is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(cls, inspect.isfunction))
+        self._concurrency = max(1, int(opts.get("max_concurrency", 1)))
+        self._threads: List[threading.Thread] = []
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._stopped = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._is_async:
+            t = threading.Thread(target=self._async_main, daemon=True,
+                                 name=f"actor-{self.actor_id.hex()[:8]}")
+            t.start()
+            self._threads = [t]
+        else:
+            for i in range(self._concurrency):
+                t = threading.Thread(target=self._sync_main, args=(i,),
+                                     daemon=True,
+                                     name=f"actor-{self.actor_id.hex()[:8]}-{i}")
+                t.start()
+                self._threads.append(t)
+
+    def _run_init(self) -> bool:
+        try:
+            self.instance = self.cls(*self.init_args, **self.init_kwargs)
+            self.state = ActorState.ALIVE
+            self.worker.memory_store.put(
+                _creation_object_id(self.actor_id), "ALIVE")
+            return True
+        except BaseException as e:  # noqa: BLE001
+            tb = "".join(traceback.format_exception(type(e), e, e.__traceback__))
+            err = rex.TaskError(f"{self.cls.__name__}.__init__", e, tb)
+            self.death_cause = err
+            self.state = ActorState.DEAD
+            self.worker.memory_store.put(
+                _creation_object_id(self.actor_id), err, is_exception=True)
+            return False
+        finally:
+            self.init_done.set()
+            # default actors release their creation CPU once alive
+            if not self._explicit_resources:
+                self.worker.scheduler.notify_task_finished(
+                    self._creation_spec.task_id, self._creation_node_index,
+                    self._creation_spec.resources)
+
+    def _sync_main(self, thread_index: int):
+        if thread_index == 0:
+            ok = self._run_init()
+            if not ok:
+                self._drain_with_error()
+                return
+        else:
+            self.init_done.wait()
+            if self.state == ActorState.DEAD:
+                return
+        while not self._stopped.is_set():
+            call = self.inbox.get()
+            if call is None:
+                break
+            self._execute_call(call)
+
+    def _async_main(self):
+        ok = self._run_init()
+        if not ok:
+            self._drain_with_error()
+            return
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        # async actors default to high concurrency (reference: 1000)
+        limit = (self._concurrency if self.opts.get("max_concurrency", 1) > 1
+                 else 1000)
+        sem = asyncio.Semaphore(limit)
+
+        async def run_one(call):
+            async with sem:
+                await self._execute_call_async(call)
+
+        def pump():
+            # daemon thread: blocking inbox reads posted into the loop
+            while True:
+                call = self.inbox.get()
+                if call is None:
+                    loop.call_soon_threadsafe(loop.stop)
+                    return
+                loop.call_soon_threadsafe(
+                    lambda c=call: loop.create_task(run_one(c)))
+
+        pump_thread = threading.Thread(
+            target=pump, daemon=True,
+            name=f"actor-pump-{self.actor_id.hex()[:8]}")
+        pump_thread.start()
+        try:
+            loop.run_forever()
+        finally:
+            for p in asyncio.all_tasks(loop):
+                p.cancel()
+            loop.close()
+
+    # -- execution ---------------------------------------------------------
+    def _execute_call(self, call: _Call):
+        method = getattr(self.instance, call.method_name)
+        try:
+            args, kwargs, dep_err = self._resolve(call.args, call.kwargs)
+            if dep_err is not None:
+                raise dep_err
+            result = method(*args, **kwargs)
+            if inspect.isgenerator(result):
+                result = list(result)
+            self._store(call, result)
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(call, e)
+        finally:
+            self.num_executed += 1
+
+    async def _execute_call_async(self, call: _Call):
+        method = getattr(self.instance, call.method_name)
+        try:
+            args, kwargs, dep_err = self._resolve(call.args, call.kwargs)
+            if dep_err is not None:
+                raise dep_err
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            self._store(call, result)
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(call, e)
+        finally:
+            self.num_executed += 1
+
+    def _resolve(self, args, kwargs):
+        dep_err = None
+
+        def r(v):
+            nonlocal dep_err
+            if isinstance(v, ObjectRef):
+                entry = self.worker.memory_store.get_entry(v.object_id())
+                if entry is None:
+                    # actor calls resolve deps by blocking get (direct path)
+                    try:
+                        return self.worker.get([v], timeout=None)[0]
+                    except BaseException as e:  # noqa: BLE001
+                        dep_err = e
+                        return None
+                if entry.is_exception:
+                    dep_err = entry.value
+                    return None
+                return entry.value
+            return v
+
+        return (tuple(r(a) for a in args),
+                {k: r(v) for k, v in kwargs.items()}, dep_err)
+
+    def _store(self, call: _Call, result):
+        if call.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+        for oid, v in zip(call.return_ids, values):
+            self.worker.memory_store.put(oid, v)
+            self.worker.scheduler.notify_object_ready(oid)
+
+    def _store_error(self, call: _Call, exc: BaseException):
+        if not isinstance(exc, (rex.TaskError, rex.ActorError)):
+            tb = "".join(traceback.format_exception(type(exc), exc,
+                                                    exc.__traceback__))
+            exc = rex.TaskError(f"{self.cls.__name__}.{call.method_name}",
+                                exc, tb)
+        for oid in call.return_ids:
+            self.worker.memory_store.put(oid, exc, is_exception=True)
+            self.worker.scheduler.notify_object_ready(oid)
+
+    def _drain_with_error(self):
+        err = self.death_cause or rex.ActorDiedError(actor_id=self.actor_id)
+        while True:
+            try:
+                call = self.inbox.get_nowait()
+            except queue.Empty:
+                break
+            if call is not None:
+                self._store_error(call, err)
+
+    # -- submission (from handles) ----------------------------------------
+    def submit(self, call: _Call):
+        if self.state == ActorState.DEAD:
+            self._store_error(call, self.death_cause
+                              or rex.ActorDiedError(actor_id=self.actor_id))
+            return
+        limit = self.opts.get("max_pending_calls", -1)
+        if limit > 0 and self.inbox.qsize() >= limit:
+            raise rex.PendingCallsLimitExceeded(
+                f"actor has {self.inbox.qsize()} pending calls (limit {limit})")
+        self.inbox.put(call)
+
+    # -- death / restart ---------------------------------------------------
+    def stop(self, no_restart: bool = True,
+             cause: Optional[BaseException] = None):
+        max_restarts = int(self.opts.get("max_restarts", 0))
+        can_restart = (not no_restart
+                       and (max_restarts == -1
+                            or self.num_restarts < max_restarts))
+        if can_restart:
+            self.num_restarts += 1
+            self.state = ActorState.RESTARTING
+            # restart = re-run __init__ (lineage-style state reconstruction)
+            try:
+                self.instance = self.cls(*self.init_args, **self.init_kwargs)
+                self.state = ActorState.ALIVE
+                return
+            except BaseException as e:  # noqa: BLE001
+                self.death_cause = rex.TaskError(
+                    f"{self.cls.__name__}.__init__ (restart)", e)
+        self.state = ActorState.DEAD
+        self.death_cause = self.death_cause or cause or rex.ActorDiedError(
+            "actor killed via ray_tpu.kill()", actor_id=self.actor_id)
+        self._stopped.set()
+        for _ in self._threads:
+            self.inbox.put(None)
+        self._drain_with_error()
+        # lifetime-held resources released at death
+        if self._explicit_resources:
+            self.worker.scheduler.notify_task_finished(
+                self._creation_spec.task_id, self._creation_node_index,
+                self._creation_spec.resources)
+        with self.worker._actors_lock:
+            self.worker.actors.pop(self.actor_id, None)
+            self.worker.dead_actors.add(self.actor_id)
+            if self.name:
+                self.worker.named_actors.pop((self.namespace, self.name), None)
+
+
+def _creation_object_id(actor_id: ActorID) -> ObjectID:
+    return ObjectID.for_task_return(TaskID.for_actor_task(actor_id, 0), 0)
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, *, num_returns: Optional[int] = None, name=None,
+                **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name,
+                           num_returns or self._num_returns)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(self._method_name, args, kwargs,
+                                           self._num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} must be invoked with "
+            f".remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = ""):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def _runtime(self) -> _ActorRuntime:
+        import time as _time
+
+        worker = worker_mod.get_worker()
+        deadline = _time.monotonic() + 60.0
+        while True:
+            with worker._actors_lock:
+                rt = worker.actors.get(self._actor_id)
+                dead = self._actor_id in worker.dead_actors
+            if rt is not None:
+                return rt
+            if dead or _time.monotonic() > deadline:
+                raise rex.ActorDiedError(
+                    f"actor {self._actor_id.hex()} does not exist or is dead",
+                    actor_id=self._actor_id)
+            # creation may still be queued behind deps/resources
+            _time.sleep(0.001)
+
+    def _submit_method(self, method_name, args, kwargs, num_returns):
+        worker = worker_mod.get_worker()
+        rt = self._runtime()
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        task_id = TaskID.for_actor_task(self._actor_id,
+                                        (id(self) & 0xFFFF) * 65536 + seq)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        for oid in return_ids:
+            worker.reference_counter.add_owned_object(oid)
+        call = _Call(method_name, args, kwargs, return_ids, num_returns,
+                     task_id)
+        rt.submit(call)
+        refs = [ObjectRef(oid, worker.worker_id) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id.binary(), self._class_name))
+
+    def __repr__(self):
+        return (f"ActorHandle({self._class_name}, "
+                f"{self._actor_id.hex()[:16]})")
+
+
+def _rebuild_handle(actor_binary: bytes, class_name: str) -> ActorHandle:
+    return ActorHandle(ActorID(actor_binary), class_name)
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Dict[str, Any]):
+        self._cls = cls
+        self._options = dict(_ACTOR_OPTIONS)
+        if "num_gpus" in options:
+            options["num_tpus"] = options.pop("num_gpus")
+        # actor default: no CPU option given -> 1 CPU for creation only
+        self._options.update(options)
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote()")
+
+    def options(self, **overrides) -> "ActorClass":
+        if "num_gpus" in overrides:
+            overrides["num_tpus"] = overrides.pop("num_gpus")
+        for k in overrides:
+            if k not in _ACTOR_OPTIONS and k != "name":
+                raise ValueError(f"unknown actor option {k!r}")
+        merged = dict(self._options)
+        merged.update(overrides)
+        new = ActorClass.__new__(ActorClass)
+        new._cls = self._cls
+        new._options = merged
+        return new
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = worker_mod.get_worker()
+        opts = self._options
+        name = opts.get("name")
+        namespace = opts.get("namespace") or "default"
+        if name:
+            with worker._actors_lock:
+                if (namespace, name) in worker.named_actors:
+                    raise ValueError(
+                        f"actor name {name!r} already taken in namespace "
+                        f"{namespace!r}")
+
+        actor_id = ActorID.of(worker.job_id)
+        creation_task_id = TaskID.for_actor_task(actor_id, 0)
+        creation_oid = _creation_object_id(actor_id)
+        worker.reference_counter.add_owned_object(creation_oid)
+        worker.reference_counter.pin(creation_oid)
+
+        spec = TaskSpec(
+            task_id=creation_task_id,
+            name=f"{self._cls.__name__}.__init__",
+            func=None,
+            func_descriptor=f"{self._cls.__module__}.{self._cls.__name__}",
+            args=args,
+            kwargs=kwargs,
+            num_returns=1,
+            resources=_build_resources(opts),
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            actor_id=actor_id,
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            placement_group_id=None,
+        )
+        pg = opts.get("placement_group")
+        strategy = opts.get("scheduling_strategy")
+        if strategy is not None and hasattr(strategy, "placement_group"):
+            pg = strategy.placement_group
+        if pg is not None:
+            spec.placement_group_id = pg.id if hasattr(pg, "id") else pg
+
+        cls, copts = self._cls, dict(opts)
+
+        def create(pending, node_index, _worker=worker):
+            rt = _ActorRuntime(_worker, actor_id, cls, args, kwargs, copts,
+                               spec, node_index)
+            with _worker._actors_lock:
+                _worker.actors[actor_id] = rt
+                if name:
+                    _worker.named_actors[(rt.namespace, name)] = actor_id
+            rt.start()
+
+        from ray_tpu._private.scheduler.base import PendingTask
+        deps = [a.object_id() for a in args if isinstance(a, ObjectRef)]
+        deps += [v.object_id() for v in kwargs.values()
+                 if isinstance(v, ObjectRef)]
+        unresolved = [d for d in deps if not worker.memory_store.contains(d)]
+        pending = PendingTask(spec=spec, deps=unresolved, execute=create)
+        # route through the scheduler so creation respects resources
+        _submit_actor_creation(worker, pending, create)
+        handle = ActorHandle(actor_id, self._cls.__name__)
+        return handle
+
+
+def _submit_actor_creation(worker, pending, create):
+    """Actor creation dispatches via the scheduler (so it respects
+    resources/placement) but executes the _ActorRuntime bootstrap instead of
+    a plain function call; the worker dispatcher recognizes _actor_boot."""
+    pending.spec._actor_boot = create  # type: ignore[attr-defined]
+    worker.scheduler.submit(pending)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    worker = worker_mod.get_worker()
+    with worker._actors_lock:
+        actor_id = worker.named_actors.get((namespace, name))
+        if actor_id is None:
+            raise ValueError(f"no actor named {name!r} in namespace "
+                             f"{namespace!r}")
+        rt = worker.actors[actor_id]
+    return ActorHandle(actor_id, rt.cls.__name__)
+
+
+def kill(handle: ActorHandle, *, no_restart: bool = True) -> None:
+    worker = worker_mod.get_worker()
+    with worker._actors_lock:
+        rt = worker.actors.get(handle.actor_id)
+    if rt is None:
+        return
+    rt.init_done.wait(timeout=30)
+    rt.stop(no_restart=no_restart)

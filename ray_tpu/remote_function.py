@@ -1,0 +1,152 @@
+"""@remote function decorator and submission options.
+
+Reference surface: python/ray/remote_function.py (RemoteFunction,
+._remote(), .options()) — same semantics: free functions become task
+factories; `.remote(*args)` returns ObjectRef(s); `.options()` overrides
+resources/retries/strategy per call site.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+
+_DEFAULT_OPTIONS = dict(
+    num_cpus=1.0,
+    num_tpus=0.0,
+    memory=0.0,
+    resources=None,
+    num_returns=1,
+    max_retries=None,
+    retry_exceptions=False,
+    name=None,
+    scheduling_strategy=None,
+    placement_group=None,
+    placement_group_bundle_index=-1,
+    runtime_env=None,
+)
+
+
+def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
+    res = {"CPU": float(opts["num_cpus"])}
+    if opts["num_tpus"]:
+        res["TPU"] = float(opts["num_tpus"])
+    if opts["memory"]:
+        res["memory"] = float(opts["memory"])
+    if opts["resources"]:
+        res.update(opts["resources"])
+    return res
+
+
+class RemoteFunction:
+    def __init__(self, func: Callable, options: Optional[Dict[str, Any]] = None):
+        self._function = func
+        self._name = getattr(func, "__qualname__", getattr(func, "__name__", "fn"))
+        self._module = getattr(func, "__module__", "")
+        self._options = dict(_DEFAULT_OPTIONS)
+        if options:
+            self._options.update(options)
+        self._is_generator = inspect.isgeneratorfunction(func)
+        functools.update_wrapper(self, func)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._name} cannot be called directly; use "
+            f"{self._name}.remote() or access the original via "
+            f"{self._name}.func")
+
+    @property
+    def func(self) -> Callable:
+        """The undecorated function (upstream: .__wrapped__ / _function)."""
+        return self._function
+
+    def options(self, **overrides) -> "RemoteFunction":
+        for k in overrides:
+            if k not in _DEFAULT_OPTIONS and k != "num_gpus":
+                raise ValueError(f"unknown option {k!r}")
+        if "num_gpus" in overrides:  # portability alias
+            overrides["num_tpus"] = overrides.pop("num_gpus")
+        merged = dict(self._options)
+        merged.update(overrides)
+        return RemoteFunction(self._function, merged)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, opts):
+        worker = worker_mod.get_worker()
+        num_returns = opts["num_returns"]
+        generator = self._is_generator or num_returns in ("dynamic", "streaming")
+        if generator and isinstance(num_returns, str):
+            num_returns = 1
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        max_retries = opts["max_retries"]
+        if max_retries is None:
+            max_retries = GLOBAL_CONFIG.task_max_retries
+
+        pg = opts["placement_group"]
+        pg_id = None
+        bundle_index = opts["placement_group_bundle_index"]
+        strategy = opts["scheduling_strategy"]
+        if strategy is not None and hasattr(strategy, "placement_group"):
+            pg = strategy.placement_group
+            bundle_index = getattr(strategy, "placement_group_bundle_index", -1)
+        if pg is not None:
+            pg_id = pg.id if hasattr(pg, "id") else pg
+
+        func = self._function
+        if generator:
+            func = _collect_generator(func)
+
+        spec = TaskSpec(
+            task_id=worker.next_task_id(),
+            name=opts["name"] or self._name,
+            func=func,
+            func_descriptor=f"{self._module}.{self._name}",
+            args=args,
+            kwargs=kwargs,
+            num_returns=num_returns,
+            resources=_build_resources(opts),
+            max_retries=max_retries,
+            retry_exceptions=opts["retry_exceptions"],
+            task_type=TaskType.NORMAL_TASK,
+            scheduling_strategy=strategy,
+            placement_group_id=pg_id,
+            placement_group_bundle_index=bundle_index,
+            runtime_env=opts["runtime_env"],
+            generator=generator,
+        )
+        refs = worker.submit_task(spec)
+        return refs[0] if spec.num_returns == 1 else refs
+
+
+def _collect_generator(func):
+    @functools.wraps(func)
+    def wrapper(*a, **k):
+        return list(func(*a, **k))
+    return wrapper
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=2)`` for functions and classes."""
+    from ray_tpu.actor import ActorClass
+
+    def decorate(obj, options=None):
+        if inspect.isclass(obj):
+            return ActorClass(obj, options or {})
+        if not callable(obj):
+            raise TypeError("@remote requires a function or class")
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+    if "num_gpus" in kwargs:
+        kwargs["num_tpus"] = kwargs.pop("num_gpus")
+    return lambda obj: decorate(obj, kwargs)

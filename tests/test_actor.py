@@ -1,0 +1,171 @@
+"""Actor semantics tests (reference: python/ray/tests/test_actor*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as rex
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.get.remote()) == 6
+
+
+def test_actor_init_args(ray_start_regular):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.get.remote()) == 100
+
+
+def test_actor_method_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(50)]
+    assert ray_tpu.get(refs) == list(range(1, 51))
+
+
+def test_actor_state_isolated(ray_start_regular):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get(a.incr.remote())
+    assert ray_tpu.get(b.get.remote()) == 0
+
+
+def test_actor_method_exception_does_not_kill(ray_start_regular):
+    @ray_tpu.remote
+    class Fragile:
+        def boom(self):
+            raise KeyError("oops")
+
+        def ok(self):
+            return "fine"
+
+    f = Fragile.remote()
+    with pytest.raises(KeyError):
+        ray_tpu.get(f.boom.remote())
+    assert ray_tpu.get(f.ok.remote()) == "fine"
+
+
+def test_actor_init_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("cannot construct")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    ref = b.m.remote()
+    with pytest.raises((RuntimeError, rex.ActorError)):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote())
+    ray_tpu.kill(c)
+    with pytest.raises(rex.ActorError):
+        ray_tpu.get(c.incr.remote(), timeout=10)
+
+
+def test_named_actor(ray_start_regular):
+    c = Counter.options(name="counter1").remote(5)
+    ray_tpu.get(c.get.remote())  # ensure created
+    h = ray_tpu.get_actor("counter1")
+    assert ray_tpu.get(h.get.remote()) == 5
+    with pytest.raises(ValueError):
+        Counter.options(name="counter1").remote()
+    ray_tpu.kill(c)
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("counter1")
+
+
+def test_actor_handle_serialization(ray_start_regular):
+    import pickle
+
+    c = Counter.remote(7)
+    ray_tpu.get(c.get.remote())
+    h = pickle.loads(pickle.dumps(c))
+    assert ray_tpu.get(h.get.remote()) == 7
+
+
+def test_actor_handle_passed_to_task(ray_start_regular):
+    @ray_tpu.remote
+    def use_actor(handle):
+        return ray_tpu.get(handle.incr.remote(10))
+
+    c = Counter.remote()
+    assert ray_tpu.get(use_actor.remote(c)) == 10
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    w = AsyncWorker.remote()
+    refs = [w.work.remote(i) for i in range(10)]
+    assert ray_tpu.get(refs) == [i * 2 for i in range(10)]
+
+
+def test_threaded_actor_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def work(self):
+            time.sleep(0.2)
+            return 1
+
+    s = Slow.remote()
+    t0 = time.monotonic()
+    ray_tpu.get([s.work.remote() for _ in range(4)])
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.7, f"no concurrency: {elapsed:.2f}s"
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.incr.remote()) == 1
+    ray_tpu.kill(p, no_restart=False)
+    time.sleep(0.1)
+    # restarted: state reset via __init__ replay
+    assert ray_tpu.get(p.incr.remote(), timeout=10) == 1
+
+
+def test_actor_refs_as_args(ray_start_regular):
+    c = Counter.remote()
+    ref = ray_tpu.put(41)
+
+    @ray_tpu.remote
+    class Adder:
+        def add(self, a, b):
+            return a + b
+
+    a = Adder.remote()
+    assert ray_tpu.get(a.add.remote(ref, 1)) == 42

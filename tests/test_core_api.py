@@ -1,0 +1,315 @@
+"""Core API conformance tests — the semantics oracle for everything else.
+
+Modeled on the reference's python/ray/tests/test_basic*.py coverage:
+put/get/wait, task fan-out, ObjectRef dependencies, error propagation,
+num_returns, options, nested refs, retries, cancellation.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as rex
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    assert ray_tpu.get([ref, ref]) == [42, 42]
+
+
+def test_put_objectref_rejected(ray_start_regular):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_fanout(ray_start_regular):
+    @ray_tpu.remote
+    def f(i):
+        return i * i
+
+    refs = [f.remote(i) for i in range(100)]
+    assert ray_tpu.get(refs) == [i * i for i in range(100)]
+
+
+def test_objectref_dependency_chain(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    x = add.remote(1, 2)
+    y = add.remote(x, 3)
+    z = add.remote(y, x)
+    assert ray_tpu.get(z) == 9
+
+
+def test_map_reduce_dag(ray_start_regular):
+    @ray_tpu.remote
+    def mapper(i):
+        return i
+
+    @ray_tpu.remote
+    def reducer(*parts):
+        return sum(parts)
+
+    maps = [mapper.remote(i) for i in range(20)]
+    total = reducer.remote(*maps)
+    assert ray_tpu.get(total) == sum(range(20))
+
+
+def test_kwargs_and_ref_kwargs(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=0):
+        return a + b
+
+    ref = ray_tpu.put(5)
+    assert ray_tpu.get(f.remote(1, b=ref)) == 6
+
+
+def test_nested_refs_not_resolved(ray_start_regular):
+    """Only top-level args are awaited/inlined (reference semantics)."""
+    @ray_tpu.remote
+    def inspect(lst):
+        return [type(v).__name__ for v in lst]
+
+    ref = ray_tpu.put(1)
+    assert ray_tpu.get(inspect.remote([ref])) == ["ObjectRef"]
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_num_returns_mismatch_errors(ray_start_regular):
+    @ray_tpu.remote(num_returns=2)
+    def wrong():
+        return (1, 2, 3)
+
+    a, b = wrong.remote()
+    with pytest.raises(ValueError):
+        ray_tpu.get(a)
+
+
+def test_generator_task(ray_start_regular):
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    assert ray_tpu.get(gen.remote(4)) == [0, 1, 2, 3]
+
+
+def test_exception_propagation(ray_start_regular):
+    class CustomError(Exception):
+        pass
+
+    @ray_tpu.remote
+    def boom():
+        raise CustomError("bad")
+
+    ref = boom.remote()
+    with pytest.raises(CustomError):
+        ray_tpu.get(ref)
+    # also an instance of TaskError for framework-level handling
+    with pytest.raises(rex.TaskError):
+        ray_tpu.get(ref)
+
+
+def test_exception_cascades_to_dependents(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    ref = consume.remote(boom.remote())
+    with pytest.raises(ValueError):
+        ray_tpu.get(ref)
+
+
+def test_wait_basics(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.5)
+        return "slow"
+
+    refs = [slow.remote(), fast.remote()]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=2)
+    assert len(ready) == 1 and len(not_ready) == 1
+    assert ray_tpu.get(ready[0]) == "fast"
+    ready2, nr2 = ray_tpu.wait(refs, num_returns=2, timeout=5)
+    assert len(ready2) == 2 and not nr2
+
+
+def test_wait_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def never():
+        time.sleep(60)
+
+    ready, not_ready = ray_tpu.wait([never.remote()], num_returns=1,
+                                    timeout=0.1)
+    assert not ready and len(not_ready) == 1
+
+
+def test_wait_validation(ray_start_regular):
+    with pytest.raises(ValueError):
+        ray_tpu.wait([ray_tpu.put(1)], num_returns=2)
+    with pytest.raises(TypeError):
+        ray_tpu.wait(ray_tpu.put(1))
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def never():
+        time.sleep(60)
+
+    with pytest.raises(rex.GetTimeoutError):
+        ray_tpu.get(never.remote(), timeout=0.1)
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(num_cpus=2).remote()) == 1
+    with pytest.raises(ValueError):
+        f.options(bogus=1)
+
+
+def test_retries(ray_start_regular):
+    attempts = []
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    assert len(attempts) == 3
+
+
+def test_no_retry_by_default_on_app_error(ray_start_regular):
+    attempts = []
+
+    @ray_tpu.remote
+    def boom():
+        attempts.append(1)
+        raise RuntimeError("app error")
+
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(boom.remote())
+    assert len(attempts) == 1
+
+
+def test_retry_specific_exceptions(ray_start_regular):
+    attempts = []
+
+    @ray_tpu.remote(max_retries=5, retry_exceptions=[KeyError])
+    def picky():
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise KeyError("retry me")
+        raise ValueError("don't retry me")
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(picky.remote())
+    assert len(attempts) == 2
+
+
+def test_cancel_pending(ray_start_regular):
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(5)
+
+    @ray_tpu.remote
+    def target():
+        return 1
+
+    # saturate the pool so target stays queued
+    blockers = [blocker.options(num_cpus=1).remote() for _ in range(8)]
+    gate = ray_tpu.put("gate")
+
+    @ray_tpu.remote
+    def gated(g):
+        time.sleep(30)
+        return g
+
+    # a task waiting on resources long enough to cancel
+    victim = gated.remote(gate)
+    time.sleep(0.05)
+    ray_tpu.cancel(victim)
+    with pytest.raises(rex.TaskCancelledError):
+        ray_tpu.get(victim, timeout=40)
+    del blockers
+
+
+def test_remote_function_direct_call_raises(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+    assert f.func() == 1
+
+
+def test_resources_api(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] >= 4
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= total["CPU"]
+
+
+def test_ref_serialization_roundtrip(ray_start_regular):
+    import pickle
+
+    ref = ray_tpu.put("payload")
+    blob = pickle.dumps(ref)
+    ref2 = pickle.loads(blob)
+    assert ray_tpu.get(ref2) == "payload"
+
+
+def test_runtime_context(ray_start_regular):
+    ctx = ray_tpu.get_runtime_context()
+    assert len(ctx.get_job_id()) == 8  # 4 bytes hex
+
+    @ray_tpu.remote
+    def task_ctx():
+        return ray_tpu.get_runtime_context().get_task_id()
+
+    tid = ray_tpu.get(task_ctx.remote())
+    assert len(tid) == 32 and tid != ctx.get_task_id()
+
+
+def test_large_numpy_roundtrip(ray_start_regular):
+    import numpy as np
+
+    arr = np.arange(1 << 18, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    assert out.shape == arr.shape and out.dtype == arr.dtype
+    assert (out == arr).all()

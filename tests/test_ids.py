@@ -1,0 +1,51 @@
+"""ID structure tests (reference: src/ray/common/id.h semantics)."""
+
+from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID)
+
+
+def test_job_id_roundtrip():
+    j = JobID.from_int(7)
+    assert j.int_value() == 7
+    assert JobID.from_hex(j.hex()) == j
+
+
+def test_task_id_embeds_job():
+    j = JobID.from_random()
+    t = TaskID.of(j, seq=42)
+    assert t.job_id() == j
+    assert t.seq() == 42
+
+
+def test_object_id_embeds_task_and_index():
+    j = JobID.from_random()
+    t = TaskID.of(j)
+    o = ObjectID.for_task_return(t, 3)
+    assert o.task_id() == t
+    assert o.return_index() == 3
+    assert not o.is_put()
+    assert o.job_id() == j
+
+
+def test_put_id_disjoint_from_returns():
+    t = TaskID.of(JobID.from_random())
+    ret = ObjectID.for_task_return(t, 1)
+    put = ObjectID.for_put(t, 1)
+    assert ret != put
+    assert put.is_put()
+    assert put.return_index() == 1
+
+
+def test_actor_id_embeds_job():
+    j = JobID.from_random()
+    a = ActorID.of(j)
+    assert a.job_id() == j
+
+
+def test_ids_hashable_distinct():
+    ids = {TaskID.of(JobID.from_random()) for _ in range(100)}
+    assert len(ids) == 100
+
+
+def test_nil():
+    assert TaskID.nil().is_nil()
+    assert not TaskID.of(JobID.from_random()).is_nil()
