@@ -88,12 +88,12 @@ class ObjectRef:
         # GC can run __del__ inside ANY allocation, including while runtime
         # locks are held — defer the unref to the worker's drain thread.
         if not self._weak:
-            worker = _get_worker()
-            if worker is not None and worker.alive:
-                try:
+            try:
+                worker = _get_worker()
+                if worker is not None and worker.alive:
                     worker.defer_unref(self._id)
-                except Exception:  # interpreter shutdown
-                    pass
+            except BaseException:  # interpreter teardown: globals/imports gone
+                pass
 
     def __reduce__(self):
         # A deserialized copy registers itself as a borrower on unpickle.

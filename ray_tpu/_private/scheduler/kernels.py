@@ -1,0 +1,281 @@
+"""Batched scheduling kernels — the device-resident decision core.
+
+This replaces the reference's per-task C++ event-loop decisions
+(ray: src/ray/raylet/scheduling/cluster_task_manager.cc
+ClusterTaskManager::ScheduleAndDispatchTasks + local_task_manager.cc
+LocalTaskManager dispatch + scheduling_policy.cc HybridSchedulingPolicy)
+with data-parallel passes over the whole pending set per tick:
+
+  1. ready-set:   ready = waiting & (indegree == 0)
+  2. assignment:  for each scheduling class (the reference's
+                  SchedulingClass — tasks with identical (fn, demand)
+                  that can share worker leases), partition the ready
+                  tasks over nodes by a vectorized capacity fill:
+                  per-node fit counts -> cumsum -> searchsorted.
+                  The hybrid policy analog: node 0 ("local") is filled
+                  first up to the configured load threshold, then all
+                  nodes least-loaded-first.
+  3. completion wave: fire CSR edges of newly-done producers and
+                  decrement consumer indegrees with one segment-add.
+
+Two interchangeable backends with identical semantics:
+  - numpy: low-latency host ticks for small/interactive batches
+  - jax:   jit-compiled ticks for large batches and the benchmark
+           graphs (runs on the TPU; all O(T+E) ops vectorize onto the
+           VPU and the partition math is a handful of tiny reductions)
+
+Array-state conventions shared by both backends and TensorScheduler:
+  state   int8  [C]   0=FREE 1=WAITING 3=RUNNING 4=DONE  (2 reserved)
+  indeg   int32 [C]   outstanding dependency count
+  cls     int32 [C]   scheduling-class index into demands
+  demands f32  [K,R]  per-class resource demand vectors
+  avail   f32  [N,R]  per-node available resources
+  cap     f32  [N,R]  per-node capacities
+  node_of int32 [C]   assigned node (-1 = unassigned)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+FREE, WAITING, RUNNING, DONE = 0, 1, 3, 4
+
+
+# ======================================================================
+# numpy backend
+# ======================================================================
+
+def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
+              avail: np.ndarray, cap: np.ndarray,
+              threshold: float) -> Tuple[np.ndarray, np.ndarray]:
+    """Assign ready tasks (by arena index) to nodes.
+
+    Returns (node_of_ready [len(ready_idx)] int32 with -1 for
+    not-assigned-this-tick, updated avail). Mutates nothing.
+    """
+    avail = avail.copy()
+    n_nodes = avail.shape[0]
+    out = np.full(len(ready_idx), -1, dtype=np.int32)
+    if len(ready_idx) == 0:
+        return out, avail
+
+    ready_cls = cls[ready_idx]
+    for c in np.unique(ready_cls):
+        members = np.flatnonzero(ready_cls == c)  # positions in ready_idx
+        d = demands[c]
+        active = d > 0
+        if active.any():
+            with np.errstate(divide="ignore", invalid="ignore"):
+                per_r = np.floor(avail[:, active] / d[active])
+            fit = np.maximum(per_r.min(axis=1), 0.0)
+            fit = np.where(np.isfinite(fit), fit, len(members))
+            # infeasible-anywhere guard: nodes whose *capacity* can't ever
+            # hold the demand contribute 0 (matches EventScheduler feasible())
+            cap_ok = (cap[:, active] >= d[active]).all(axis=1)
+            fit = np.where(cap_ok, fit, 0.0)
+            # clip to the batch size: unbounded resources (e.g. 1e18 memory
+            # capacity) would otherwise make np.repeat materialize petabytes
+            fit = np.minimum(fit, len(members)).astype(np.int64)
+        else:
+            fit = np.full(n_nodes, len(members), dtype=np.int64)
+
+        # hybrid policy: node 0 takes tasks while its load stays under the
+        # threshold, then every node least-loaded-first up to its fit count.
+        used = cap - avail
+        with np.errstate(divide="ignore", invalid="ignore"):
+            load = np.where(cap > 0, used / np.maximum(cap, 1e-9), 0.0).max(axis=1)
+        if active.any() and fit[0] > 0 and load[0] < threshold:
+            room = np.floor((threshold * cap[0, active] - used[0, active])
+                            / d[active]).min()
+            t0 = int(np.clip(room, 0, fit[0]))
+        elif not active.any():
+            t0 = len(members) if load[0] < threshold else 0
+        else:
+            t0 = 0
+        order = np.argsort(load, kind="stable")
+        counts = [min(t0, len(members))]
+        nodes_seq = [0]
+        remaining_fit = fit.copy()
+        remaining_fit[0] -= counts[0]
+        for i in order:
+            nodes_seq.append(int(i))
+            counts.append(int(remaining_fit[i]))
+        assignment_nodes = np.repeat(np.asarray(nodes_seq, dtype=np.int32),
+                                     np.asarray(counts, dtype=np.int64))
+        take = min(len(members), len(assignment_nodes))
+        if take > 0:
+            chosen = assignment_nodes[:take]
+            out[members[:take]] = chosen
+            # ufunc.at accumulates correctly over repeated node indices
+            np.subtract.at(avail, chosen, d)
+    return out, avail
+
+
+def fire_edges_np(done_mask: np.ndarray, src: np.ndarray, dst: np.ndarray,
+                  consumed: np.ndarray, indeg: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Completion wave over a static edge list (bench / bulk-admission path).
+
+    Returns (new indeg, new consumed)."""
+    fire = done_mask[src] & ~consumed
+    if fire.any():
+        indeg = indeg.copy()
+        np.subtract.at(indeg, dst[fire], 1)
+        consumed = consumed | fire
+    return indeg, consumed
+
+
+# ======================================================================
+# jax backend
+# ======================================================================
+
+def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap):
+    """One scheduling class: partition `members` (bool mask over a flat task
+    axis) across nodes. Traced under jit; shared by the runtime assign kernel
+    and the benchmark whole-graph tick. Returns (assign_mask, chosen, avail).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rank = jnp.cumsum(members) - 1
+    active = d > 0
+    safe_d = jnp.where(active, d, 1.0)
+    per_r = jnp.where(active[None, :], jnp.floor(avail / safe_d), jnp.inf)
+    fit = jnp.clip(per_r.min(axis=1), 0, None)
+    cap_ok = jnp.where(active[None, :], cap >= d, True).all(axis=1)
+    fit = jnp.where(cap_ok, fit, 0.0)
+    fit = jnp.minimum(fit, jnp.float32(batch_cap)).astype(jnp.int32)
+
+    used_now = cap - avail
+    load_now = jnp.where(cap > 0, used_now / jnp.maximum(cap, 1e-9),
+                         0.0).max(axis=1)
+    k = members.sum()
+    room0 = jnp.where(active,
+                      jnp.floor((threshold * cap[0] - used_now[0]) / safe_d),
+                      jnp.inf).min()
+    any_active = active.any()
+    t0 = jnp.where(any_active,
+                   jnp.clip(room0, 0, fit[0]),
+                   jnp.where(load_now[0] < threshold, k, 0))
+    t0 = jnp.where((fit[0] > 0) | (~any_active), t0, 0)
+    t0 = jnp.where(load_now[0] < threshold, t0, 0).astype(jnp.int32)
+
+    order = jnp.argsort(load_now, stable=True)
+    fit_rest = fit.at[0].add(-t0)
+    seq_nodes = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                 order.astype(jnp.int32)])
+    seq_counts = jnp.concatenate([t0[None], fit_rest[order]])
+    cum = jnp.cumsum(seq_counts)
+    total = cum[-1]
+    seg = jnp.searchsorted(cum, rank, side="right")
+    seg = jnp.clip(seg, 0, n_nodes)
+    chosen = seq_nodes[seg]
+    assign_mask = members & (rank < total) & (rank >= 0)
+    per_node = jax.ops.segment_sum(
+        assign_mask.astype(jnp.float32), chosen, num_segments=n_nodes)
+    avail = avail - per_node[:, None] * d[None, :]
+    return assign_mask, chosen, avail
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_assign(num_classes: int, n_nodes: int, n_res: int, threshold: float):
+    """Jitted assignment over a compacted ready batch (runtime big-batch
+    path). Inputs: ready_cls [Kpad] int32 (class per ready task), valid
+    [Kpad] bool, demands [K,R], avail/cap [N,R]. Returns (node_of [Kpad]
+    int32, -1 = not assigned; new avail)."""
+    import jax
+    import jax.numpy as jnp
+
+    def assign(ready_cls, valid, demands, avail, cap):
+        kpad = ready_cls.shape[0]
+        node_of = jnp.full((kpad,), -1, dtype=jnp.int32)
+        for c in range(num_classes):
+            members = valid & (ready_cls == c)
+            assign_mask, chosen, avail = _assign_class_traced(
+                members, demands[c], avail, cap, threshold, n_nodes, kpad)
+            node_of = jnp.where(assign_mask, chosen, node_of)
+        return node_of, avail
+
+    return jax.jit(assign)
+
+
+def jax_assign(ready_cls: np.ndarray, demands: np.ndarray, avail: np.ndarray,
+               cap: np.ndarray, threshold: float
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad the ready batch to a power-of-two bucket (bounds recompiles) and
+    run the jitted assignment. Same contract as assign_np given
+    ready_cls = cls[ready_idx]."""
+    k = len(ready_cls)
+    kpad = 1 << max(9, (k - 1).bit_length())
+    padded = np.zeros(kpad, dtype=np.int32)
+    padded[:k] = ready_cls
+    valid = np.zeros(kpad, dtype=bool)
+    valid[:k] = True
+    fn = _jit_assign(int(demands.shape[0]), int(avail.shape[0]),
+                     int(avail.shape[1]), float(threshold))
+    node_of, new_avail = fn(padded, valid, demands.astype(np.float32),
+                            avail.astype(np.float32), cap.astype(np.float32))
+    return np.asarray(node_of)[:k], np.asarray(new_avail)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_tick(num_classes: int, n_nodes: int, n_res: int,
+              threshold: float, instant_completion: bool):
+    """Build a jitted whole-graph tick: ready-set -> per-class assignment
+    -> (optionally) instant completion + edge firing.
+
+    ``instant_completion=True`` is the benchmark/simulation mode: assigned
+    tasks complete within the tick and their out-edges fire, so one tick
+    advances one wave of the DAG. The runtime scheduler uses
+    ``instant_completion=False`` and reports completions from real
+    executions between ticks.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def tick(state, indeg, cls, demands, avail, cap, src, dst, consumed):
+        C = state.shape[0]
+        ready = (state == WAITING) & (indeg <= 0)
+        node_of = jnp.full((C,), -1, dtype=jnp.int32)
+
+        for c in range(num_classes):
+            members = ready & (cls == c)
+            assign_mask, chosen, avail = _assign_class_traced(
+                members, demands[c], avail, cap, threshold, n_nodes, C)
+            node_of = jnp.where(assign_mask, chosen, node_of)
+            state = jnp.where(assign_mask, jnp.int8(RUNNING), state)
+
+        if instant_completion:
+            newly_done = state == RUNNING
+            # release resources
+            for c in range(num_classes):
+                m = newly_done & (cls == c)
+                per_node = jax.ops.segment_sum(
+                    m.astype(jnp.float32),
+                    jnp.clip(node_of, 0, n_nodes - 1),
+                    num_segments=n_nodes)
+                avail = avail + per_node[:, None] * demands[c][None, :]
+            avail = jnp.minimum(avail, cap)
+            state = jnp.where(newly_done, jnp.int8(DONE), state)
+            done = state == DONE
+            fire = done[src] & ~consumed
+            dec = jax.ops.segment_sum(fire.astype(jnp.int32), dst,
+                                      num_segments=state.shape[0])
+            indeg = indeg - dec
+            consumed = consumed | fire
+
+        return state, indeg, avail, node_of, consumed
+
+    return jax.jit(tick, donate_argnums=(0, 1, 8))
+
+
+def jax_tick(state, indeg, cls, demands, avail, cap, src, dst, consumed,
+             *, num_classes: int, threshold: float,
+             instant_completion: bool = False):
+    """Run one jitted tick; shapes are static per (C, E, N, R, K) bucket."""
+    fn = _jit_tick(num_classes, int(avail.shape[0]), int(avail.shape[1]),
+                   float(threshold), bool(instant_completion))
+    return fn(state, indeg, cls, demands, avail, cap, src, dst, consumed)

@@ -1,0 +1,348 @@
+"""TensorScheduler — batched array-resident scheduler (the north star).
+
+Replaces the per-event O(1) decisions of EventScheduler (and of the
+reference's ClusterTaskManager / ILocalTaskManager,
+ray: src/ray/raylet/scheduling/cluster_task_manager.cc,
+local_task_manager.cc) with per-tick batched decisions over the whole
+pending set, held as arrays (see kernels.py for the decision kernels).
+
+Architecture:
+  - submit()/notify_*() only enqueue events (O(1), lock-held briefly)
+    and wake the tick thread.
+  - The tick thread drains all queued events, updates the task arena
+    arrays in bulk, computes the ready set + assignments with one
+    batched kernel call, and dispatches outside the lock.
+  - Dependencies are tracked as an ``indegree`` vector plus a host-side
+    ``object -> waiting slots`` index; object-ready events decrement
+    indegrees with one scatter per tick.
+
+Backends: numpy ticks by default (lowest latency at interactive sizes);
+the jax jitted kernel takes over for large ready batches
+(config sched_jax_min_batch) and for the benchmark graphs.
+
+The EventScheduler is kept as the semantics oracle: property tests run
+identical task graphs through both and assert the same completion
+semantics and capacity invariants.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.scheduler import kernels
+from ray_tpu._private.scheduler.base import PendingTask, SchedulerBase
+from ray_tpu._private.scheduler.kernels import DONE, FREE, RUNNING, WAITING
+from ray_tpu._private.scheduler.local import NodeState
+from ray_tpu._private.task_spec import resources_to_vector
+
+
+class TensorScheduler(SchedulerBase):
+    def __init__(self, nodes: List[NodeState],
+                 dispatcher: Callable[[PendingTask], None],
+                 store_contains: Optional[Callable[[ObjectID], bool]] = None,
+                 initial_capacity: int = 4096):
+        self._dispatch = dispatcher
+        self._store_contains = store_contains or (lambda oid: False)
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+
+        n_res = GLOBAL_CONFIG.sched_num_resources
+        self._cap = np.zeros((0, n_res), dtype=np.float32)
+        self._avail = np.zeros((0, n_res), dtype=np.float32)
+        self._node_states: List[NodeState] = []
+        for n in nodes:
+            self._append_node(n)
+
+        c = initial_capacity
+        self._state = np.zeros(c, dtype=np.int8)
+        self._indeg = np.zeros(c, dtype=np.int32)
+        self._cls = np.zeros(c, dtype=np.int32)
+        self._node_of = np.full(c, -1, dtype=np.int32)
+        self._free: collections.deque = collections.deque(range(c))
+
+        self._tasks: Dict[int, PendingTask] = {}       # slot -> task
+        self._slot_of: Dict[TaskID, int] = {}
+        self._waiters: Dict[ObjectID, List[int]] = {}  # oid -> slots
+        self._deps_of: Dict[int, List[ObjectID]] = {}  # slot -> pending oids
+
+        self._class_index: Dict[Tuple, int] = {}
+        self._demands = np.zeros((0, n_res), dtype=np.float32)
+
+        self._submit_q: collections.deque = collections.deque()
+        self._ready_obj_q: collections.deque = collections.deque()
+        self._finish_q: collections.deque = collections.deque()
+
+        self._num_submitted = 0
+        self._num_dispatched = 0
+        self._num_finished = 0
+        self._num_ticks = 0
+        self._dirty = False  # schedulability changed without a queued event
+        self._shutdown = False
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, daemon=True, name="ray_tpu_sched_tick")
+        self._tick_thread.start()
+
+    # -- SchedulerBase -----------------------------------------------------
+    def submit(self, task: PendingTask) -> None:
+        with self._wake:
+            self._submit_q.append(task)
+            self._num_submitted += 1
+            self._wake.notify()
+
+    def notify_object_ready(self, object_id: ObjectID) -> None:
+        with self._wake:
+            self._ready_obj_q.append(object_id)
+            self._wake.notify()
+
+    def notify_task_finished(self, task_id: TaskID, node_index: int,
+                             resources: Dict[str, float]) -> None:
+        with self._wake:
+            self._finish_q.append((task_id, node_index, resources))
+            self._num_finished += 1
+            self._wake.notify()
+
+    def cancel(self, task_id: TaskID) -> bool:
+        with self._wake:
+            # not yet admitted: remove straight from the submission queue
+            for task in self._submit_q:
+                if task.spec.task_id == task_id:
+                    task.cancelled = True
+                    self._submit_q.remove(task)
+                    return True
+            slot = self._slot_of.get(task_id)
+            if slot is None or self._state[slot] not in (WAITING,):
+                return False
+            task = self._tasks.get(slot)
+            if task is not None:
+                task.cancelled = True
+            self._release_slot(slot)
+            return True
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            waiting_mask = self._state == WAITING
+            dep_blocked = waiting_mask & (self._indeg > 0)
+            ready_mask = waiting_mask & (self._indeg <= 0)
+            # infeasible = ready but no node's *capacity* can ever hold it
+            infeasible = 0
+            for slot in np.flatnonzero(ready_mask):
+                d = self._demands[self._cls[slot]]
+                if not ((self._cap >= d[None, :]).all(axis=1)).any():
+                    infeasible += 1
+            return {
+                "submitted": self._num_submitted,
+                "dispatched": self._num_dispatched,
+                "finished": self._num_finished,
+                "ticks": self._num_ticks,
+                "waiting_deps": int(dep_blocked.sum()),
+                "ready_queue": int(ready_mask.sum()) - infeasible,
+                "running": int((self._state == RUNNING).sum()),
+                "infeasible": infeasible,
+                "nodes": [
+                    {"available": self._avail[i].tolist(),
+                     "capacity": self._cap[i].tolist()}
+                    for i in range(len(self._node_states))
+                ],
+            }
+
+    def shutdown(self) -> None:
+        with self._wake:
+            self._shutdown = True
+            self._wake.notify()
+        self._tick_thread.join(timeout=2.0)
+
+    # -- node management ---------------------------------------------------
+    def add_node(self, node: NodeState) -> int:
+        with self._wake:
+            idx = self._append_node(node)
+            self._dirty = True
+            self._wake.notify()
+            return idx
+
+    def remove_node(self, node_index: int) -> None:
+        with self._wake:
+            self._cap[node_index] = 0.0
+            self._avail[node_index] = 0.0
+            self._node_states[node_index].capacity = [0.0] * self._cap.shape[1]
+            self._node_states[node_index].available = [0.0] * self._cap.shape[1]
+            self._dirty = True
+            self._wake.notify()
+
+    def _append_node(self, node: NodeState) -> int:
+        vec = np.zeros((1, self._cap.shape[1] if self._cap.size else
+                        GLOBAL_CONFIG.sched_num_resources), dtype=np.float32)
+        for i, v in enumerate(node.capacity[:vec.shape[1]]):
+            vec[0, i] = v
+        self._cap = np.concatenate([self._cap, vec], axis=0)
+        av = vec.copy()
+        for i, v in enumerate(node.available[:vec.shape[1]]):
+            av[0, i] = v
+        self._avail = np.concatenate([self._avail, av], axis=0)
+        self._node_states.append(node)
+        return len(self._node_states) - 1
+
+    # -- tick loop ---------------------------------------------------------
+    def _tick_loop(self) -> None:
+        # Every WAITING->schedulable transition arrives as a queued event
+        # (object ready, task finished, node added), so the thread sleeps
+        # until events exist — no polling of dep-blocked or saturated tasks.
+        while True:
+            with self._wake:
+                while (not self._shutdown and not self._submit_q
+                       and not self._ready_obj_q and not self._finish_q
+                       and not self._dirty):
+                    self._wake.wait(timeout=0.5)
+                if self._shutdown:
+                    return
+                self._dirty = False
+                try:
+                    to_dispatch = self._tick_locked()
+                except Exception:
+                    logger.exception(
+                        "scheduler tick failed; state may be inconsistent")
+                    to_dispatch = []
+            for task in to_dispatch:
+                try:
+                    self._dispatch(task)
+                except Exception:
+                    logger.exception("dispatch failed for %s",
+                                     task.spec.task_id)
+
+    def _tick_locked(self) -> List[PendingTask]:
+        self._num_ticks += 1
+
+        # 1) admissions
+        while self._submit_q:
+            task = self._submit_q.popleft()
+            slot = self._alloc_slot()
+            spec = task.spec
+            self._tasks[slot] = task
+            self._slot_of[spec.task_id] = slot
+            key = spec.scheduling_class()
+            cidx = self._class_index.get(key)
+            if cidx is None:
+                cidx = len(self._class_index)
+                self._class_index[key] = cidx
+                vec = np.asarray(spec.resource_vector(), dtype=np.float32)
+                d = np.zeros((1, self._cap.shape[1]), dtype=np.float32)
+                w = min(len(vec), d.shape[1])
+                d[0, :w] = vec[:w]
+                self._demands = np.concatenate([self._demands, d], axis=0)
+            self._cls[slot] = cidx
+            pending_deps = []
+            for dep in task.deps:
+                if self._store_contains(dep):
+                    continue
+                self._waiters.setdefault(dep, []).append(slot)
+                pending_deps.append(dep)
+            self._indeg[slot] = len(pending_deps)
+            if pending_deps:
+                self._deps_of[slot] = pending_deps
+            self._state[slot] = WAITING
+
+        # 2) object-ready wave (batched indegree scatter)
+        dec_slots: List[int] = []
+        while self._ready_obj_q:
+            oid = self._ready_obj_q.popleft()
+            dec_slots.extend(self._waiters.pop(oid, ()))
+        if dec_slots:
+            np.subtract.at(self._indeg, np.asarray(dec_slots, dtype=np.int64), 1)
+
+        # 3) completions: release resources, free slots
+        while self._finish_q:
+            task_id, node_index, resources = self._finish_q.popleft()
+            slot = self._slot_of.get(task_id)
+            if slot is not None and self._state[slot] == RUNNING:
+                self._release_slot(slot)
+            if 0 <= node_index < len(self._node_states):
+                vec = np.asarray(resources_to_vector(resources),
+                                 dtype=np.float32)[:self._cap.shape[1]]
+                self._avail[node_index] = np.minimum(
+                    self._avail[node_index] + vec, self._cap[node_index])
+                self._node_states[node_index].release(tuple(vec))
+
+        # 4) ready set + batched assignment (numpy for interactive sizes;
+        #    the jitted jax kernel for large batches per sched_backend/auto)
+        ready_idx = np.flatnonzero((self._state == WAITING) & (self._indeg <= 0))
+        if len(ready_idx) == 0:
+            return []
+        backend = GLOBAL_CONFIG.sched_backend
+        use_jax = (backend == "jax"
+                   or (backend == "auto"
+                       and len(ready_idx) >= GLOBAL_CONFIG.sched_jax_min_batch))
+        threshold = GLOBAL_CONFIG.sched_hybrid_threshold
+        if use_jax:
+            try:
+                node_of_ready, new_avail = kernels.jax_assign(
+                    self._cls[ready_idx], self._demands, self._avail,
+                    self._cap, threshold)
+            except Exception:
+                logger.exception("jax assign failed; falling back to numpy")
+                node_of_ready, new_avail = kernels.assign_np(
+                    ready_idx, self._cls, self._demands, self._avail,
+                    self._cap, threshold)
+        else:
+            node_of_ready, new_avail = kernels.assign_np(
+                ready_idx, self._cls, self._demands, self._avail, self._cap,
+                threshold)
+        self._avail = new_avail
+        out: List[PendingTask] = []
+        for pos, slot in enumerate(ready_idx):
+            node = int(node_of_ready[pos])
+            if node < 0:
+                continue
+            task = self._tasks.get(int(slot))
+            if task is None or task.cancelled:
+                self._release_slot(int(slot))
+                continue
+            self._state[slot] = RUNNING
+            self._node_of[slot] = node
+            task.node_index = node
+            self._node_states[node].allocate(
+                tuple(self._demands[self._cls[slot]].tolist()))
+            self._num_dispatched += 1
+            out.append(task)
+        return out
+
+    # -- slot lifecycle ----------------------------------------------------
+    def _alloc_slot(self) -> int:
+        if not self._free:
+            old = len(self._state)
+            new = old * 2
+            self._state = np.concatenate(
+                [self._state, np.zeros(old, dtype=np.int8)])
+            self._indeg = np.concatenate(
+                [self._indeg, np.zeros(old, dtype=np.int32)])
+            self._cls = np.concatenate(
+                [self._cls, np.zeros(old, dtype=np.int32)])
+            self._node_of = np.concatenate(
+                [self._node_of, np.full(old, -1, dtype=np.int32)])
+            self._free.extend(range(old, new))
+        return self._free.popleft()
+
+    def _release_slot(self, slot: int) -> None:
+        task = self._tasks.pop(slot, None)
+        if task is not None:
+            self._slot_of.pop(task.spec.task_id, None)
+        for dep in self._deps_of.pop(slot, ()):
+            lst = self._waiters.get(dep)
+            if lst is not None:
+                try:
+                    lst.remove(slot)
+                except ValueError:
+                    pass
+                if not lst:
+                    self._waiters.pop(dep, None)
+        self._state[slot] = FREE
+        self._indeg[slot] = 0
+        self._node_of[slot] = -1
+        self._free.append(slot)
