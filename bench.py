@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Headline benchmark — north-star scheduling overhead.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+
+The metric is the BASELINE.json north star: aggregate scheduling overhead
+for a 1M-task fan-out DAG on one TPU chip (target < 10 ms; the reference's
+per-task C++ scheduler path runs ~1M tasks/s *cluster-wide*, i.e. ~1000 ms
+for the same DAG). vs_baseline = target_ms / measured_ms, so > 1.0 beats
+the target.
+
+Usage:
+  python bench.py            # north star only (the one JSON line)
+  python bench.py --all      # also run the 5 BASELINE configs (to stderr)
+  python bench.py --smoke    # tiny sizes (CI / CPU)
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    smoke = "--smoke" in sys.argv
+    run_all = "--all" in sys.argv
+
+    from ray_tpu._private import benchmarks
+
+    if run_all:
+        results = benchmarks.run_all("smoke" if smoke else "full")
+        for name, r in results.items():
+            print(f"  {name}: {r['scheduling_ms']:.3f} ms, "
+                  f"{r['tasks_per_sec']:.3g} tasks/s, {r['ticks']} ticks",
+                  file=sys.stderr)
+        ns = next(v for k, v in results.items() if k.startswith("north_star"))
+    else:
+        g = (benchmarks.build_north_star(10_000, 8) if smoke
+             else benchmarks.build_north_star())
+        ns = benchmarks.run_graph(g)
+
+    target_ms = 10.0
+    value = round(ns["scheduling_ms"], 4)
+    print(json.dumps({
+        "metric": "north_star_1M_fanout_scheduling_overhead",
+        "value": value,
+        "unit": "ms",
+        "vs_baseline": round(target_ms / max(value, 1e-9), 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,14 +170,54 @@ def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap):
     seq_counts = jnp.concatenate([t0[None], fit_rest[order]])
     cum = jnp.cumsum(seq_counts)
     total = cum[-1]
-    seg = jnp.searchsorted(cum, rank, side="right")
+    # Segment lookup without any [C, N] materialization: ``rank`` is
+    # monotone (a cumsum), so instead of comparing every rank against
+    # every boundary (compare-all: ~5 ms at C=1M) or per-element binary
+    # search (jnp.searchsorted scan lowering: ~50 ms at C=1M), find each
+    # boundary's position in rank (N+1 tiny binary searches), scatter unit
+    # deltas, and cumsum: seg[i] = #{j : pos[j] <= i} = #{j : cum[j] <=
+    # rank[i]}.
+    C = members.shape[0]
+    pos = jnp.searchsorted(rank, cum, side="left", method="scan")
+    delta = jnp.zeros((C + 1,), jnp.int32).at[jnp.clip(pos, 0, C)].add(1)
+    seg = jnp.cumsum(delta)[:C]
     seg = jnp.clip(seg, 0, n_nodes)
     chosen = seq_nodes[seg]
     assign_mask = members & (rank < total) & (rank >= 0)
-    per_node = jax.ops.segment_sum(
-        assign_mask.astype(jnp.float32), chosen, num_segments=n_nodes)
+    # per-node assignment counts from the same boundaries (no one-hot):
+    # segment j received min(cum[j], k) - min(cum[j-1], k) tasks
+    k = jnp.minimum(rank[-1] + 1, total).astype(cum.dtype)
+    m = jnp.minimum(cum, k)
+    seg_assigned = (m - jnp.concatenate([jnp.zeros((1,), m.dtype), m[:-1]])
+                    ).astype(jnp.float32)
+    per_node = jnp.zeros((n_nodes,), jnp.float32).at[seq_nodes].add(
+        seg_assigned)
     avail = avail - per_node[:, None] * d[None, :]
-    return assign_mask, chosen, avail
+    return assign_mask, chosen, avail, per_node
+
+
+def _make_drive_loop(tick, cls, pin, demands, cap, src, dst, max_ticks):
+    """while_loop driving the instant tick to DAG completion (shared by
+    _jit_drive and _jit_bench so the loop cannot diverge between them)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def drive(state, indeg, avail, consumed):
+        def cond(carry):
+            state, indeg, avail, consumed, ticks = carry
+            return (state == WAITING).any() & (ticks < max_ticks)
+
+        def body(carry):
+            state, indeg, avail, consumed, ticks = carry
+            state, indeg, avail, _node_of, consumed = tick(
+                state, indeg, cls, pin, demands, avail, cap, src, dst,
+                consumed)
+            return (state, indeg, avail, consumed, ticks + 1)
+
+        return lax.while_loop(
+            cond, body, (state, indeg, avail, consumed, jnp.int32(0)))
+
+    return drive
 
 
 @functools.lru_cache(maxsize=None)
@@ -194,7 +234,7 @@ def _jit_assign(num_classes: int, n_nodes: int, n_res: int, threshold: float):
         node_of = jnp.full((kpad,), -1, dtype=jnp.int32)
         for c in range(num_classes):
             members = valid & (ready_cls == c)
-            assign_mask, chosen, avail = _assign_class_traced(
+            assign_mask, chosen, avail, _pn = _assign_class_traced(
                 members, demands[c], avail, cap, threshold, n_nodes, kpad)
             node_of = jnp.where(assign_mask, chosen, node_of)
         return node_of, avail
@@ -221,6 +261,156 @@ def jax_assign(ready_cls: np.ndarray, demands: np.ndarray, avail: np.ndarray,
     return np.asarray(node_of)[:k], np.asarray(new_avail)
 
 
+def _make_instant_tick(num_classes: int, n_nodes: int, threshold: float):
+    """Traced instant-completion tick body shared by the single-tick entry
+    point and the fused on-device drive loop: ready-set -> assignment ->
+    instant completion -> resource release -> edge firing.
+
+    ``pin[t] >= 0`` assigns task t straight to that node with no capacity
+    partition — the batched analog of the reference's actor-call path,
+    where calls go directly to the actor's leased worker and never touch
+    the scheduler (ray: src/ray/core_worker/transport/ —
+    ActorTaskSubmitter submits over the actor's own queue). Pinned tasks
+    should use an all-zero demand class: the actor's resources were
+    acquired once at creation, not per call.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def tick(state, indeg, cls, pin, demands, avail, cap, src, dst, consumed):
+        C = state.shape[0]
+        ready = (state == WAITING) & (indeg <= 0)
+        pinned = ready & (pin >= 0)
+        node_of = jnp.where(pinned, pin, jnp.int32(-1))
+        state = jnp.where(pinned, jnp.int8(RUNNING), state)
+        ready = ready & ~pinned
+        per_node_by_class = []
+        for c in range(num_classes):
+            members = ready & (cls == c)
+            assign_mask, chosen, avail, per_node = _assign_class_traced(
+                members, demands[c], avail, cap, threshold, n_nodes, C)
+            per_node_by_class.append(per_node)
+            node_of = jnp.where(assign_mask, chosen, node_of)
+            state = jnp.where(assign_mask, jnp.int8(RUNNING), state)
+
+        newly_done = state == RUNNING
+        # instant completion releases exactly what assignment just took
+        # (pinned tasks use zero-demand classes), so reuse the per-class
+        # per-node counts instead of recounting over the task axis
+        for c in range(num_classes):
+            avail = avail + per_node_by_class[c][:, None] * demands[c][None, :]
+        avail = jnp.minimum(avail, cap)
+        state = jnp.where(newly_done, jnp.int8(DONE), state)
+        done = state == DONE
+        fire = done[src] & ~consumed
+        # builders emit dst sorted ascending -> no sort inside segment_sum
+        dec = jax.ops.segment_sum(fire.astype(jnp.int32), dst,
+                                  num_segments=C, indices_are_sorted=True)
+        indeg = indeg - dec
+        consumed = consumed | fire
+        return state, indeg, avail, node_of, consumed
+
+    return tick
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_drive(num_classes: int, n_nodes: int, n_res: int, threshold: float,
+               max_ticks: int, donate: bool = True):
+    """Whole-DAG drive fused into ONE device program: lax.while_loop over
+    the instant-completion tick. One dispatch + one host sync for the
+    entire graph — this is the north-star measurement path (per-tick host
+    round-trips would otherwise dominate on a tunneled/remote chip)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    tick = _make_instant_tick(num_classes, n_nodes, threshold)
+
+    def drive(state, indeg, cls, pin, demands, avail, cap, src, dst,
+              consumed):
+        loop = _make_drive_loop(tick, cls, pin, demands, cap, src, dst,
+                                max_ticks)
+        return loop(state, indeg, avail, consumed)
+
+    return jax.jit(drive, donate_argnums=(0, 1, 9) if donate else ())
+
+
+def jax_drive(state, indeg, cls, pin, demands, avail, cap, src, dst,
+              consumed, *, num_classes: int, threshold: float,
+              max_ticks: int, donate: bool = True):
+    """Run the fused on-device DAG drive; returns (state, ..., ticks).
+
+    CONTRACT: ``dst`` must be sorted ascending (the completion wave uses
+    segment_sum(indices_are_sorted=True); unsorted dst silently corrupts
+    indegrees). benchmarks._device_state enforces this by sorting.
+
+    donate=False keeps the input buffers alive so the same device state
+    can be re-driven (benchmark repeats without re-transferring)."""
+    fn = _jit_drive(num_classes, int(avail.shape[0]), int(avail.shape[1]),
+                    float(threshold), int(max_ticks), bool(donate))
+    return fn(state, indeg, cls, pin, demands, avail, cap, src, dst,
+              consumed)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_bench(num_classes: int, n_nodes: int, n_res: int, threshold: float,
+               max_ticks: int, k_reps: int):
+    """K whole-DAG drives chained by true data dependence, in ONE program.
+
+    Benchmark measurement core. Each repetition re-initializes the graph
+    state from the originals PLUS an all-zero value computed from the
+    previous repetition's outputs (``prev_state == RUNNING`` is always
+    false after a completed drive, but XLA cannot prove that), so the
+    compiler can neither CSE the repetitions nor hoist them out of the
+    loop, and the executions serialize. Fetching the returned tick-count
+    scalar forces genuine completion of all K drives — the only reliable
+    completion signal on transports whose block_until_ready acks early.
+    Cost model: T(K) = round_trip + K * drive_time; run at two K values
+    and difference to cancel the round trip.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    tick = _make_instant_tick(num_classes, n_nodes, threshold)
+
+    def bench(state0, indeg0, cls, pin, demands, avail0, cap, src, dst,
+              consumed0):
+        drive = _make_drive_loop(tick, cls, pin, demands, cap, src, dst,
+                                 max_ticks)
+
+        def outer(i, carry):
+            prev_state, _pi, _pa, _pc, total = carry
+            opaque = (prev_state == RUNNING).astype(jnp.int32)  # all zeros
+            state = (jnp.full_like(prev_state, WAITING)
+                     + opaque.astype(jnp.int8))
+            indeg = indeg0 + opaque
+            avail = avail0 + _pa * 0.0  # original avail + opaque zero
+            consumed = consumed0 | (prev_state == RUNNING)[src]
+            state, indeg, avail, consumed, t = drive(
+                state, indeg, avail, consumed)
+            return (state, indeg, avail, consumed, total + t)
+
+        state, indeg, avail, consumed, total = lax.fori_loop(
+            0, k_reps, outer,
+            (state0, indeg0, avail0, consumed0, jnp.int32(0)))
+        return total, state
+
+    return jax.jit(bench)
+
+
+def jax_bench(state, indeg, cls, pin, demands, avail, cap, src, dst,
+              consumed, *, num_classes: int, threshold: float,
+              max_ticks: int, k_reps: int):
+    """Run K chained drives; returns (total_ticks scalar, final state).
+
+    CONTRACT: ``dst`` must be sorted ascending (see jax_drive)."""
+    fn = _jit_bench(num_classes, int(avail.shape[0]), int(avail.shape[1]),
+                    float(threshold), int(max_ticks), int(k_reps))
+    return fn(state, indeg, cls, pin, demands, avail, cap, src, dst,
+              consumed)
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_tick(num_classes: int, n_nodes: int, n_res: int,
               threshold: float, instant_completion: bool):
@@ -236,46 +426,34 @@ def _jit_tick(num_classes: int, n_nodes: int, n_res: int,
     import jax
     import jax.numpy as jnp
 
-    def tick(state, indeg, cls, demands, avail, cap, src, dst, consumed):
+    if instant_completion:
+        tick = _make_instant_tick(num_classes, n_nodes, threshold)
+        return jax.jit(tick, donate_argnums=(0, 1, 9))
+
+    def tick(state, indeg, cls, pin, demands, avail, cap, src, dst, consumed):
         C = state.shape[0]
         ready = (state == WAITING) & (indeg <= 0)
-        node_of = jnp.full((C,), -1, dtype=jnp.int32)
-
+        pinned = ready & (pin >= 0)
+        node_of = jnp.where(pinned, pin, jnp.int32(-1))
+        state = jnp.where(pinned, jnp.int8(RUNNING), state)
+        ready = ready & ~pinned
         for c in range(num_classes):
             members = ready & (cls == c)
-            assign_mask, chosen, avail = _assign_class_traced(
+            assign_mask, chosen, avail, _pn = _assign_class_traced(
                 members, demands[c], avail, cap, threshold, n_nodes, C)
             node_of = jnp.where(assign_mask, chosen, node_of)
             state = jnp.where(assign_mask, jnp.int8(RUNNING), state)
-
-        if instant_completion:
-            newly_done = state == RUNNING
-            # release resources
-            for c in range(num_classes):
-                m = newly_done & (cls == c)
-                per_node = jax.ops.segment_sum(
-                    m.astype(jnp.float32),
-                    jnp.clip(node_of, 0, n_nodes - 1),
-                    num_segments=n_nodes)
-                avail = avail + per_node[:, None] * demands[c][None, :]
-            avail = jnp.minimum(avail, cap)
-            state = jnp.where(newly_done, jnp.int8(DONE), state)
-            done = state == DONE
-            fire = done[src] & ~consumed
-            dec = jax.ops.segment_sum(fire.astype(jnp.int32), dst,
-                                      num_segments=state.shape[0])
-            indeg = indeg - dec
-            consumed = consumed | fire
-
         return state, indeg, avail, node_of, consumed
 
-    return jax.jit(tick, donate_argnums=(0, 1, 8))
+    return jax.jit(tick, donate_argnums=(0, 1, 9))
 
 
-def jax_tick(state, indeg, cls, demands, avail, cap, src, dst, consumed,
+def jax_tick(state, indeg, cls, pin, demands, avail, cap, src, dst, consumed,
              *, num_classes: int, threshold: float,
              instant_completion: bool = False):
-    """Run one jitted tick; shapes are static per (C, E, N, R, K) bucket."""
+    """Run one jitted tick; shapes are static per (C, E, N, R, K) bucket.
+
+    CONTRACT: ``dst`` must be sorted ascending (see jax_drive)."""
     fn = _jit_tick(num_classes, int(avail.shape[0]), int(avail.shape[1]),
                    float(threshold), bool(instant_completion))
-    return fn(state, indeg, cls, demands, avail, cap, src, dst, consumed)
+    return fn(state, indeg, cls, pin, demands, avail, cap, src, dst, consumed)

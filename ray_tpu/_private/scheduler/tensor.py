@@ -71,6 +71,9 @@ class TensorScheduler(SchedulerBase):
 
         self._tasks: Dict[int, PendingTask] = {}       # slot -> task
         self._slot_of: Dict[TaskID, int] = {}
+        # id the slot was admitted under: spec.task_id mutates on retry, so
+        # release must use the admission-time id, not spec.task_id
+        self._tid_of: Dict[int, TaskID] = {}
         self._waiters: Dict[ObjectID, List[int]] = {}  # oid -> slots
         self._deps_of: Dict[int, List[ObjectID]] = {}  # slot -> pending oids
 
@@ -132,12 +135,19 @@ class TensorScheduler(SchedulerBase):
             waiting_mask = self._state == WAITING
             dep_blocked = waiting_mask & (self._indeg > 0)
             ready_mask = waiting_mask & (self._indeg <= 0)
-            # infeasible = ready but no node's *capacity* can ever hold it
-            infeasible = 0
-            for slot in np.flatnonzero(ready_mask):
-                d = self._demands[self._cls[slot]]
-                if not ((self._cap >= d[None, :]).all(axis=1)).any():
-                    infeasible += 1
+            # infeasible = ready but no node's *capacity* can ever hold it;
+            # feasibility depends only on the class, so compute per class
+            # (K x N) and count ready slots per class — O(K*N + C)
+            if self._demands.shape[0] and ready_mask.any():
+                class_feasible = (self._cap[None, :, :]
+                                  >= self._demands[:, None, :]).all(
+                                      axis=2).any(axis=1)  # [K]
+                ready_cls_counts = np.bincount(
+                    self._cls[ready_mask],
+                    minlength=self._demands.shape[0])
+                infeasible = int(ready_cls_counts[~class_feasible].sum())
+            else:
+                infeasible = 0
             return {
                 "submitted": self._num_submitted,
                 "dispatched": self._num_dispatched,
@@ -205,11 +215,26 @@ class TensorScheduler(SchedulerBase):
                     return
                 self._dirty = False
                 try:
-                    to_dispatch = self._tick_locked()
+                    snapshot = self._drain_events_locked()
                 except Exception:
                     logger.exception(
                         "scheduler tick failed; state may be inconsistent")
-                    to_dispatch = []
+                    snapshot = None
+            to_dispatch: List[PendingTask] = []
+            if snapshot is not None:
+                try:
+                    # assignment (and any jit compilation it triggers) runs
+                    # OUTSIDE the lock: the tick thread is the only mutator
+                    # of the scheduling arrays, so the snapshot stays
+                    # coherent; cancel()/remove_node() races are validated
+                    # at apply time
+                    ready_idx, decisions, new_avail = self._assign(snapshot)
+                    if ready_idx is not None:
+                        with self._wake:
+                            to_dispatch = self._apply_locked(
+                                ready_idx, decisions)
+                except Exception:
+                    logger.exception("scheduler assignment failed")
             for task in to_dispatch:
                 try:
                     self._dispatch(task)
@@ -217,7 +242,7 @@ class TensorScheduler(SchedulerBase):
                     logger.exception("dispatch failed for %s",
                                      task.spec.task_id)
 
-    def _tick_locked(self) -> List[PendingTask]:
+    def _drain_events_locked(self):
         self._num_ticks += 1
 
         # 1) admissions
@@ -227,6 +252,7 @@ class TensorScheduler(SchedulerBase):
             spec = task.spec
             self._tasks[slot] = task
             self._slot_of[spec.task_id] = slot
+            self._tid_of[slot] = spec.task_id
             key = spec.scheduling_class()
             cidx = self._class_index.get(key)
             if cidx is None:
@@ -270,45 +296,60 @@ class TensorScheduler(SchedulerBase):
                     self._avail[node_index] + vec, self._cap[node_index])
                 self._node_states[node_index].release(tuple(vec))
 
-        # 4) ready set + batched assignment (numpy for interactive sizes;
-        #    the jitted jax kernel for large batches per sched_backend/auto)
+        # snapshot for the out-of-lock assignment pass
         ready_idx = np.flatnonzero((self._state == WAITING) & (self._indeg <= 0))
         if len(ready_idx) == 0:
-            return []
+            return None
+        return (ready_idx, self._cls[ready_idx].copy(), self._demands.copy(),
+                self._avail.copy(), self._cap.copy())
+
+    def _assign(self, snapshot):
+        """Batched assignment OUTSIDE the lock (jit compilation of the jax
+        path can take seconds and must not block submit()/notify_*)."""
+        ready_idx, ready_cls, demands, avail, cap = snapshot
         backend = GLOBAL_CONFIG.sched_backend
         use_jax = (backend == "jax"
                    or (backend == "auto"
-                       and len(ready_idx) >= GLOBAL_CONFIG.sched_jax_min_batch))
+                       and len(ready_idx) >= GLOBAL_CONFIG.sched_jax_min_batch
+                       and demands.shape[0] <= 8))
         threshold = GLOBAL_CONFIG.sched_hybrid_threshold
         if use_jax:
             try:
                 node_of_ready, new_avail = kernels.jax_assign(
-                    self._cls[ready_idx], self._demands, self._avail,
-                    self._cap, threshold)
+                    ready_cls, demands, avail, cap, threshold)
             except Exception:
                 logger.exception("jax assign failed; falling back to numpy")
-                node_of_ready, new_avail = kernels.assign_np(
-                    ready_idx, self._cls, self._demands, self._avail,
-                    self._cap, threshold)
-        else:
+                use_jax = False
+        if not use_jax:
+            cls_full = np.zeros(int(ready_idx.max()) + 1, dtype=np.int32)
+            cls_full[ready_idx] = ready_cls
             node_of_ready, new_avail = kernels.assign_np(
-                ready_idx, self._cls, self._demands, self._avail, self._cap,
-                threshold)
-        self._avail = new_avail
+                ready_idx, cls_full, demands, avail, cap, threshold)
+        return ready_idx, node_of_ready, new_avail
+
+    def _apply_locked(self, ready_idx, node_of_ready) -> List[PendingTask]:
+        """Validate + apply out-of-lock decisions: a slot may have been
+        cancelled and a node drained/removed since the snapshot."""
         out: List[PendingTask] = []
         for pos, slot in enumerate(ready_idx):
             node = int(node_of_ready[pos])
             if node < 0:
                 continue
-            task = self._tasks.get(int(slot))
+            slot = int(slot)
+            if self._state[slot] != WAITING:
+                continue  # cancelled (and maybe reused) since snapshot
+            demand = self._demands[self._cls[slot]]
+            if not (self._cap[node] >= demand).all():
+                continue  # node removed/shrunk since snapshot; next tick
+            task = self._tasks.get(slot)
             if task is None or task.cancelled:
-                self._release_slot(int(slot))
+                self._release_slot(slot)
                 continue
             self._state[slot] = RUNNING
             self._node_of[slot] = node
+            self._avail[node] -= demand
             task.node_index = node
-            self._node_states[node].allocate(
-                tuple(self._demands[self._cls[slot]].tolist()))
+            self._node_states[node].allocate(tuple(demand.tolist()))
             self._num_dispatched += 1
             out.append(task)
         return out
@@ -330,9 +371,10 @@ class TensorScheduler(SchedulerBase):
         return self._free.popleft()
 
     def _release_slot(self, slot: int) -> None:
-        task = self._tasks.pop(slot, None)
-        if task is not None:
-            self._slot_of.pop(task.spec.task_id, None)
+        self._tasks.pop(slot, None)
+        tid = self._tid_of.pop(slot, None)
+        if tid is not None and self._slot_of.get(tid) == slot:
+            del self._slot_of[tid]
         for dep in self._deps_of.pop(slot, ()):
             lst = self._waiters.get(dep)
             if lst is not None:

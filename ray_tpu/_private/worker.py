@@ -280,14 +280,19 @@ class Worker:
         spec = pending.spec
         # retries keep the ORIGINAL return ids so existing refs resolve
         return_ids = getattr(spec, "_retry_return_ids", None) or spec.return_ids()
+        # capture the id this execution runs under: a retry mutates
+        # spec.task_id, and the scheduler must be notified for THIS id
+        # (and only after the retry has a fresh id) or its slot leaks
+        exec_task_id = spec.task_id
         cancel_ev = threading.Event()
         with self._running_lock:
-            self._running_tasks[spec.task_id] = cancel_ev
+            self._running_tasks[exec_task_id] = cancel_ev
 
         prev_task = self._context.task_id
         prev_put = self._context.put_counter
-        self._context.task_id = spec.task_id
+        self._context.task_id = exec_task_id
         self._context.put_counter = 0
+        retry_task: Optional[PendingTask] = None
         try:
             args, kwargs, dep_error = self._resolve_args(spec)
             if dep_error is not None:
@@ -295,24 +300,28 @@ class Worker:
                 return
             if cancel_ev.is_set():
                 self._store_error(spec, return_ids,
-                                  rex.TaskCancelledError(spec.task_id))
+                                  rex.TaskCancelledError(exec_task_id))
                 return
             self._maybe_inject_failure()
             try:
                 result = spec.func(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001
-                self._handle_task_failure(spec, return_ids, e)
+                retry_task = self._handle_task_failure(spec, return_ids, e)
                 return
             self._store_returns(spec, return_ids, result)
         finally:
             self._context.task_id = prev_task
             self._context.put_counter = prev_put
             with self._running_lock:
-                self._running_tasks.pop(spec.task_id, None)
+                self._running_tasks.pop(exec_task_id, None)
             deps = _top_level_deps(spec.args, spec.kwargs)
             self.reference_counter.remove_submitted_task_references(deps)
             self.scheduler.notify_task_finished(
-                spec.task_id, pending.node_index, spec.resources)
+                exec_task_id, pending.node_index, spec.resources)
+            # resubmit AFTER the finished notification so the scheduler
+            # releases this execution's slot before seeing the retry
+            if retry_task is not None:
+                self.scheduler.submit(retry_task)
 
     def _resolve_args(self, spec: TaskSpec):
         """Replace top-level ObjectRefs by values (reference semantics: only
@@ -353,7 +362,10 @@ class Worker:
             self.scheduler.notify_object_ready(oid)
         self.task_manager.complete(spec.task_id)
 
-    def _handle_task_failure(self, spec: TaskSpec, return_ids, exc: BaseException):
+    def _handle_task_failure(self, spec: TaskSpec, return_ids,
+                             exc: BaseException) -> Optional[PendingTask]:
+        """Store the error, or build the retry task for the caller to submit
+        once this execution's finished-notification has gone out."""
         if self.task_manager.should_retry(spec, exc):
             spec.attempt_number += 1
             spec.task_id = self.next_task_id()  # retries get a fresh attempt id
@@ -364,9 +376,8 @@ class Worker:
             spec._retry_return_ids = return_ids  # type: ignore[attr-defined]
             deps = _top_level_deps(spec.args, spec.kwargs)
             unresolved = [d for d in deps if not self.memory_store.contains(d)]
-            self.scheduler.submit(PendingTask(spec=spec, deps=unresolved,
-                                              execute=lambda t, n: None))
-            return
+            return PendingTask(spec=spec, deps=unresolved,
+                               execute=lambda t, n: None)
         if isinstance(exc, rex.TaskCancelledError):
             self._store_error(spec, return_ids, exc)
         else:
@@ -374,6 +385,7 @@ class Worker:
                                                     exc.__traceback__))
             self._store_error(spec, return_ids,
                               rex.TaskError(spec.name, exc, tb))
+        return None
 
     def _store_error(self, spec: TaskSpec, return_ids, exc: BaseException):
         for oid in return_ids:

@@ -1,0 +1,43 @@
+"""Benchmark harness smoke tests (tiny sizes, CPU backend via conftest).
+
+Checks the 5 BASELINE graph builders produce well-formed DAGs and that
+run_graph drives each to completion with correct tick counts."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import benchmarks as B
+
+
+class TestGraphBuilders:
+    @pytest.mark.parametrize("build,expected_depth", [
+        (lambda: B.build_fanout(100, 4), 1),
+        (lambda: B.build_map_reduce(202, 100, 4), 2),
+        (lambda: B.build_pipeline(3, 50, 4), 3),
+        (lambda: B.build_actor_heavy(10, 5, 4), 2),
+        (lambda: B.build_ppo(40, 4, 2, 2), 4),
+    ])
+    def test_builds_and_completes(self, build, expected_depth):
+        g = build()
+        assert (np.sort(g.dst) == g.dst).all() or len(g.dst) <= 1
+        r = B.run_graph(g, repeats=1)
+        assert r["ticks"] == expected_depth
+        assert r["scheduling_ms"] >= 0
+
+    def test_indegree_consistency(self):
+        g = B.build_map_reduce(202, 100, 4)
+        indeg = np.zeros(len(g.indeg), dtype=np.int32)
+        np.add.at(indeg, g.dst, 1)
+        assert (indeg == g.indeg).all()
+
+    def test_actor_pin_layout(self):
+        g = B.build_actor_heavy(10, 5, 4)
+        # creations unpinned + resource-bearing; calls pinned + zero-demand
+        assert (g.pin[:10] == -1).all()
+        assert (g.pin[10:] >= 0).all()
+        assert (g.demands[1] == 0).all()
+
+    def test_north_star_is_fanout(self):
+        g = B.build_north_star(1000, 4)
+        assert g.name.startswith("north_star")
+        assert (g.indeg == 0).all()

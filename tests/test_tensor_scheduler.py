@@ -98,6 +98,32 @@ class TestTensorSchedulerE2E:
         refs = [c.incr.remote() for _ in range(20)]
         assert ray_tpu.get(refs) == list(range(1, 21))
 
+    def test_retry_releases_slot(self, ray_start_tensor_sched):
+        """A retried failure must not leak the original RUNNING slot
+        (the finished-notification goes out under the execution's id
+        BEFORE the retry is resubmitted under a fresh id)."""
+        attempts = []
+
+        @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ValueError("transient")
+            return "ok"
+
+        assert ray_tpu.get(flaky.remote(), timeout=10) == "ok"
+        assert len(attempts) == 3
+        sched = ray_tpu._private.worker.global_worker.scheduler
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            s = sched.stats()
+            if s["running"] == 0 and s["ready_queue"] == 0:
+                break
+            time.sleep(0.01)
+        s = sched.stats()
+        assert s["running"] == 0, s
+        assert s["ready_queue"] == 0, s
+
     def test_cancel_queued(self, ray_start_tensor_sched):
         import ray_tpu.exceptions as rex
 
@@ -263,6 +289,8 @@ class TestJaxTickParity:
             max=C - 1).astype(np.int32)
         keep = src < dst
         src, dst = src[keep], dst[keep]
+        order = np.argsort(dst, kind="stable")  # kernel requires sorted dst
+        src, dst = src[order], dst[order]
         indeg = np.zeros(C, dtype=np.int32)
         np.add.at(indeg, dst, 1)
         cls = rng.integers(0, 2, size=C).astype(np.int32)
@@ -278,9 +306,10 @@ class TestJaxTickParity:
         ind = indeg.copy()
         avail = cap.copy()
         consumed = np.zeros(len(src), dtype=bool)
+        pin = np.full(C, -1, dtype=np.int32)
         for _ in range(C):
             state, ind, avail_j, node_of, consumed = kernels.jax_tick(
-                state, ind, cls, demands, avail, cap, src, dst, consumed,
+                state, ind, cls, pin, demands, avail, cap, src, dst, consumed,
                 num_classes=2, threshold=0.5, instant_completion=True)
             state = np.asarray(state)
             ind = np.asarray(ind)
