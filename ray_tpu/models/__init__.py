@@ -1,0 +1,8 @@
+"""Model family for the framework's compute path. The flagship is the
+decoder-only transformer (models/transformer.py) used by __graft_entry__,
+the Train library examples, and the serving stack."""
+
+from ray_tpu.models.transformer import (Transformer, TransformerConfig,
+                                        cross_entropy_loss)
+
+__all__ = ["Transformer", "TransformerConfig", "cross_entropy_loss"]

@@ -1,0 +1,21 @@
+"""Parallelism & communication layer — the TPU-native equivalent of the
+reference's distributed-training plumbing (ray: python/ray/util/collective/
+NCCL/GLOO groups, python/ray/dag/ compiled-graph NCCL channels, Train's
+torch.distributed wiring). On TPU these are sharding annotations on jitted
+programs: XLA inserts the ICI collectives (SURVEY.md §2.3)."""
+
+from ray_tpu.parallel.mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_FSDP,
+                                   AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR,
+                                   MeshConfig, default_logical_rules,
+                                   logical_sharding, make_mesh)
+from ray_tpu.parallel.collectives import (CollectiveGroup, allgather,
+                                          allreduce, barrier, broadcast,
+                                          reducescatter, send_recv)
+
+__all__ = [
+    "AXIS_DATA", "AXIS_EXPERT", "AXIS_FSDP", "AXIS_PIPE", "AXIS_SEQ",
+    "AXIS_TENSOR", "MeshConfig", "default_logical_rules",
+    "logical_sharding", "make_mesh",
+    "CollectiveGroup", "allgather", "allreduce", "barrier", "broadcast",
+    "reducescatter", "send_recv",
+]

@@ -1,0 +1,139 @@
+"""Device mesh construction + logical sharding rules.
+
+The single place where parallelism axes are named. Everything above
+(models, trainers, serving) speaks in LOGICAL axis names ("batch",
+"heads", ...); the mesh config maps them onto physical mesh axes so the
+same model code runs as pure DP, FSDP, TP, or any product of them —
+the XLA SPMD partitioner inserts the ICI collectives (all-gather /
+reduce-scatter / psum) that the reference obtains from NCCL process
+groups (ray: python/ray/util/collective/, train torch.distributed
+wiring; SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_DATA = "data"      # data parallelism (batch split, grads all-reduced)
+AXIS_FSDP = "fsdp"      # fully-sharded data parallel (params sharded too)
+AXIS_TENSOR = "tensor"  # tensor/model parallelism (heads, ffn split)
+AXIS_SEQ = "seq"        # sequence/context parallelism (ring attention)
+AXIS_PIPE = "pipe"      # pipeline stages
+AXIS_EXPERT = "expert"  # MoE expert parallelism
+
+_CANONICAL_ORDER = (AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_EXPERT,
+                    AXIS_SEQ, AXIS_TENSOR)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes per mesh axis; axes of size 1 are still present (so sharding
+    specs are stable across configurations). Product must equal the
+    device count used."""
+    data: int = 1
+    fsdp: int = 1
+    pipe: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
+        return ((AXIS_DATA, self.data), (AXIS_FSDP, self.fsdp),
+                (AXIS_PIPE, self.pipe), (AXIS_EXPERT, self.expert),
+                (AXIS_SEQ, self.seq), (AXIS_TENSOR, self.tensor))
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for _, s in self.axis_sizes():
+            n *= s
+        return n
+
+    @staticmethod
+    def for_devices(n: int) -> "MeshConfig":
+        """A reasonable default decomposition for n devices: favor fsdp
+        (cheapest to scale for training) then data, then tensor."""
+        if n == 1:
+            return MeshConfig()
+        tensor = 1
+        for t in (2,):
+            if n % t == 0 and n > 2:
+                tensor = t
+        rest = n // tensor
+        fsdp = 1
+        while rest % 2 == 0 and fsdp < 8:
+            fsdp *= 2
+            rest //= 2
+        return MeshConfig(data=rest, fsdp=fsdp, tensor=tensor)
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None):
+    """Build a jax.sharding.Mesh with the canonical axis names.
+
+    ICI topology note: later axes of the mesh vary fastest over the
+    device order, so put the highest-bandwidth-demand axis (tensor) LAST
+    — adjacent devices on the ICI torus then serve the heaviest
+    collectives (the scaling-book recipe)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if config is None:
+        config = MeshConfig.for_devices(len(devices))
+    if config.num_devices != len(devices):
+        raise ValueError(
+            f"mesh config {config} needs {config.num_devices} devices, "
+            f"got {len(devices)}")
+    shape = [s for _, s in config.axis_sizes()]
+    names = [a for a, _ in config.axis_sizes()]
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(names))
+
+
+def default_logical_rules() -> List[Tuple[str, object]]:
+    """Logical-axis -> mesh-axis mapping for the model family.
+
+    Parameters:
+      vocab   -> tensor     (embedding/output vocab split)
+      embed   -> fsdp       (d_model axis of weights: ZeRO-3 style shard)
+      heads   -> tensor     (attention heads split across chips)
+      mlp     -> tensor     (ffn hidden split)
+    Activations:
+      batch     -> (data, fsdp)  (global batch split across both axes)
+      act_seq   -> seq           (sequence/context parallelism)
+      act_embed -> None          (activation hidden replicated)
+    """
+    return [
+        ("vocab", AXIS_TENSOR),
+        ("embed", AXIS_FSDP),
+        ("heads", AXIS_TENSOR),
+        ("kv_heads", AXIS_TENSOR),
+        ("mlp", AXIS_TENSOR),
+        ("layers", None),
+        ("batch", (AXIS_DATA, AXIS_FSDP)),
+        ("act_seq", AXIS_SEQ),
+        ("act_embed", None),
+        ("head_dim", None),
+    ]
+
+
+def logical_sharding(mesh, logical_axes: Sequence[Optional[str]],
+                     rules: Optional[List[Tuple[str, object]]] = None):
+    """NamedSharding for an array whose dims carry the given logical axis
+    names (None = replicated dim)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rules = rules if rules is not None else default_logical_rules()
+    table = dict(rules)
+    spec = []
+    for ax in logical_axes:
+        if ax is None:
+            spec.append(None)
+            continue
+        mapped = table.get(ax)
+        spec.append(mapped)
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -11,6 +11,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The env var alone is NOT enough in this environment: the axon TPU
+# plugin overrides JAX_PLATFORMS at import, silently routing "cpu" tests
+# through the tunneled chip. jax.config is authoritative.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
