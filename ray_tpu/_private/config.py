@@ -123,8 +123,11 @@ _d("sched_num_resources", int, 4,
 _d("sched_max_nodes", int, 64, "node capacity matrix rows held on device")
 _d("sched_hybrid_threshold", float, 0.5,
    "prefer-local until node load exceeds this fraction (hybrid policy analog)")
+_d("scheduler", str, "tensor",
+   "scheduler implementation: tensor (device-array batched, default) | "
+   "event (per-event oracle)")
 _d("sched_backend", str, "auto",
-   "scheduler tick backend: auto | jax | numpy (numpy for tiny graphs)")
+   "TensorScheduler tick backend: auto | jax | numpy (numpy for tiny graphs)")
 _d("sched_jax_min_batch", int, 512,
    "below this many pending tasks the numpy tick is used (auto mode)")
 

@@ -62,6 +62,9 @@ def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
     if len(ready_idx) == 0:
         return out, avail
 
+    # a removed node zeroes its capacity; it must never receive tasks —
+    # without this, zero-demand tasks see it as the least-loaded node
+    alive = cap.any(axis=1)
     ready_cls = cls[ready_idx]
     for c in np.unique(ready_cls):
         members = np.flatnonzero(ready_cls == c)  # positions in ready_idx
@@ -81,6 +84,7 @@ def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
             fit = np.minimum(fit, len(members)).astype(np.int64)
         else:
             fit = np.full(n_nodes, len(members), dtype=np.int64)
+        fit = np.where(alive, fit, 0)
 
         # hybrid policy: node 0 takes tasks while its load stays under the
         # threshold, then every node least-loaded-first up to its fit count.
@@ -92,7 +96,7 @@ def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
                             / d[active]).min()
             t0 = int(np.clip(room, 0, fit[0]))
         elif not active.any():
-            t0 = len(members) if load[0] < threshold else 0
+            t0 = len(members) if load[0] < threshold and alive[0] else 0
         else:
             t0 = 0
         order = np.argsort(load, kind="stable")
@@ -146,7 +150,10 @@ def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap):
     per_r = jnp.where(active[None, :], jnp.floor(avail / safe_d), jnp.inf)
     fit = jnp.clip(per_r.min(axis=1), 0, None)
     cap_ok = jnp.where(active[None, :], cap >= d, True).all(axis=1)
-    fit = jnp.where(cap_ok, fit, 0.0)
+    # dead (removed) nodes have all-zero capacity and must take nothing —
+    # even zero-demand tasks, which would otherwise see load 0
+    alive = (cap > 0).any(axis=1)
+    fit = jnp.where(cap_ok & alive, fit, 0.0)
     fit = jnp.minimum(fit, jnp.float32(batch_cap)).astype(jnp.int32)
 
     used_now = cap - avail
@@ -161,7 +168,8 @@ def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap):
                    jnp.clip(room0, 0, fit[0]),
                    jnp.where(load_now[0] < threshold, k, 0))
     t0 = jnp.where((fit[0] > 0) | (~any_active), t0, 0)
-    t0 = jnp.where(load_now[0] < threshold, t0, 0).astype(jnp.int32)
+    t0 = jnp.where(load_now[0] < threshold, t0, 0)
+    t0 = jnp.where(alive[0], t0, 0).astype(jnp.int32)
 
     order = jnp.argsort(load_now, stable=True)
     fit_rest = fit.at[0].add(-t0)
@@ -196,6 +204,69 @@ def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap):
     return assign_mask, chosen, avail, per_node
 
 
+def _scan_classes(ready, cls, demands, avail, cap, threshold, n_nodes,
+                  batch_cap):
+    """Sequential capacity consumption over the class axis via lax.scan.
+
+    Class count is DATA (the demands array's leading dim), not a Python
+    unroll: one compiled program serves any class count with the same
+    padded shape, so newly observed scheduling classes never trigger an
+    XLA recompile (classes are padded to power-of-two buckets by callers;
+    a zero-demand padding class has no members and assigns nothing).
+
+    Returns (node_of [C] int32 with -1 = unassigned, assigned [C] bool,
+    new avail, release [N,R] = total resources the assigned tasks took,
+    for the instant-completion path to hand back).
+
+    ``assigned`` is returned SEPARATELY from ``node_of`` on purpose:
+    state updates must derive from the cheap mask so that when a caller
+    discards node_of (the fused drive loop does), XLA can dead-code-
+    eliminate the per-task ``chosen`` gather chain — deriving the mask
+    from ``node_of >= 0`` instead keeps that gather live and costs ~8x
+    on the 1M north star.
+
+    Tiny class counts (the benchmark graphs, K <= 4) statically unroll —
+    a scan's dynamic demand slice blocks fusion inside the drive
+    while_loop. Larger counts scan (class as data: no recompile as
+    classes accumulate).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    C = ready.shape[0]
+    K = demands.shape[0]
+    node_of0 = jnp.full((C,), -1, dtype=jnp.int32)
+    assigned0 = jnp.zeros((C,), dtype=bool)
+    release0 = jnp.zeros_like(avail)
+
+    if K <= 4:
+        node_of, assigned, release = node_of0, assigned0, release0
+        for c in range(K):
+            members = ready & (cls == c)
+            assign_mask, chosen, avail, per_node = _assign_class_traced(
+                members, demands[c], avail, cap, threshold, n_nodes,
+                batch_cap)
+            node_of = jnp.where(assign_mask, chosen, node_of)
+            assigned = assigned | assign_mask
+            release = release + per_node[:, None] * demands[c][None, :]
+        return node_of, assigned, avail, release
+
+    def step(carry, c):
+        node_of, assigned, avail, release = carry
+        members = ready & (cls == c)
+        assign_mask, chosen, avail, per_node = _assign_class_traced(
+            members, demands[c], avail, cap, threshold, n_nodes, batch_cap)
+        node_of = jnp.where(assign_mask, chosen, node_of)
+        assigned = assigned | assign_mask
+        release = release + per_node[:, None] * demands[c][None, :]
+        return (node_of, assigned, avail, release), None
+
+    (node_of, assigned, avail, release), _ = lax.scan(
+        step, (node_of0, assigned0, avail, release0),
+        jnp.arange(K, dtype=jnp.int32))
+    return node_of, assigned, avail, release
+
+
 def _make_drive_loop(tick, cls, pin, demands, cap, src, dst, max_ticks):
     """while_loop driving the instant tick to DAG completion (shared by
     _jit_drive and _jit_bench so the loop cannot diverge between them)."""
@@ -221,22 +292,20 @@ def _make_drive_loop(tick, cls, pin, demands, cap, src, dst, max_ticks):
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_assign(num_classes: int, n_nodes: int, n_res: int, threshold: float):
+def _jit_assign(threshold: float):
     """Jitted assignment over a compacted ready batch (runtime big-batch
     path). Inputs: ready_cls [Kpad] int32 (class per ready task), valid
     [Kpad] bool, demands [K,R], avail/cap [N,R]. Returns (node_of [Kpad]
-    int32, -1 = not assigned; new avail)."""
+    int32, -1 = not assigned; new avail). jit specializes on the padded
+    shapes; the class axis is scanned, so class count only recompiles at
+    power-of-two bucket boundaries (the padding done by jax_assign)."""
     import jax
-    import jax.numpy as jnp
 
     def assign(ready_cls, valid, demands, avail, cap):
         kpad = ready_cls.shape[0]
-        node_of = jnp.full((kpad,), -1, dtype=jnp.int32)
-        for c in range(num_classes):
-            members = valid & (ready_cls == c)
-            assign_mask, chosen, avail, _pn = _assign_class_traced(
-                members, demands[c], avail, cap, threshold, n_nodes, kpad)
-            node_of = jnp.where(assign_mask, chosen, node_of)
+        node_of, _assigned, avail, _release = _scan_classes(
+            valid, ready_cls, demands, avail, cap, threshold,
+            avail.shape[0], kpad)
         return node_of, avail
 
     return jax.jit(assign)
@@ -245,23 +314,29 @@ def _jit_assign(num_classes: int, n_nodes: int, n_res: int, threshold: float):
 def jax_assign(ready_cls: np.ndarray, demands: np.ndarray, avail: np.ndarray,
                cap: np.ndarray, threshold: float
                ) -> Tuple[np.ndarray, np.ndarray]:
-    """Pad the ready batch to a power-of-two bucket (bounds recompiles) and
-    run the jitted assignment. Same contract as assign_np given
-    ready_cls = cls[ready_idx]."""
+    """Pad the ready batch AND the class axis to power-of-two buckets
+    (bounds recompiles to O(log) in both) and run the jitted assignment.
+    Same contract as assign_np given ready_cls = cls[ready_idx]."""
     k = len(ready_cls)
     kpad = 1 << max(9, (k - 1).bit_length())
     padded = np.zeros(kpad, dtype=np.int32)
     padded[:k] = ready_cls
     valid = np.zeros(kpad, dtype=bool)
     valid[:k] = True
-    fn = _jit_assign(int(demands.shape[0]), int(avail.shape[0]),
-                     int(avail.shape[1]), float(threshold))
-    node_of, new_avail = fn(padded, valid, demands.astype(np.float32),
+    num_classes = int(demands.shape[0])
+    kcls = 1 << max(0, (num_classes - 1).bit_length())
+    demands = demands.astype(np.float32)
+    if kcls > num_classes:
+        demands = np.concatenate(
+            [demands, np.zeros((kcls - num_classes, demands.shape[1]),
+                               dtype=np.float32)], axis=0)
+    fn = _jit_assign(float(threshold))
+    node_of, new_avail = fn(padded, valid, demands,
                             avail.astype(np.float32), cap.astype(np.float32))
     return np.asarray(node_of)[:k], np.asarray(new_avail)
 
 
-def _make_instant_tick(num_classes: int, n_nodes: int, threshold: float):
+def _make_instant_tick(threshold: float):
     """Traced instant-completion tick body shared by the single-tick entry
     point and the fused on-device drive loop: ready-set -> assignment ->
     instant completion -> resource release -> edge firing.
@@ -284,22 +359,16 @@ def _make_instant_tick(num_classes: int, n_nodes: int, threshold: float):
         node_of = jnp.where(pinned, pin, jnp.int32(-1))
         state = jnp.where(pinned, jnp.int8(RUNNING), state)
         ready = ready & ~pinned
-        per_node_by_class = []
-        for c in range(num_classes):
-            members = ready & (cls == c)
-            assign_mask, chosen, avail, per_node = _assign_class_traced(
-                members, demands[c], avail, cap, threshold, n_nodes, C)
-            per_node_by_class.append(per_node)
-            node_of = jnp.where(assign_mask, chosen, node_of)
-            state = jnp.where(assign_mask, jnp.int8(RUNNING), state)
+        nof, assigned, avail, release = _scan_classes(
+            ready, cls, demands, avail, cap, threshold, avail.shape[0], C)
+        node_of = jnp.where(assigned, nof, node_of)
+        state = jnp.where(assigned, jnp.int8(RUNNING), state)
 
         newly_done = state == RUNNING
         # instant completion releases exactly what assignment just took
-        # (pinned tasks use zero-demand classes), so reuse the per-class
-        # per-node counts instead of recounting over the task axis
-        for c in range(num_classes):
-            avail = avail + per_node_by_class[c][:, None] * demands[c][None, :]
-        avail = jnp.minimum(avail, cap)
+        # (pinned tasks use zero-demand classes), so reuse the scan's
+        # accumulated release matrix instead of recounting the task axis
+        avail = jnp.minimum(avail + release, cap)
         state = jnp.where(newly_done, jnp.int8(DONE), state)
         done = state == DONE
         fire = done[src] & ~consumed
@@ -314,8 +383,7 @@ def _make_instant_tick(num_classes: int, n_nodes: int, threshold: float):
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_drive(num_classes: int, n_nodes: int, n_res: int, threshold: float,
-               max_ticks: int, donate: bool = True):
+def _jit_drive(threshold: float, max_ticks: int, donate: bool = True):
     """Whole-DAG drive fused into ONE device program: lax.while_loop over
     the instant-completion tick. One dispatch + one host sync for the
     entire graph — this is the north-star measurement path (per-tick host
@@ -324,7 +392,7 @@ def _jit_drive(num_classes: int, n_nodes: int, n_res: int, threshold: float,
     import jax.numpy as jnp
     from jax import lax
 
-    tick = _make_instant_tick(num_classes, n_nodes, threshold)
+    tick = _make_instant_tick(threshold)
 
     def drive(state, indeg, cls, pin, demands, avail, cap, src, dst,
               consumed):
@@ -346,15 +414,14 @@ def jax_drive(state, indeg, cls, pin, demands, avail, cap, src, dst,
 
     donate=False keeps the input buffers alive so the same device state
     can be re-driven (benchmark repeats without re-transferring)."""
-    fn = _jit_drive(num_classes, int(avail.shape[0]), int(avail.shape[1]),
-                    float(threshold), int(max_ticks), bool(donate))
+    del num_classes  # class count is now the demands array's leading dim
+    fn = _jit_drive(float(threshold), int(max_ticks), bool(donate))
     return fn(state, indeg, cls, pin, demands, avail, cap, src, dst,
               consumed)
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_bench(num_classes: int, n_nodes: int, n_res: int, threshold: float,
-               max_ticks: int, k_reps: int):
+def _jit_bench(threshold: float, max_ticks: int, k_reps: int):
     """K whole-DAG drives chained by true data dependence, in ONE program.
 
     Benchmark measurement core. Each repetition re-initializes the graph
@@ -372,7 +439,7 @@ def _jit_bench(num_classes: int, n_nodes: int, n_res: int, threshold: float,
     import jax.numpy as jnp
     from jax import lax
 
-    tick = _make_instant_tick(num_classes, n_nodes, threshold)
+    tick = _make_instant_tick(threshold)
 
     def bench(state0, indeg0, cls, pin, demands, avail0, cap, src, dst,
               consumed0):
@@ -405,15 +472,14 @@ def jax_bench(state, indeg, cls, pin, demands, avail, cap, src, dst,
     """Run K chained drives; returns (total_ticks scalar, final state).
 
     CONTRACT: ``dst`` must be sorted ascending (see jax_drive)."""
-    fn = _jit_bench(num_classes, int(avail.shape[0]), int(avail.shape[1]),
-                    float(threshold), int(max_ticks), int(k_reps))
+    del num_classes  # class count is now the demands array's leading dim
+    fn = _jit_bench(float(threshold), int(max_ticks), int(k_reps))
     return fn(state, indeg, cls, pin, demands, avail, cap, src, dst,
               consumed)
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_tick(num_classes: int, n_nodes: int, n_res: int,
-              threshold: float, instant_completion: bool):
+def _jit_tick(threshold: float, instant_completion: bool):
     """Build a jitted whole-graph tick: ready-set -> per-class assignment
     -> (optionally) instant completion + edge firing.
 
@@ -427,7 +493,7 @@ def _jit_tick(num_classes: int, n_nodes: int, n_res: int,
     import jax.numpy as jnp
 
     if instant_completion:
-        tick = _make_instant_tick(num_classes, n_nodes, threshold)
+        tick = _make_instant_tick(threshold)
         return jax.jit(tick, donate_argnums=(0, 1, 9))
 
     def tick(state, indeg, cls, pin, demands, avail, cap, src, dst, consumed):
@@ -437,12 +503,10 @@ def _jit_tick(num_classes: int, n_nodes: int, n_res: int,
         node_of = jnp.where(pinned, pin, jnp.int32(-1))
         state = jnp.where(pinned, jnp.int8(RUNNING), state)
         ready = ready & ~pinned
-        for c in range(num_classes):
-            members = ready & (cls == c)
-            assign_mask, chosen, avail, _pn = _assign_class_traced(
-                members, demands[c], avail, cap, threshold, n_nodes, C)
-            node_of = jnp.where(assign_mask, chosen, node_of)
-            state = jnp.where(assign_mask, jnp.int8(RUNNING), state)
+        nof, assigned, avail, _release = _scan_classes(
+            ready, cls, demands, avail, cap, threshold, avail.shape[0], C)
+        node_of = jnp.where(assigned, nof, node_of)
+        state = jnp.where(assigned, jnp.int8(RUNNING), state)
         return state, indeg, avail, node_of, consumed
 
     return jax.jit(tick, donate_argnums=(0, 1, 9))
@@ -454,6 +518,6 @@ def jax_tick(state, indeg, cls, pin, demands, avail, cap, src, dst, consumed,
     """Run one jitted tick; shapes are static per (C, E, N, R, K) bucket.
 
     CONTRACT: ``dst`` must be sorted ascending (see jax_drive)."""
-    fn = _jit_tick(num_classes, int(avail.shape[0]), int(avail.shape[1]),
-                   float(threshold), bool(instant_completion))
+    del num_classes  # class count is now the demands array's leading dim
+    fn = _jit_tick(float(threshold), bool(instant_completion))
     return fn(state, indeg, cls, pin, demands, avail, cap, src, dst, consumed)

@@ -159,9 +159,18 @@ class EventScheduler(SchedulerBase):
 
     # -- node management (used by the virtual cluster test util) -----------
     def add_node(self, node: NodeState) -> int:
+        to_dispatch = []
         with self._lock:
             self._nodes.append(node)
-            return len(self._nodes) - 1
+            idx = len(self._nodes) - 1
+            # a new node can make previously-infeasible tasks feasible;
+            # without this rescan they would be parked forever
+            if self._infeasible:
+                self._ready.extend(self._infeasible)
+                self._infeasible.clear()
+            to_dispatch = self._drain_ready_locked()
+        self._run_dispatch(to_dispatch)
+        return idx
 
     def remove_node(self, node_index: int) -> None:
         with self._lock:

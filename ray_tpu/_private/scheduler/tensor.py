@@ -308,15 +308,22 @@ class TensorScheduler(SchedulerBase):
         path can take seconds and must not block submit()/notify_*)."""
         ready_idx, ready_cls, demands, avail, cap = snapshot
         backend = GLOBAL_CONFIG.sched_backend
+        # class count no longer gates the device path: the kernel scans the
+        # class axis (class as data), so many classes don't grow the program
         use_jax = (backend == "jax"
                    or (backend == "auto"
-                       and len(ready_idx) >= GLOBAL_CONFIG.sched_jax_min_batch
-                       and demands.shape[0] <= 8))
+                       and len(ready_idx) >= GLOBAL_CONFIG.sched_jax_min_batch))
         threshold = GLOBAL_CONFIG.sched_hybrid_threshold
         if use_jax:
             try:
+                # compact the class axis to the classes PRESENT in this
+                # batch: self._demands grows for process lifetime (one row
+                # per unique scheduling class, never compacted), and the
+                # kernel's scan length is its leading dim
+                uniq, inv = np.unique(ready_cls, return_inverse=True)
                 node_of_ready, new_avail = kernels.jax_assign(
-                    ready_cls, demands, avail, cap, threshold)
+                    inv.astype(np.int32), demands[uniq], avail, cap,
+                    threshold)
             except Exception:
                 logger.exception("jax assign failed; falling back to numpy")
                 use_jax = False
@@ -339,8 +346,12 @@ class TensorScheduler(SchedulerBase):
             if self._state[slot] != WAITING:
                 continue  # cancelled (and maybe reused) since snapshot
             demand = self._demands[self._cls[slot]]
+            # liveness first: a removed node zeroes its capacity, and a
+            # zero-demand task would otherwise pass the fit check (0 >= 0)
+            if not (self._cap[node] > 0).any():
+                continue  # node removed since snapshot
             if not (self._cap[node] >= demand).all():
-                continue  # node removed/shrunk since snapshot; next tick
+                continue  # node shrunk since snapshot; next tick
             task = self._tasks.get(slot)
             if task is None or task.cancelled:
                 self._release_slot(slot)

@@ -95,6 +95,11 @@ class TaskManager:
         with self._lock:
             return self._lineage.get(task_id)
 
+    def get_pending_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._lock:
+            entry = self._pending.get(task_id)
+            return entry[0] if entry is not None else None
+
     def evict_lineage(self, task_id: TaskID) -> None:
         with self._lock:
             if self._lineage.pop(task_id, None) is not None:
@@ -241,8 +246,14 @@ class Worker:
         task_id = ref.task_id()
         if self.scheduler.cancel(task_id):
             err = rex.TaskCancelledError(task_id)
-            spec_returns = 1  # at minimum the ref being cancelled
-            self.memory_store.put(ref.object_id(), err, is_exception=True)
+            # resolve ALL the task's return refs, not just the one passed
+            # in — a get() on a sibling return must not hang forever
+            spec = self.task_manager.get_pending_spec(task_id)
+            return_ids = (spec.return_ids() if spec is not None
+                          else [ref.object_id()])
+            for oid in return_ids:
+                self.memory_store.put(oid, err, is_exception=True)
+                self.scheduler.notify_object_ready(oid)
             self.task_manager.complete(task_id)
             return
         with self._running_lock:
@@ -478,13 +489,19 @@ def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
         if _system_config:
             GLOBAL_CONFIG.unfreeze()
             GLOBAL_CONFIG.apply_system_config(_system_config)
+        # Two separate knobs (previously conflated): ``scheduler`` picks the
+        # scheduler CLASS (tensor = device-array north star, the default;
+        # event = per-event oracle); ``sched_backend`` picks the tensor
+        # scheduler's TICK backend (auto|jax|numpy).
         scheduler_factory = None
-        backend = scheduler or GLOBAL_CONFIG.sched_backend
-        if backend in ("jax", "tensor"):
+        impl = scheduler or GLOBAL_CONFIG.scheduler
+        if impl in ("tensor", "jax"):  # "jax" kept as a legacy alias
             from ray_tpu._private.scheduler.tensor import TensorScheduler
             scheduler_factory = (
                 lambda nodes, dispatch, contains:
                 TensorScheduler(nodes, dispatch, contains))
+        elif impl != "event":
+            raise ValueError(f"unknown scheduler {impl!r}: tensor | event")
         GLOBAL_CONFIG.freeze()
         global_worker = Worker(num_cpus=num_cpus, num_workers=num_workers,
                                scheduler_factory=scheduler_factory)

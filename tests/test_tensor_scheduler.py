@@ -395,3 +395,49 @@ class TestTensorSchedulerMultiNode:
                 assert dispatched[0].node_index == 1
         finally:
             sched.shutdown()
+
+
+class TestManyClasses:
+    """The class axis is scanned (class as data), so large class counts
+    must run the jax path without per-class recompiles and must match the
+    numpy oracle decision-for-decision in totals."""
+
+    def test_64_classes_jax_matches_numpy(self):
+        rng = np.random.default_rng(7)
+        K, C, N = 64, 512, 8
+        demands = np.zeros((K, 4), dtype=np.float32)
+        demands[:, 0] = rng.integers(1, 4, size=K)
+        cls = rng.integers(0, K, size=C).astype(np.int32)
+        cap = np.zeros((N, 4), dtype=np.float32)
+        cap[:, 0] = rng.integers(16, 64, size=N)
+        ready_idx = np.arange(C)
+
+        node_np, avail_np = kernels.assign_np(
+            ready_idx, cls, demands, cap.copy(), cap, 0.5)
+        node_jx, avail_jx = kernels.jax_assign(
+            cls, demands, cap.copy(), cap, 0.5)
+
+        # identical assignment decisions per task, not just totals
+        assert (node_np == node_jx).all()
+        assert np.allclose(avail_np, avail_jx, atol=1e-4)
+
+    def test_class_bucket_no_recompile(self):
+        """Growing the class count within a power-of-two bucket reuses the
+        same compiled program (jax_assign pads the class axis)."""
+        import jax
+
+        cap = np.asarray([[64, 0, 0, 0]], dtype=np.float32)
+
+        def run(k):
+            demands = np.zeros((k, 4), dtype=np.float32)
+            demands[:, 0] = 1
+            cls = np.arange(k, dtype=np.int32)
+            kernels.jax_assign(cls, demands, cap.copy(), cap, 0.5)
+
+        run(33)  # lands in the 64-class bucket
+        fn = kernels._jit_assign(0.5)
+        sizes_before = fn._cache_size()
+        run(48)  # same bucket: no new compile
+        assert fn._cache_size() == sizes_before
+        run(65)  # next bucket: exactly one new compile is allowed
+        assert fn._cache_size() == sizes_before + 1
