@@ -87,6 +87,18 @@ class ConfigRegistry:
     def unfreeze(self) -> None:
         self._frozen = False
 
+    def reset(self) -> None:
+        """Restore every knob to default (env overrides re-applied).
+        Called at runtime shutdown: ``_system_config`` is scoped to one
+        init/shutdown cycle, like the reference's per-cluster config."""
+        with self._lock:
+            if self._frozen:
+                raise RuntimeError("cannot reset a frozen config")
+            for entry in self._entries.values():
+                env = os.environ.get(_ENV_PREFIX + entry.name.upper())
+                entry.value = (_PARSERS[entry.type](env)
+                               if env is not None else entry.default)
+
     def snapshot(self) -> Dict[str, Any]:
         return {k: e.value for k, e in self._entries.items()}
 

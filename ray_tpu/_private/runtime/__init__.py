@@ -1,0 +1,8 @@
+"""Multi-process node runtime (phase P3).
+
+Reference surfaces: the raylet's worker pool
+(ray: src/ray/raylet/worker_pool.cc), the plasma shared-memory store
+(ray: src/ray/object_manager/plasma/), and the core-worker execution path
+(ray: src/ray/core_worker/). Here: forked worker processes driven over
+pipes, with a shared-memory mmap arena as the large-object data plane.
+"""

@@ -1,0 +1,616 @@
+"""Process worker pool — driver side of the multi-process node runtime.
+
+Reference surfaces: ray src/ray/raylet/worker_pool.cc (WorkerPool:
+prestarted worker processes, PopWorker/PushWorker), the dispatch half of
+src/ray/raylet/local_task_manager.cc (a scheduler decision becomes a
+lease grant to a worker process), and the owner side of
+src/ray/core_worker/ (results stored under the owner's ids, borrower
+bookkeeping for refs that cross the process boundary).
+
+Data plane: small values cross the task pipe inline; large values go
+through the node's shm arena (create/seal RPC, zero-copy reads) — the
+plasma split. Control plane: one duplex pipe per worker for tasks + RPC,
+a second for cancellation.
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from multiprocessing.connection import Listener
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as rex
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.runtime.worker_process import _ShmValue, fn_id_of
+from ray_tpu._private.scheduler.base import PendingTask
+from ray_tpu._private.serialization import SerializedObject, deserialize, serialize
+from ray_tpu._private.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+class ShmPlaceholder:
+    """Memory-store entry whose bytes live in the shm arena; resolved
+    (deserialized zero-copy) on first driver-side access."""
+
+    __slots__ = ()
+
+
+_PLACEHOLDER = ShmPlaceholder()
+
+
+class _RefCollectPickler(cloudpickle.Pickler):
+    """cloudpickle that records every ObjectRef crossing the boundary so
+    the owner can register borrows (reference: ReferenceCounter borrower
+    protocol, src/ray/core_worker/reference_count.cc)."""
+
+    def __init__(self, file, contained: List[ObjectRef]):
+        super().__init__(file, protocol=5)
+        self._contained = contained
+
+    def reducer_override(self, obj):
+        if isinstance(obj, ObjectRef):
+            self._contained.append(obj)
+        return super().reducer_override(obj)
+
+
+def _dumps_collect_refs(value: Any) -> Tuple[bytes, List[ObjectRef]]:
+    contained: List[ObjectRef] = []
+    f = io.BytesIO()
+    _RefCollectPickler(f, contained).dump(value)
+    return f.getvalue(), contained
+
+
+class _Handle:
+    __slots__ = ("worker_num", "proc", "conn", "ctrl", "worker_id", "pid",
+                 "busy", "exec_task_id", "return_ids", "borrows",
+                 "sent_fns", "dead", "force_cancelled", "send_lock",
+                 "ready", "actor_rt")
+
+    def __init__(self, worker_num: int):
+        self.actor_rt = None  # set for dedicated actor workers
+        self.worker_num = worker_num
+        self.proc: Optional[subprocess.Popen] = None
+        self.conn = None
+        self.ctrl = None
+        self.worker_id = WorkerID.from_random()
+        self.pid: Optional[int] = None
+        self.busy: Optional[PendingTask] = None
+        self.exec_task_id: Optional[TaskID] = None
+        self.return_ids: List[ObjectID] = []
+        self.borrows: Set[ObjectID] = set()
+        self.sent_fns: Set[bytes] = set()
+        self.dead = False
+        self.force_cancelled = False
+        self.send_lock = threading.Lock()
+        self.ready = False
+
+
+class ProcessWorkerPool:
+    def __init__(self, worker, num_workers: int, shm_store):
+        self._worker = worker
+        self._shm = shm_store
+        self._lock = threading.Lock()
+        self._idle: Deque[_Handle] = collections.deque()
+        self._queue: Deque[Tuple[PendingTask, dict]] = collections.deque()
+        self._handles: List[_Handle] = []
+        self._actor_handles: List[_Handle] = []
+        self._by_num: Dict[int, _Handle] = {}
+        self._by_task: Dict[TaskID, _Handle] = {}
+        self._shutdown = False
+        self._worker_seq = 0
+        self._inline_max = GLOBAL_CONFIG.inline_object_max_bytes
+        self._inject_prob = GLOBAL_CONFIG.testing_inject_task_failure_prob
+        # children exec `python -m ...worker_process` and dial back here
+        # (reference: raylet execs default_worker.py; registration over a
+        # unix socket) — never fork/spawn of this process, whose jax/TPU
+        # state and threads are not fork-safe and whose __main__ must not
+        # be re-run
+        self._authkey = os.urandom(16)
+        self._sock_dir = tempfile.mkdtemp(prefix="ray_tpu_pool_")
+        self._listener = Listener(
+            address=os.path.join(self._sock_dir, "pool.sock"),
+            family="AF_UNIX", authkey=self._authkey)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="ray_tpu_pool_accept").start()
+        for _ in range(num_workers):
+            self._handles.append(self._spawn())
+
+    # ------------------------------------------------------------------
+    # worker lifecycle
+    # ------------------------------------------------------------------
+    def _spawn(self) -> _Handle:
+        with self._lock:
+            self._worker_seq += 1
+            num = self._worker_seq
+        h = _Handle(num)
+        with self._lock:
+            self._by_num[num] = h
+        env = dict(os.environ)
+        env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in sys.path if p) + os.pathsep + env.get("PYTHONPATH", "")
+        h.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.runtime.worker_process",
+             self._listener.address, self._shm.arena.name,
+             str(self._inline_max), str(num)],
+            env=env, close_fds=True)
+        h.pid = h.proc.pid
+        threading.Thread(target=self._monitor_proc, args=(h,), daemon=True,
+                         name=f"ray_tpu_pool_monitor_{num}").start()
+        return h
+
+    def _monitor_proc(self, h: _Handle) -> None:
+        h.proc.wait()
+        self._on_worker_failure(h, f"exit code {h.proc.returncode}")
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            try:
+                hello = conn.recv()
+            except (EOFError, OSError):
+                conn.close()
+                continue
+            if not (isinstance(hello, tuple) and hello[0] == "hello"):
+                conn.close()
+                continue
+            _, num, kind = hello
+            with self._lock:
+                h = self._by_num.get(num)
+            if h is None or h.dead:
+                conn.close()
+                continue
+            if kind == "task":
+                h.conn = conn
+                threading.Thread(target=self._reader_loop, args=(h,),
+                                 daemon=True,
+                                 name=f"ray_tpu_pool_reader_{num}").start()
+            else:
+                h.ctrl = conn
+
+    def pids(self) -> List[int]:
+        with self._lock:
+            return [h.pid for h in self._handles if h.pid is not None]
+
+    # ------------------------------------------------------------------
+    # dedicated actor workers (reference: every actor gets its own
+    # worker process; GcsActorScheduler leases one at creation)
+    # ------------------------------------------------------------------
+    def spawn_actor_worker(self, actor_rt) -> _Handle:
+        h = self._spawn()
+        h.actor_rt = actor_rt
+        with self._lock:
+            self._actor_handles.append(h)
+        return h
+
+    def send_to(self, h: _Handle, msg: tuple) -> None:
+        with h.send_lock:
+            h.conn.send(msg)
+
+    def release_actor_worker(self, h: _Handle, kill: bool = False) -> None:
+        with self._lock:
+            try:
+                self._actor_handles.remove(h)
+            except ValueError:
+                pass
+            h.dead = True
+            self._by_num.pop(h.worker_num, None)
+        if kill and h.proc is not None:
+            try:
+                h.proc.kill()
+            except Exception:
+                pass
+        elif h.conn is not None:
+            try:
+                with h.send_lock:
+                    h.conn.send(("exit",))
+            except (OSError, ValueError):
+                pass
+
+    # ------------------------------------------------------------------
+    # submission (called from the driver's dispatch thread pool)
+    # ------------------------------------------------------------------
+    def run_task(self, pending: PendingTask) -> None:
+        spec = pending.spec
+        exec_task_id = spec.task_id
+        return_ids = (getattr(spec, "_retry_return_ids", None)
+                      or spec.return_ids())
+        try:
+            payload, borrows = self._build_payload(spec, return_ids)
+        except _DepError as e:
+            self._worker._store_error(spec, return_ids, e.error)
+            self._finish_task(pending, exec_task_id, None)
+            return
+        except Exception as e:  # unserializable task
+            self._worker._store_error(
+                spec, return_ids,
+                rex.TaskError(spec.name, e, "task serialization failed"))
+            self._finish_task(pending, exec_task_id, None)
+            return
+        with self._lock:
+            if self._shutdown:
+                return
+            if self._idle:
+                h = self._idle.popleft()
+            else:
+                self._queue.append((pending, payload))
+                return
+        self._assign(h, pending, payload)
+
+    def _build_payload(self, spec: TaskSpec,
+                       return_ids: List[ObjectID]) -> Tuple[dict, list]:
+        args = tuple(self._resolve_for_ship(a) for a in spec.args)
+        kwargs = {k: self._resolve_for_ship(v) for k, v in spec.kwargs.items()}
+        args_blob, contained = _dumps_collect_refs((args, kwargs))
+        fn_blob = cloudpickle.dumps(spec.func)
+        payload = dict(
+            task_id=spec.task_id.binary(),
+            name=spec.name,
+            fn_id=fn_id_of(fn_blob),
+            fn_blob=fn_blob,
+            args_blob=args_blob,
+            num_returns=spec.num_returns,
+            return_ids=[o.binary() for o in return_ids],
+            inject_prob=self._inject_prob,
+        )
+        payload["_contained"] = [r.object_id() for r in contained]
+        return payload, contained
+
+    def _resolve_for_ship(self, v: Any) -> Any:
+        """Top-level ObjectRef -> value (small) or _ShmValue (large)."""
+        if not isinstance(v, ObjectRef):
+            return v
+        oid = v.object_id()
+        loc = self._shm.locate(oid)
+        if loc is not None:
+            return _ShmValue(*loc)
+        entry = self._worker.memory_store.get_entry(oid)
+        if entry is None:
+            raise _DepError(rex.ObjectLostError(oid.hex()))
+        if entry.is_exception:
+            raise _DepError(entry.value)
+        return entry.value
+
+    def _assign(self, h: _Handle, pending: PendingTask, payload: dict) -> None:
+        spec = pending.spec
+        contained = payload.pop("_contained")
+        h.busy = pending
+        h.exec_task_id = spec.task_id
+        h.return_ids = [ObjectID(b) for b in payload["return_ids"]]
+        h.force_cancelled = False
+        # register borrows for refs crossing into the worker BEFORE the
+        # task can observe them
+        for oid in contained:
+            self._worker.reference_counter.add_borrower(oid, h.worker_id)
+            h.borrows.add(oid)
+        with self._lock:
+            self._by_task[spec.task_id] = h
+        if payload["fn_id"] in h.sent_fns:
+            payload = dict(payload, fn_blob=None)
+        else:
+            h.sent_fns.add(payload["fn_id"])
+        try:
+            with h.send_lock:
+                h.conn.send(("task", payload))
+        except (OSError, ValueError) as e:
+            self._on_worker_failure(h, e)
+
+    # ------------------------------------------------------------------
+    # reader: completions + worker-initiated RPC
+    # ------------------------------------------------------------------
+    def _reader_loop(self, h: _Handle) -> None:
+        while True:
+            try:
+                msg = h.conn.recv()
+            except (EOFError, OSError):
+                self._on_worker_failure(h, None)
+                return
+            kind = msg[0]
+            try:
+                if kind == "ready":
+                    h.pid = msg[1]
+                    h.ready = True
+                    if h.actor_rt is not None:
+                        h.actor_rt._on_worker_ready(h)
+                    else:
+                        self._mark_idle(h)
+                elif kind == "done":
+                    if h.actor_rt is not None:
+                        h.actor_rt._on_remote_done(TaskID(msg[1]), msg[2])
+                    else:
+                        self._on_done(h, TaskID(msg[1]), msg[2])
+                elif kind == "err":
+                    if h.actor_rt is not None:
+                        h.actor_rt._on_remote_err(TaskID(msg[1]), msg[2],
+                                                  msg[3])
+                    else:
+                        self._on_err(h, TaskID(msg[1]), msg[2], msg[3])
+                elif kind == "rpc":
+                    self._on_rpc(h, msg[1], msg[2], msg[3])
+            except Exception:
+                logger.exception("pool reader failed handling %s", kind)
+
+    def _mark_idle(self, h: _Handle) -> None:
+        nxt = None
+        with self._lock:
+            if self._shutdown or h.dead:
+                return
+            if self._queue:
+                nxt = self._queue.popleft()
+            else:
+                self._idle.append(h)
+        if nxt is not None:
+            self._assign(h, *nxt)
+
+    def _release(self, h: _Handle, task_id: TaskID) -> None:
+        for oid in h.borrows:
+            self._worker.reference_counter.remove_borrower(oid, h.worker_id)
+        h.borrows = set()
+        h.busy = None
+        h.exec_task_id = None
+        with self._lock:
+            self._by_task.pop(task_id, None)
+        self._mark_idle(h)
+
+    def store_result_entries(self, return_ids: List[ObjectID],
+                             entries: list) -> None:
+        """Seal + register worker-produced result locations under the
+        owner's ids (shm entries resolve lazily; inline deserialized)."""
+        for oid, entry in zip(return_ids, entries):
+            if entry[0] == "shm":
+                self._shm.seal(oid)
+                self._worker.memory_store.put(oid, _PLACEHOLDER)
+            else:
+                value = deserialize(SerializedObject.from_bytes(entry[1]))
+                self._worker.memory_store.put(oid, value)
+            self._worker.scheduler.notify_object_ready(oid)
+
+    def _on_done(self, h: _Handle, task_id: TaskID, entries: list) -> None:
+        pending, spec = h.busy, h.busy.spec
+        self.store_result_entries(h.return_ids, entries)
+        self._worker.task_manager.complete(spec.task_id)
+        self._finish_task(pending, task_id, None)
+        self._release(h, task_id)
+
+    def _on_err(self, h: _Handle, task_id: TaskID, exc_blob: bytes,
+                tb: str) -> None:
+        pending, spec = h.busy, h.busy.spec
+        try:
+            exc = cloudpickle.loads(exc_blob)
+        except Exception:
+            exc = RuntimeError("worker error (exception undeserializable)")
+        exc._ray_tpu_traceback = tb
+        retry = self._worker._handle_task_failure(spec, h.return_ids, exc)
+        self._finish_task(pending, task_id, retry)
+        self._release(h, task_id)
+
+    def _finish_task(self, pending: PendingTask, exec_task_id: TaskID,
+                     retry: Optional[PendingTask]) -> None:
+        from ray_tpu._private.worker import _top_level_deps
+
+        spec = pending.spec
+        deps = _top_level_deps(spec.args, spec.kwargs)
+        self._worker.reference_counter.remove_submitted_task_references(deps)
+        self._worker.scheduler.notify_task_finished(
+            exec_task_id, pending.node_index, spec.resources)
+        if retry is not None:
+            self._worker.scheduler.submit(retry)
+
+    def _on_worker_failure(self, h: _Handle, cause) -> None:
+        with self._lock:
+            if h.dead:
+                if h.actor_rt is not None:
+                    pass  # released actor workers still notify their rt
+                else:
+                    return
+            was_dead = h.dead
+            h.dead = True
+            self._by_num.pop(h.worker_num, None)
+            try:
+                self._idle.remove(h)
+            except ValueError:
+                pass
+            shutting_down = self._shutdown
+        if h.actor_rt is not None:
+            if not shutting_down and not was_dead:
+                h.actor_rt._on_process_died(h, cause)
+            return
+        pending = h.busy
+        if pending is not None and not shutting_down:
+            spec = pending.spec
+            if h.force_cancelled:
+                exc: BaseException = rex.TaskCancelledError(h.exec_task_id)
+            else:
+                exc = rex.WorkerCrashedError(
+                    f"worker process {h.pid} died while running "
+                    f"{spec.name}: {cause}")
+            retry = self._worker._handle_task_failure(spec, h.return_ids, exc)
+            self._finish_task(pending, h.exec_task_id, retry)
+            for oid in h.borrows:
+                self._worker.reference_counter.remove_borrower(
+                    oid, h.worker_id)
+            with self._lock:
+                self._by_task.pop(h.exec_task_id, None)
+        if not shutting_down:
+            # replacement worker keeps the pool at capacity
+            replacement = self._spawn()
+            with self._lock:
+                try:
+                    self._handles[self._handles.index(h)] = replacement
+                except ValueError:
+                    self._handles.append(replacement)
+
+    # ------------------------------------------------------------------
+    # worker-initiated RPC (get/put/submit/create/wait from inside tasks)
+    # ------------------------------------------------------------------
+    def _on_rpc(self, h: _Handle, req_id: int, op: str, args: tuple) -> None:
+        try:
+            data = getattr(self, f"_rpc_{op}")(h, *args)
+            ok = True
+        except BaseException as e:  # noqa: BLE001
+            ok, data = False, cloudpickle.dumps(e)
+        with h.send_lock:
+            h.conn.send(("reply", req_id, ok, data))
+
+    def _rpc_create(self, h: _Handle, oid_bin: bytes, nbytes: int) -> int:
+        return self._shm.create(ObjectID(oid_bin), nbytes)
+
+    def _rpc_put(self, h: _Handle, oid_bin: bytes, loc: tuple) -> bool:
+        oid = ObjectID(oid_bin)
+        self._worker.reference_counter.add_owned_object(oid)
+        # the worker holds the only handle: track it as a borrower until
+        # the task completes (driver-side refs appear if the ref is
+        # returned, which deserializes and registers locally first)
+        self._worker.reference_counter.add_borrower(oid, h.worker_id)
+        h.borrows.add(oid)
+        if loc[0] == "shm":
+            self._shm.seal(oid)
+            self._worker.memory_store.put(oid, _PLACEHOLDER)
+        else:
+            value = deserialize(SerializedObject.from_bytes(loc[1]))
+            self._worker.memory_store.put(oid, value)
+        self._worker.scheduler.notify_object_ready(oid)
+        return True
+
+    def _rpc_get(self, h: _Handle, oid_bins: list,
+                 timeout: Optional[float]) -> list:
+        oids = [ObjectID(b) for b in oid_bins]
+        try:
+            entries = self._worker.memory_store.wait_and_get(oids, timeout)
+        except TimeoutError as e:
+            raise rex.GetTimeoutError(str(e)) from None
+        out = []
+        for oid, entry in zip(oids, entries):
+            if entry.is_exception:
+                out.append(("exc", cloudpickle.dumps(entry.value)))
+                continue
+            loc = self._shm.locate(oid)
+            if loc is not None:
+                out.append(("shm", loc[0], loc[1]))
+            else:
+                out.append(("inline", serialize(entry.value).to_bytes()))
+        return out
+
+    def _rpc_wait(self, h: _Handle, oid_bins: list, num_returns: int,
+                  timeout: Optional[float]) -> list:
+        oids = [ObjectID(b) for b in oid_bins]
+        ready = self._worker.memory_store.wait(oids, num_returns, timeout)
+        return [o.binary() for o in oids if o in ready]
+
+    def _rpc_submit(self, h: _Handle, blob: bytes) -> list:
+        d = cloudpickle.loads(blob)
+        func = cloudpickle.loads(d["func_blob"])
+        args, kwargs = cloudpickle.loads(d["args_blob"])
+        spec = TaskSpec(
+            task_id=self._worker.next_task_id(),
+            name=d["name"],
+            func=func,
+            func_descriptor=d["func_descriptor"],
+            args=args,
+            kwargs=kwargs,
+            num_returns=d["num_returns"],
+            resources=d["resources"],
+            max_retries=d["max_retries"],
+            retry_exceptions=d["retry_exceptions"],
+        )
+        refs = self._worker.submit_task(spec)
+        for r in refs:
+            self._worker.reference_counter.add_borrower(
+                r.object_id(), h.worker_id)
+            h.borrows.add(r.object_id())
+        return [r.object_id().binary() for r in refs]
+
+    # ------------------------------------------------------------------
+    # cancellation
+    # ------------------------------------------------------------------
+    def cancel(self, task_id: TaskID, force: bool) -> bool:
+        # not yet leased to a worker: drop it from the pool queue and
+        # resolve its return refs with the cancellation error
+        with self._lock:
+            for item in self._queue:
+                if item[0].spec.task_id == task_id:
+                    self._queue.remove(item)
+                    queued = item[0]
+                    break
+            else:
+                queued = None
+        if queued is not None:
+            spec = queued.spec
+            err = rex.TaskCancelledError(task_id)
+            return_ids = (getattr(spec, "_retry_return_ids", None)
+                          or spec.return_ids())
+            self._worker._store_error(spec, return_ids, err)
+            self._finish_task(queued, task_id, None)
+            return True
+        with self._lock:
+            h = self._by_task.get(task_id)
+        if h is None:
+            return False
+        if force:
+            h.force_cancelled = True
+            try:
+                h.proc.kill()
+            except Exception:
+                pass
+        elif h.ctrl is not None:
+            try:
+                h.ctrl.send(("cancel", task_id.binary()))
+            except (OSError, ValueError):
+                pass
+        return True
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            handles = list(self._handles) + list(self._actor_handles)
+            self._queue.clear()
+            self._idle.clear()
+        for h in handles:
+            if h.conn is not None:
+                try:
+                    with h.send_lock:
+                        h.conn.send(("exit",))
+                except (OSError, ValueError):
+                    pass
+        for h in handles:
+            if h.proc is not None:
+                try:
+                    h.proc.wait(timeout=1.0)
+                except subprocess.TimeoutExpired:
+                    h.proc.kill()
+        for h in handles:
+            for c in (h.conn, h.ctrl):
+                if c is not None:
+                    try:
+                        c.close()
+                    except Exception:
+                        pass
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        try:
+            os.rmdir(self._sock_dir)
+        except OSError:
+            pass
+
+
+class _DepError(Exception):
+    def __init__(self, error: BaseException):
+        self.error = error

@@ -1,0 +1,207 @@
+"""Shared-memory object store — the plasma analog.
+
+Reference surface: ray src/ray/object_manager/plasma/ (PlasmaStore,
+ObjectLifecycleManager, PlasmaClient): a per-node shared-memory arena,
+objects written once through a create -> seal lifecycle, then read
+zero-copy by any process on the node via mmap.
+
+TPU-native differences: one mmap arena per node owned by the driver
+process (the node owner); allocation decisions are made owner-side only
+(workers request offsets over their pipe — the create/seal RPC), while
+reads and writes go straight through each process's own mapping of the
+arena, so object BYTES never cross a pipe. Deserialization wraps numpy
+buffers around the arena memory (zero-copy views, valid while the object
+is in scope — the same contract as plasma's read-only buffers).
+"""
+
+from __future__ import annotations
+
+import threading
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import ObjectStoreFullError
+from ray_tpu._private.serialization import SerializedObject
+
+_ALIGN = 64  # cache-line align allocations
+
+
+class ShmArena:
+    """A named shared-memory segment + first-fit free-list allocator.
+
+    The allocator lives ONLY in the owner process; attached clients
+    (worker processes) are handed (offset, size) pairs and use views.
+    """
+
+    def __init__(self, size: int, name: Optional[str] = None,
+                 create: bool = True):
+        self._shm = shared_memory.SharedMemory(
+            name=name, create=create, size=size if create else 0)
+        if not create:
+            # Python <=3.12 registers attached segments with the
+            # resource_tracker, which UNLINKS them when the attaching
+            # process exits — a worker exiting would destroy the node's
+            # arena under the driver. The owner is responsible for unlink.
+            try:
+                from multiprocessing import resource_tracker
+                resource_tracker.unregister(self._shm._name,  # noqa: SLF001
+                                            "shared_memory")
+            except Exception:
+                pass
+        self.name = self._shm.name
+        self.size = self._shm.size
+        self._owner = create
+        # free list: sorted list of (offset, size), coalesced on free
+        self._free: List[Tuple[int, int]] = [(0, self.size)] if create else []
+        self._lock = threading.Lock()
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmArena":
+        return cls(0, name=name, create=False)
+
+    # -- allocator (owner side only) ---------------------------------------
+    def allocate(self, nbytes: int) -> int:
+        nbytes = max(_ALIGN, (nbytes + _ALIGN - 1) & ~(_ALIGN - 1))
+        with self._lock:
+            for i, (off, sz) in enumerate(self._free):
+                if sz >= nbytes:
+                    if sz == nbytes:
+                        self._free.pop(i)
+                    else:
+                        self._free[i] = (off + nbytes, sz - nbytes)
+                    return off
+        raise ObjectStoreFullError(
+            f"shm arena full: requested {nbytes} bytes, "
+            f"{self.free_bytes()} free (fragmented across "
+            f"{len(self._free)} holes)")
+
+    def free(self, offset: int, nbytes: int) -> None:
+        nbytes = max(_ALIGN, (nbytes + _ALIGN - 1) & ~(_ALIGN - 1))
+        with self._lock:
+            # insert sorted + coalesce with neighbors
+            free = self._free
+            lo, hi = 0, len(free)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if free[mid][0] < offset:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            free.insert(lo, (offset, nbytes))
+            if lo + 1 < len(free):
+                o, s = free[lo]
+                o2, s2 = free[lo + 1]
+                if o + s == o2:
+                    free[lo] = (o, s + s2)
+                    free.pop(lo + 1)
+            if lo > 0:
+                o, s = free[lo - 1]
+                o2, s2 = free[lo]
+                if o + s == o2:
+                    free[lo - 1] = (o, s + s2)
+                    free.pop(lo)
+
+    def free_bytes(self) -> int:
+        return sum(s for _, s in self._free)
+
+    # -- data access (any process) -----------------------------------------
+    def view(self, offset: int, nbytes: int) -> memoryview:
+        return self._shm.buf[offset:offset + nbytes]
+
+    def close(self) -> None:
+        try:
+            self._shm.close()
+        except BufferError:
+            # exported zero-copy views still alive (user holds arrays);
+            # the mapping stays until they are collected
+            pass
+
+    def unlink(self) -> None:
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+
+class _Alloc:
+    __slots__ = ("offset", "nbytes", "sealed")
+
+    def __init__(self, offset: int, nbytes: int):
+        self.offset = offset
+        self.nbytes = nbytes
+        self.sealed = False
+
+
+class ShmObjectStore:
+    """Owner-side object table over a ShmArena: create/seal/locate/free.
+
+    Reference: plasma's ObjectLifecycleManager — an object is writable
+    between create and seal, immutable and readable after seal.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        self.arena = ShmArena(capacity_bytes)
+        self._table: Dict[ObjectID, _Alloc] = {}
+        self._lock = threading.Lock()
+
+    # -- create/seal lifecycle --------------------------------------------
+    def create(self, object_id: ObjectID, nbytes: int) -> int:
+        offset = self.arena.allocate(nbytes)
+        with self._lock:
+            if object_id in self._table:
+                self.arena.free(offset, nbytes)
+                raise ValueError(f"object {object_id.hex()} already created")
+            self._table[object_id] = _Alloc(offset, nbytes)
+        return offset
+
+    def seal(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._table[object_id].sealed = True
+
+    def locate(self, object_id: ObjectID) -> Optional[Tuple[int, int]]:
+        """(offset, nbytes) of a SEALED object, else None."""
+        with self._lock:
+            alloc = self._table.get(object_id)
+            if alloc is None or not alloc.sealed:
+                return None
+            return alloc.offset, alloc.nbytes
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self.locate(object_id) is not None
+
+    # -- owner-process direct IO ------------------------------------------
+    def put_serialized(self, object_id: ObjectID,
+                       sobj: SerializedObject) -> Tuple[int, int]:
+        """create + write + seal in the owner process (driver puts)."""
+        nbytes = sobj.framed_nbytes()
+        offset = self.create(object_id, nbytes)
+        sobj.write_into(self.arena.view(offset, nbytes))
+        self.seal(object_id)
+        return offset, nbytes
+
+    def get_serialized(self, object_id: ObjectID) -> Optional[SerializedObject]:
+        loc = self.locate(object_id)
+        if loc is None:
+            return None
+        offset, nbytes = loc
+        return SerializedObject.from_bytes(self.arena.view(offset, nbytes))
+
+    def free_object(self, object_id: ObjectID) -> None:
+        with self._lock:
+            alloc = self._table.pop(object_id, None)
+        if alloc is not None:
+            self.arena.free(alloc.offset, alloc.nbytes)
+
+    # -- stats / lifecycle -------------------------------------------------
+    def num_objects(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+    def used_bytes(self) -> int:
+        return self.arena.size - self.arena.free_bytes()
+
+    def shutdown(self) -> None:
+        self.arena.close()
+        self.arena.unlink()

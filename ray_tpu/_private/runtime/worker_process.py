@@ -1,0 +1,382 @@
+"""Worker process main loop — the core-worker analog for process mode.
+
+Reference surfaces: ray src/ray/core_worker/core_worker.cc (task receiver
++ execute loop in every worker process) and python/ray/_private/worker.py
+(the worker-side of execute_task). Each worker process:
+
+  - attaches the node's shm arena (zero-copy object data plane),
+  - receives task messages over its private pipe from the node owner
+    (the driver), executes, and ships results back (inline if small,
+    via create/seal into the arena if large),
+  - installs a lightweight worker context so `ray_tpu.get/put/remote`
+    called INSIDE tasks route through owner RPC over the same pipe,
+  - runs a control thread for cooperative cancellation.
+
+Protocol invariant that makes the single pipe safe: the owner sends at
+most one task to a worker at a time, and while that task runs the only
+owner->worker traffic on the task pipe is RPC replies — so the executing
+thread can issue a blocking send/recv RPC without racing the main loop.
+Cancellation travels on a separate control pipe.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
+from ray_tpu._private.runtime.shm_store import ShmArena
+from ray_tpu._private.serialization import SerializedObject, deserialize, serialize
+
+INLINE_MAX_DEFAULT = 100 * 1024
+
+
+class _ShmValue:
+    """Placeholder for a resolved arg whose bytes live in the arena."""
+
+    __slots__ = ("offset", "nbytes")
+
+    def __init__(self, offset: int, nbytes: int):
+        self.offset = offset
+        self.nbytes = nbytes
+
+
+def fn_id_of(blob: bytes) -> bytes:
+    return hashlib.sha1(blob).digest()
+
+
+class ProcessWorkerContext:
+    """Installed as ray_tpu._private.worker.global_worker inside the worker
+    process, so user code in tasks can call the public API. Routes
+    get/put/submit to the owner over the pipe RPC."""
+
+    def __init__(self, runner: "_WorkerRunner"):
+        self._runner = runner
+        self.alive = True
+        self.worker_id = WorkerID.from_random()
+        self.job_id = None  # set per task from the spec's task id
+
+    # -- context -----------------------------------------------------------
+    @property
+    def current_task_id(self) -> Optional[TaskID]:
+        return self._runner.current_task_id
+
+    def next_put_id(self) -> ObjectID:
+        self._runner.put_counter += 1
+        return ObjectID.for_put(self._runner.current_task_id,
+                                self._runner.put_counter)
+
+    def was_current_task_cancelled(self) -> bool:
+        tid = self._runner.current_task_id
+        return tid is not None and tid.binary() in self._runner.cancelled
+
+    # -- object plane ------------------------------------------------------
+    def put(self, value: Any):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed")
+        oid = self.next_put_id()
+        loc = self._runner.store_value(oid, value)
+        self._runner.rpc("put", (oid.binary(), loc))
+        return ObjectRef(oid, None)
+
+    def get(self, refs, timeout: Optional[float]) -> List[Any]:
+        from ray_tpu import exceptions as rex
+
+        oid_bins = [r.object_id().binary() for r in refs]
+        locs = self._runner.rpc("get", (oid_bins, timeout))
+        out = []
+        for loc in locs:
+            kind = loc[0]
+            if kind == "timeout":
+                raise rex.GetTimeoutError(loc[1])
+            if kind == "exc":
+                exc = cloudpickle.loads(loc[1])
+                if isinstance(exc, rex.TaskError):
+                    raise exc.as_instanceof_cause()
+                raise exc
+            out.append(self._runner.load_location(loc))
+        return out
+
+    def wait(self, refs, num_returns: int, timeout: Optional[float]):
+        oid_bins = [r.object_id().binary() for r in refs]
+        ready_bins = set(self._runner.rpc(
+            "wait", (oid_bins, num_returns, timeout)))
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.object_id().binary() in ready_bins
+             and len(ready) < num_returns else not_ready).append(r)
+        return ready, not_ready
+
+    # -- task plane --------------------------------------------------------
+    def submit_task(self, spec) -> list:
+        from ray_tpu._private.object_ref import ObjectRef
+
+        blob = _dump_spec(spec)
+        return_bins = self._runner.rpc("submit", (blob,))
+        return [ObjectRef(ObjectID(b), None) for b in return_bins]
+
+    def next_task_id(self) -> TaskID:
+        # ids for nested submissions are assigned by the owner; this is a
+        # provisional id replaced at owner admission
+        return TaskID.of(self._runner.current_task_id.job_id())
+
+    # -- no-op surfaces (single-owner model: the driver owns refcounts) ----
+    class _NoopRC:
+        def add_local_reference(self, oid):  # borrows tracked owner-side
+            pass
+
+        def remove_local_reference(self, oid):
+            pass
+
+    reference_counter = _NoopRC()
+
+    def defer_unref(self, oid) -> None:
+        pass
+
+    def run_callback_when_ready(self, oid, cb) -> None:
+        raise NotImplementedError(
+            "futures/await on refs are driver-side APIs")
+
+
+def _dump_spec(spec) -> bytes:
+    """Ship a TaskSpec for owner-side admission (func by value)."""
+    d = dict(
+        name=spec.name,
+        func_blob=cloudpickle.dumps(spec.func),
+        func_descriptor=spec.func_descriptor,
+        args_blob=cloudpickle.dumps((spec.args, spec.kwargs)),
+        num_returns=spec.num_returns,
+        resources=spec.resources,
+        max_retries=spec.max_retries,
+        retry_exceptions=spec.retry_exceptions,
+    )
+    return cloudpickle.dumps(d)
+
+
+class _WorkerRunner:
+    def __init__(self, conn, ctrl_conn, arena_name: str, inline_max: int):
+        self.conn = conn
+        self.ctrl_conn = ctrl_conn
+        self.arena = ShmArena.attach(arena_name) if arena_name else None
+        self.inline_max = inline_max
+        self.fn_cache: Dict[bytes, Any] = {}
+        self.actor_instance: Any = None  # set by actor_create (dedicated)
+        self.current_task_id: Optional[TaskID] = None
+        self.put_counter = 0
+        self.cancelled: set = set()  # task_id binaries
+        self._rpc_seq = 0
+        self._rpc_lock = threading.RLock()
+        self._stop = False
+
+    # -- RPC to the owner --------------------------------------------------
+    def rpc(self, op: str, args: tuple):
+        with self._rpc_lock:
+            self._rpc_seq += 1
+            req_id = self._rpc_seq
+            self.conn.send(("rpc", req_id, op, args))
+            while True:
+                msg = self.conn.recv()
+                if msg[0] == "reply" and msg[1] == req_id:
+                    ok, data = msg[2], msg[3]
+                    if not ok:
+                        raise cloudpickle.loads(data)
+                    return data
+                # protocol violation — only replies may arrive mid-task
+                raise RuntimeError(f"unexpected message during rpc: {msg[0]}")
+
+    # -- value movement ----------------------------------------------------
+    def store_value(self, oid: ObjectID, value: Any) -> tuple:
+        """Serialize; small -> inline tuple, large -> create/seal in arena."""
+        sobj = serialize(value)
+        nbytes = sobj.framed_nbytes()
+        if self.arena is None or nbytes <= self.inline_max:
+            return ("inline", sobj.to_bytes())
+        try:
+            offset = self.rpc("create", (oid.binary(), nbytes))
+        except Exception:
+            # arena full/fragmented: ship inline rather than fail the task
+            return ("inline", sobj.to_bytes())
+        sobj.write_into(self.arena.view(offset, nbytes))
+        return ("shm", offset, nbytes)
+
+    def load_location(self, loc: tuple) -> Any:
+        if loc[0] == "inline":
+            return deserialize(SerializedObject.from_bytes(loc[1]))
+        if loc[0] == "shm":
+            _, offset, nbytes = loc
+            view = self.arena.view(offset, nbytes)
+            return deserialize(SerializedObject.from_bytes(view))
+        raise ValueError(f"bad location {loc[0]!r}")
+
+    # -- control thread ----------------------------------------------------
+    def _ctrl_loop(self):
+        while not self._stop:
+            try:
+                msg = self.ctrl_conn.recv()
+            except (EOFError, OSError):
+                return
+            if msg[0] == "cancel":
+                self.cancelled.add(msg[1])
+
+    # -- task / actor execution --------------------------------------------
+    def execute(self, payload: dict) -> None:
+        from ray_tpu import exceptions as rex
+
+        def run(args, kwargs):
+            fn_id = payload["fn_id"]
+            fn = self.fn_cache.get(fn_id)
+            if fn is None:
+                fn = cloudpickle.loads(payload["fn_blob"])
+                self.fn_cache[fn_id] = fn
+            return fn(*args, **kwargs)
+
+        self._run_payload(payload, run)
+
+    def actor_create(self, payload: dict) -> None:
+        def run(args, kwargs):
+            cls = cloudpickle.loads(payload["cls_blob"])
+            self.actor_instance = cls(*args, **kwargs)
+            return "ALIVE"
+
+        self._run_payload(payload, run)
+
+    def actor_call(self, payload: dict) -> None:
+        def run(args, kwargs):
+            import inspect
+            method = getattr(self.actor_instance, payload["method"])
+            result = method(*args, **kwargs)
+            if inspect.isgenerator(result):
+                result = list(result)
+            return result
+
+        self._run_payload(payload, run)
+
+    def _run_payload(self, payload: dict, run) -> None:
+        from ray_tpu import exceptions as rex
+
+        task_id = TaskID(payload["task_id"])
+        self.current_task_id = task_id
+        self.put_counter = 0
+        try:
+            args, kwargs = cloudpickle.loads(payload["args_blob"])
+            args = tuple(self._resolve(a) for a in args)
+            kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
+            inject = payload.get("inject_prob", 0.0)
+            if inject > 0.0:
+                import random
+                if random.random() < inject:
+                    raise rex.WorkerCrashedError("injected failure (chaos)")
+            if task_id.binary() in self.cancelled:
+                raise rex.TaskCancelledError(task_id)
+            result = run(args, kwargs)
+            num_returns = payload["num_returns"]
+            if num_returns == 1:
+                values = [result]
+            else:
+                values = list(result) if result is not None else []
+                if len(values) != num_returns:
+                    raise ValueError(
+                        f"task {payload['name']} declared "
+                        f"num_returns={num_returns} but returned "
+                        f"{len(values)} values")
+            return_ids = [ObjectID(b) for b in payload["return_ids"]]
+            entries = [self.store_value(oid, v)
+                       for oid, v in zip(return_ids, values)]
+            self.conn.send(("done", payload["task_id"], entries))
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            try:
+                blob = cloudpickle.dumps(e)
+            except Exception:
+                blob = cloudpickle.dumps(
+                    RuntimeError(f"[unpicklable {type(e).__name__}] {e}"))
+            self.conn.send(("err", payload["task_id"], blob, tb))
+        finally:
+            self.cancelled.discard(task_id.binary())
+            self.current_task_id = None
+
+    def _resolve(self, v: Any) -> Any:
+        if isinstance(v, _ShmValue):
+            view = self.arena.view(v.offset, v.nbytes)
+            return deserialize(SerializedObject.from_bytes(view))
+        return v
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> None:
+        threading.Thread(target=self._ctrl_loop, daemon=True,
+                         name="ray_tpu_worker_ctrl").start()
+        self.conn.send(("ready", os.getpid()))
+        while not self._stop:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                return
+            kind = msg[0]
+            if kind == "task":
+                self.execute(msg[1])
+            elif kind == "actor_create":
+                self.actor_create(msg[1])
+            elif kind == "actor_call":
+                self.actor_call(msg[1])
+            elif kind == "exit":
+                self._stop = True
+            else:
+                raise RuntimeError(f"unexpected message {kind!r} in idle loop")
+
+
+def worker_main(conn, ctrl_conn, arena_name: str, inline_max: int) -> None:
+    """Worker entry once both pipes are connected."""
+    runner = _WorkerRunner(conn, ctrl_conn, arena_name, inline_max)
+    # install the API shim so user code inside tasks can call ray_tpu.*
+    from ray_tpu._private import worker as worker_mod
+
+    worker_mod.global_worker = ProcessWorkerContext(runner)  # type: ignore
+    try:
+        runner.run()
+    finally:
+        if runner.arena is not None:
+            runner.arena.close()
+
+
+def _main(argv: List[str]) -> None:
+    """``python -m ray_tpu._private.runtime.worker_process <address>
+    <arena_name> <inline_max> <worker_num>``
+
+    Exec'd as a fresh interpreter by the pool (reference: the raylet
+    execs python -m ray._private.workers.default_worker) — NOT forked or
+    multiprocessing-spawned, so the parent's __main__ is never re-run and
+    fork-unsafe parent state (jax/TPU clients, threads) is never
+    inherited. Connects back over AF_UNIX with an HMAC authkey handshake.
+    """
+    from multiprocessing.connection import Client
+
+    address, arena_name, inline_max, worker_num = (
+        argv[0], argv[1], int(argv[2]), int(argv[3]))
+    authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+    try:
+        conn = Client(address, authkey=authkey)
+        conn.send(("hello", worker_num, "task"))
+        ctrl = Client(address, authkey=authkey)
+        ctrl.send(("hello", worker_num, "ctrl"))
+    except (FileNotFoundError, ConnectionError, OSError):
+        return  # pool already shut down while we were starting
+    worker_main(conn, ctrl, arena_name, inline_max)
+
+
+if __name__ == "__main__":
+    import sys
+
+    # re-enter through the canonical import so every class in this module
+    # has ONE identity: under `python -m` this file runs as `__main__`,
+    # and unpickled _ShmValue instances (imported canonically) would fail
+    # isinstance checks against __main__'s copies
+    from ray_tpu._private.runtime import worker_process as _canonical
+
+    _canonical._main(sys.argv[1:])

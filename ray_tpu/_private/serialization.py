@@ -42,6 +42,29 @@ class SerializedObject:
             out.write(b)
         return out.getvalue()
 
+    def framed_nbytes(self) -> int:
+        """Size of the to_bytes() framing without materializing it."""
+        return 8 + len(self.meta) + 4 + sum(8 + len(b) for b in self.buffers)
+
+    def write_into(self, view: memoryview) -> int:
+        """Write the framed form straight into a caller-provided buffer
+        (the shm arena) — single copy, no intermediate blob."""
+        off = 0
+
+        def put(b: bytes | memoryview):
+            nonlocal off
+            n = len(b)
+            view[off:off + n] = b
+            off += n
+
+        put(len(self.meta).to_bytes(8, "little"))
+        put(self.meta)
+        put(len(self.buffers).to_bytes(4, "little"))
+        for b in self.buffers:
+            put(len(b).to_bytes(8, "little"))
+            put(b)
+        return off
+
     @classmethod
     def from_bytes(cls, blob: memoryview | bytes) -> "SerializedObject":
         view = memoryview(blob)

@@ -140,6 +140,18 @@ class Worker:
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=nworkers, thread_name_prefix="ray_tpu_worker")
 
+        # P3 multi-process node runtime: process workers + shm object store
+        # (reference: raylet WorkerPool + plasma). Thread mode keeps the
+        # original single-process semantics as the conformance oracle.
+        self.shm_store = None
+        self.process_pool = None
+        if GLOBAL_CONFIG.worker_mode == "process":
+            from ray_tpu._private.runtime.process_pool import ProcessWorkerPool
+            from ray_tpu._private.runtime.shm_store import ShmObjectStore
+            self.shm_store = ShmObjectStore(GLOBAL_CONFIG.object_store_memory)
+            self.process_pool = ProcessWorkerPool(self, nworkers,
+                                                  self.shm_store)
+
         # node 0 = "this node"; virtual cluster tests add more
         node = NodeState((capacity_cpu, _detect_tpu_count(), 1e18, 1e18))
         contains = self.memory_store.contains
@@ -191,8 +203,42 @@ class Worker:
                 "passed around directly (reference semantics).")
         object_id = self.next_put_id()
         self.reference_counter.add_owned_object(object_id)
+        if self.shm_store is not None and _likely_large(value):
+            # large puts go straight to the shm arena (plasma path) so
+            # worker processes read them zero-copy; the driver resolves
+            # the placeholder lazily on first get
+            from ray_tpu._private.object_store import ObjectStoreFullError
+            from ray_tpu._private.runtime.process_pool import _PLACEHOLDER
+            from ray_tpu._private.serialization import serialize
+            sobj = serialize(value)
+            if sobj.framed_nbytes() > GLOBAL_CONFIG.inline_object_max_bytes:
+                try:
+                    self.shm_store.put_serialized(object_id, sobj)
+                    self.memory_store.put(object_id, _PLACEHOLDER)
+                    return ObjectRef(object_id, self.worker_id)
+                except ObjectStoreFullError:
+                    # fall back to the host memory store (workers will
+                    # receive the bytes inline) rather than failing a put
+                    # that thread mode would have absorbed
+                    logger.warning(
+                        "shm arena full; storing %d-byte object in the "
+                        "host memory store", sobj.framed_nbytes())
         self.memory_store.put(object_id, value)
         return ObjectRef(object_id, self.worker_id)
+
+    def _entry_value(self, object_id: ObjectID, entry) -> Any:
+        """Resolve a memory-store entry, deserializing shm-resident bytes
+        zero-copy on first access (plasma client get analog)."""
+        from ray_tpu._private.runtime.process_pool import ShmPlaceholder
+        value = entry.value
+        if isinstance(value, ShmPlaceholder):
+            from ray_tpu._private.serialization import deserialize
+            sobj = self.shm_store.get_serialized(object_id)
+            if sobj is None:
+                raise rex.ObjectLostError(object_id.hex())
+            value = deserialize(sobj)
+            entry.value = value  # memoize the zero-copy view object
+        return value
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         ids = [r.object_id() for r in refs]
@@ -201,13 +247,14 @@ class Worker:
         except TimeoutError as e:
             raise rex.GetTimeoutError(str(e)) from None
         out = []
-        for entry in entries:
+        for oid, entry in zip(ids, entries):
             if entry.is_exception:
                 exc = entry.value
                 if isinstance(exc, rex.TaskError):
                     raise exc.as_instanceof_cause()
                 raise exc
-            out.append(entry.value)
+            out.append(self._entry_value(oid, entry)
+                       if self.shm_store is not None else entry.value)
         return out
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
@@ -256,6 +303,9 @@ class Worker:
                 self.scheduler.notify_object_ready(oid)
             self.task_manager.complete(task_id)
             return
+        if self.process_pool is not None \
+                and self.process_pool.cancel(task_id, force):
+            return  # running in a worker process: flagged or killed there
         with self._running_lock:
             ev = self._running_tasks.get(task_id)
         if ev is not None:
@@ -278,6 +328,11 @@ class Worker:
         boot = getattr(pending.spec, "_actor_boot", None)
         if boot is not None:
             self._pool.submit(self._boot_actor, pending, boot)
+        elif (self.process_pool is not None
+              and pending.spec.task_type == TaskType.NORMAL_TASK):
+            # lease grant: the decision becomes a payload shipped to a
+            # worker process (payload build runs off the tick thread)
+            self._pool.submit(self.process_pool.run_task, pending)
         else:
             self._pool.submit(self._execute_task, pending)
 
@@ -350,7 +405,8 @@ class Worker:
                 if entry.is_exception:
                     dep_error = entry.value
                     return None
-                return entry.value
+                return (self._entry_value(v.object_id(), entry)
+                        if self.shm_store is not None else entry.value)
             return v
 
         args = tuple(resolve(a) for a in spec.args)
@@ -434,6 +490,8 @@ class Worker:
 
     def _on_object_out_of_scope(self, object_id: ObjectID) -> None:
         self.memory_store.delete([object_id])
+        if self.shm_store is not None:
+            self.shm_store.free_object(object_id)
         self.task_manager.evict_lineage(object_id.task_id())
 
     def shutdown(self) -> None:
@@ -446,7 +504,11 @@ class Worker:
             except Exception:
                 pass
         self.scheduler.shutdown()
+        if self.process_pool is not None:
+            self.process_pool.shutdown()
         self._pool.shutdown(wait=False, cancel_futures=True)
+        if self.shm_store is not None:
+            self.shm_store.shutdown()
 
 
 def _top_level_deps(args, kwargs) -> List[ObjectID]:
@@ -454,6 +516,24 @@ def _top_level_deps(args, kwargs) -> List[ObjectID]:
     deps.extend(v.object_id() for v in kwargs.values()
                 if isinstance(v, ObjectRef))
     return deps
+
+
+def _likely_large(value: Any) -> bool:
+    """Cheap size probe deciding whether a put should try the shm path
+    (avoids serializing every small put twice). Arrays/bytes report real
+    sizes; other objects are assumed small and stay in the memory store."""
+    import numpy as _np
+    if isinstance(value, _np.ndarray):
+        return value.nbytes > GLOBAL_CONFIG.inline_object_max_bytes
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value) > GLOBAL_CONFIG.inline_object_max_bytes
+    try:
+        import jax
+        if isinstance(value, jax.Array):
+            return value.nbytes > GLOBAL_CONFIG.inline_object_max_bytes
+    except Exception:
+        pass
+    return False
 
 
 def _detect_tpu_count() -> float:
@@ -515,6 +595,9 @@ def shutdown() -> None:
             global_worker.shutdown()
             global_worker = None
         GLOBAL_CONFIG.unfreeze()
+        # _system_config is scoped to one init/shutdown cycle; a leaked
+        # worker_mode=process would silently re-route the next runtime
+        GLOBAL_CONFIG.reset()
 
 
 def is_initialized() -> bool:
