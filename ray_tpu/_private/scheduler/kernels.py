@@ -132,6 +132,144 @@ def fire_edges_np(done_mask: np.ndarray, src: np.ndarray, dst: np.ndarray,
     return indeg, consumed
 
 
+def pack_bundles_np(demands: np.ndarray, avail: np.ndarray, cap: np.ndarray,
+                    strategy: str) -> Optional[np.ndarray]:
+    """Bin-pack one placement group's bundles onto nodes.
+
+    The decision core of the reference's GcsPlacementGroupScheduler
+    (ray: src/ray/gcs/gcs_server/gcs_placement_group_scheduler.cc) as a
+    vectorized solve: demands [B,R], avail/cap [N,R]. Returns node index
+    per bundle, or None if no placement exists under ``avail``.
+
+    Strategies (reference: python/ray/util/placement_group.py):
+      PACK         prefer one node, spill when full
+      SPREAD       prefer distinct nodes, reuse when fewer nodes
+      STRICT_PACK  all bundles on ONE node or fail
+      STRICT_SPREAD all bundles on DISTINCT nodes or fail
+    """
+    B, R = demands.shape
+    N = avail.shape[0]
+    alive = cap.any(axis=1)
+    rem = avail.copy()
+    out = np.full(B, -1, dtype=np.int32)
+    # least-loaded-first node order (deterministic tiebreak by index)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        load = np.where(cap > 0, (cap - avail) / np.maximum(cap, 1e-9),
+                        0.0).max(axis=1)
+    order = np.argsort(load, kind="stable")
+
+    if strategy == "STRICT_PACK":
+        total = demands.sum(axis=0)
+        for n in order:
+            if alive[n] and (rem[n] >= total).all():
+                out[:] = n
+                return out
+        return None
+
+    # big bundles first: greedy first-fit-decreasing
+    bundle_order = np.argsort(-demands.sum(axis=1), kind="stable")
+    if strategy == "STRICT_SPREAD":
+        used = np.zeros(N, dtype=bool)
+        for b in bundle_order:
+            placed = False
+            for n in order:
+                if alive[n] and not used[n] and (rem[n] >= demands[b]).all():
+                    out[b] = n
+                    rem[n] -= demands[b]
+                    used[n] = True
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return out
+
+    if strategy == "SPREAD":
+        used = np.zeros(N, dtype=bool)
+        for b in bundle_order:
+            placed = False
+            for prefer_fresh in (True, False):
+                for n in order:
+                    if not alive[n] or (used[n] and prefer_fresh):
+                        continue
+                    if (rem[n] >= demands[b]).all():
+                        out[b] = n
+                        rem[n] -= demands[b]
+                        used[n] = True
+                        placed = True
+                        break
+                if placed:
+                    break
+            if not placed:
+                return None
+        return out
+
+    # PACK (default): fill the least-loaded node, spill in node order
+    for b in bundle_order:
+        placed = False
+        for n in order:
+            if alive[n] and (rem[n] >= demands[b]).all():
+                out[b] = n
+                rem[n] -= demands[b]
+                placed = True
+                break
+        if not placed:
+            return None
+    return out
+
+
+def jax_pack_many(demands, avail, cap, *, strict_spread: bool):
+    """Batched PG bin-pack on device: G placement groups of B bundles
+    each ([G,B,R]) packed against ONE shared node state [N,R] — the
+    north star's \"GCS placement-group packing ... batched bin-packing
+    solve co-resident on the same chip\". Sequential consumption over
+    (group, bundle) via nested scans; returns (node_of [G,B], ok [G],
+    final avail). First-fit over least-index nodes (deterministic).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    G, B, R = demands.shape
+    N = avail.shape[0]
+
+    @jax.jit
+    def pack(demands, avail, cap):
+        alive = (cap > 0).any(axis=1)
+
+        def per_group(carry, g):
+            avail = carry
+
+            def per_bundle(bcarry, b):
+                rem, used, node_of, ok = bcarry
+                d = demands[g, b]
+                fits = (rem >= d[None, :]).all(axis=1) & alive
+                if strict_spread:
+                    fits = fits & ~used
+                n = jnp.argmax(fits)  # first fitting node
+                found = fits.any()
+                rem = jnp.where(found,
+                                rem.at[n].add(-d), rem)
+                used = used.at[n].set(used[n] | found)
+                node_of = node_of.at[b].set(jnp.where(found, n, -1))
+                return (rem, used, node_of, ok & found), None
+
+            (rem, _used, node_of, ok), _ = lax.scan(
+                per_bundle,
+                (avail, jnp.zeros(N, bool),
+                 jnp.full(B, -1, jnp.int32), jnp.bool_(True)),
+                jnp.arange(B))
+            # 2-phase: commit the group's reservation only if every
+            # bundle found a node (prepare-all-or-rollback)
+            avail = jnp.where(ok, rem, avail)
+            node_of = jnp.where(ok, node_of, jnp.full(B, -1, jnp.int32))
+            return avail, (node_of, ok)
+
+        avail, (node_of, ok) = lax.scan(per_group, avail, jnp.arange(G))
+        return node_of, ok, avail
+
+    return pack(demands, avail, cap)
+
+
 # ======================================================================
 # jax backend
 # ======================================================================
