@@ -30,6 +30,7 @@ from __future__ import annotations
 import collections
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -88,6 +89,13 @@ class TensorScheduler(SchedulerBase):
         self._num_dispatched = 0
         self._num_finished = 0
         self._num_ticks = 0
+        # auto-backend calibration: the jitted device path only wins when
+        # the device round trip is cheap (it is NOT under a tunneled chip,
+        # where one dispatch costs ~50 ms). "cold" -> background warmup on
+        # first large batch -> timed head-to-head -> "jax" | "numpy".
+        self._calib_state = "cold"   # cold | warming | jax | numpy
+        self._np_cost = 0.0          # EWMA of assign_np wall time (s)
+        self._jax_cost = float("inf")
         self._dirty = False  # schedulability changed without a queued event
         self._shutdown = False
         self._tick_thread = threading.Thread(
@@ -310,9 +318,12 @@ class TensorScheduler(SchedulerBase):
         backend = GLOBAL_CONFIG.sched_backend
         # class count no longer gates the device path: the kernel scans the
         # class axis (class as data), so many classes don't grow the program
+        big = len(ready_idx) >= GLOBAL_CONFIG.sched_jax_min_batch
+        if backend == "auto" and big and self._calib_state == "cold":
+            self._start_calibration(snapshot)
         use_jax = (backend == "jax"
-                   or (backend == "auto"
-                       and len(ready_idx) >= GLOBAL_CONFIG.sched_jax_min_batch))
+                   or (backend == "auto" and big
+                       and self._calib_state == "jax"))
         threshold = GLOBAL_CONFIG.sched_hybrid_threshold
         if use_jax:
             try:
@@ -328,21 +339,61 @@ class TensorScheduler(SchedulerBase):
                 logger.exception("jax assign failed; falling back to numpy")
                 use_jax = False
         if not use_jax:
+            t0 = time.perf_counter()
             cls_full = np.zeros(int(ready_idx.max()) + 1, dtype=np.int32)
             cls_full[ready_idx] = ready_cls
             node_of_ready, new_avail = kernels.assign_np(
                 ready_idx, cls_full, demands, avail, cap, threshold)
+            dt = time.perf_counter() - t0
+            self._np_cost = 0.8 * self._np_cost + 0.2 * dt if self._np_cost else dt
         return ready_idx, node_of_ready, new_avail
+
+    def _start_calibration(self, snapshot) -> None:
+        """Warm + time the jitted device path off-thread; switch ``auto``
+        to it only if a real tick beats the measured numpy tick. Under a
+        remote/tunneled accelerator (e.g. an axon-proxied chip) a device
+        dispatch costs tens of ms and numpy always wins; on a local chip
+        with large ready batches the device kernel wins. Never stalls the
+        tick loop: numpy serves until the verdict is in."""
+        self._calib_state = "warming"
+        ready_idx, ready_cls, demands, avail, cap = snapshot
+        threshold = GLOBAL_CONFIG.sched_hybrid_threshold
+
+        def _calibrate() -> None:
+            verdict = "numpy"
+            try:
+                uniq, inv = np.unique(ready_cls, return_inverse=True)
+                args = (inv.astype(np.int32), demands[uniq], avail, cap,
+                        threshold)
+                kernels.jax_assign(*args)          # compile + warm
+                t0 = time.perf_counter()
+                kernels.jax_assign(*args)          # steady-state cost
+                self._jax_cost = time.perf_counter() - t0
+                # require a decisive win: the numpy EWMA is noisy (early
+                # ticks include warmup) and the device path's dispatch
+                # overhead recurs every tick, so a marginal victory in
+                # one sample is not worth switching for
+                if self._jax_cost < 0.5 * max(self._np_cost, 1e-6):
+                    verdict = "jax"
+            except Exception:
+                logger.exception("jax tick calibration failed; numpy ticks")
+            logger.info("sched auto backend: %s (jax %.3g s vs numpy %.3g s"
+                        " per tick)", verdict, self._jax_cost, self._np_cost)
+            self._calib_state = verdict
+
+        threading.Thread(target=_calibrate, daemon=True,
+                         name="ray_tpu_sched_calib").start()
 
     def _apply_locked(self, ready_idx, node_of_ready) -> List[PendingTask]:
         """Validate + apply out-of-lock decisions: a slot may have been
         cancelled and a node drained/removed since the snapshot."""
         out: List[PendingTask] = []
-        for pos, slot in enumerate(ready_idx):
+        # iterate ASSIGNED positions only: the unassigned tail can be the
+        # whole backlog (tens of thousands), and a Python loop over it per
+        # tick turns the apply step quadratic in the backlog size
+        for pos in np.flatnonzero(np.asarray(node_of_ready) >= 0):
             node = int(node_of_ready[pos])
-            if node < 0:
-                continue
-            slot = int(slot)
+            slot = int(ready_idx[pos])
             if self._state[slot] != WAITING:
                 continue  # cancelled (and maybe reused) since snapshot
             demand = self._demands[self._cls[slot]]
