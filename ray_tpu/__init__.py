@@ -99,10 +99,14 @@ def method(num_returns: int = 1):
 
 
 def available_resources() -> dict:
+    """Cluster-wide free resources over PHYSICAL nodes (placement-group
+    bundle rows are reservations, not new capacity)."""
     stats = _worker.get_worker().scheduler.stats()
     out: dict = {}
     from ray_tpu._private.task_spec import RESOURCE_NAMES
     for node in stats.get("nodes", []):
+        if node.get("is_bundle"):
+            continue
         for name, avail in zip(RESOURCE_NAMES, node["available"]):
             out[name] = out.get(name, 0.0) + avail
     return out
@@ -113,6 +117,8 @@ def cluster_resources() -> dict:
     out: dict = {}
     from ray_tpu._private.task_spec import RESOURCE_NAMES
     for node in stats.get("nodes", []):
+        if node.get("is_bundle"):
+            continue
         for name, cap in zip(RESOURCE_NAMES, node["capacity"]):
             out[name] = out.get(name, 0.0) + cap
     return out
@@ -125,6 +131,7 @@ def nodes() -> List[dict]:
          "Resources": dict(zip(("CPU", "TPU", "memory", "custom"),
                                n["capacity"]))}
         for i, n in enumerate(stats.get("nodes", []))
+        if not n.get("is_bundle")
     ]
 
 

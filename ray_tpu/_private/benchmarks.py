@@ -159,7 +159,15 @@ def build_actor_heavy(num_actors: int = 1000, calls: int = 1000,
 def build_ppo(num_rollout: int = 8000, num_learn: int = 80,
               rounds: int = 10, num_nodes: int = 16) -> BenchGraph:
     """Config 5: PPO-style rounds — a wave of CPU rollout tasks feeding a
-    wave of TPU learner tasks, repeated; heterogeneous demand classes."""
+    wave of TPU learner tasks, repeated; heterogeneous demand classes.
+
+    The learner group is placement-grouped like the reference's RLlib
+    LearnerGroup (ray: rllib/core/learner/ — PG of one TPU bundle per
+    learner, PACK): the bundle bin-pack solve (pack_bundles_np — the
+    GcsPlacementGroupScheduler analog) reserves learner slots at build
+    time, and every learner task is PINNED to its bundle's node — the
+    per-call fast path for placement-grouped work, with resources held
+    by the reservation rather than re-acquired per task."""
     per_round = num_rollout + num_learn
     c = per_round * rounds
     cls = np.zeros(c, dtype=np.int32)
@@ -187,14 +195,31 @@ def build_ppo(num_rollout: int = 8000, num_learn: int = 80,
     dst = np.concatenate(dsts).astype(np.int32)
     cap = _nodes(num_nodes, float(-(-num_rollout // num_nodes)),
                  tpu=float(-(-num_learn // num_nodes)))
+
+    # placement-group the learners: PACK one 1-TPU bundle per learner,
+    # pin learner task j (every round) to its bundle's node. Bundle
+    # resources are held by the reservation, so the learner class demand
+    # is zero per-call (kernel pin-path convention, kernels.py).
+    from ray_tpu._private.scheduler.kernels import pack_bundles_np
+
+    bundle_demands = np.tile(np.asarray([[0, 1, 0, 0]], np.float32),
+                             (num_learn, 1))
+    sol = pack_bundles_np(bundle_demands, cap.copy(), cap, "PACK")
+    if sol is None:
+        raise RuntimeError("ppo bench: learner placement group cannot fit")
+    pin = np.full(c, -1, dtype=np.int32)
+    for r in range(rounds):
+        learn0 = r * per_round + num_rollout
+        pin[learn0:learn0 + num_learn] = sol
     return BenchGraph(
         name=f"ppo_{rounds}r",
         indeg=indeg,
         cls=cls,
-        demands=np.asarray([[1, 0, 0, 0], [0, 1, 0, 0]], dtype=np.float32),
+        demands=np.asarray([[1, 0, 0, 0], [0, 0, 0, 0]], dtype=np.float32),
         src=src, dst=dst,
         cap=cap,
         max_ticks=2 * rounds + 4,
+        pin=pin,
     )
 
 

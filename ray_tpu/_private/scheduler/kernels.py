@@ -50,8 +50,17 @@ FREE, WAITING, RUNNING, DONE = 0, 1, 3, 4
 
 def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
               avail: np.ndarray, cap: np.ndarray,
-              threshold: float) -> Tuple[np.ndarray, np.ndarray]:
+              threshold: float,
+              class_mask: Optional[np.ndarray] = None,
+              class_spread: Optional[np.ndarray] = None
+              ) -> Tuple[np.ndarray, np.ndarray]:
     """Assign ready tasks (by arena index) to nodes.
+
+    class_mask [K,N] bool restricts each scheduling class to a node
+    subset (placement groups pin classes to their reserved bundle rows;
+    normal classes exclude bundle rows; node-affinity pins to one row).
+    class_spread [K] bool disables the hybrid local-node bias for
+    SPREAD-strategy classes. None = no restriction / no spread.
 
     Returns (node_of_ready [len(ready_idx)] int32 with -1 for
     not-assigned-this-tick, updated avail). Mutates nothing.
@@ -69,6 +78,8 @@ def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
     for c in np.unique(ready_cls):
         members = np.flatnonzero(ready_cls == c)  # positions in ready_idx
         d = demands[c]
+        elig = alive if class_mask is None else (alive & class_mask[c])
+        spread = bool(class_spread[c]) if class_spread is not None else False
         active = d > 0
         if active.any():
             with np.errstate(divide="ignore", invalid="ignore"):
@@ -84,31 +95,46 @@ def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
             fit = np.minimum(fit, len(members)).astype(np.int64)
         else:
             fit = np.full(n_nodes, len(members), dtype=np.int64)
-        fit = np.where(alive, fit, 0)
+        fit = np.where(elig, fit, 0)
 
         # hybrid policy: node 0 takes tasks while its load stays under the
         # threshold, then every node least-loaded-first up to its fit count.
         used = cap - avail
         with np.errstate(divide="ignore", invalid="ignore"):
             load = np.where(cap > 0, used / np.maximum(cap, 1e-9), 0.0).max(axis=1)
-        if active.any() and fit[0] > 0 and load[0] < threshold:
+        if spread:
+            t0 = 0
+        elif active.any() and fit[0] > 0 and load[0] < threshold:
             room = np.floor((threshold * cap[0, active] - used[0, active])
                             / d[active]).min()
             t0 = int(np.clip(room, 0, fit[0]))
         elif not active.any():
-            t0 = len(members) if load[0] < threshold and alive[0] else 0
+            t0 = len(members) if load[0] < threshold and elig[0] else 0
         else:
             t0 = 0
         order = np.argsort(load, kind="stable")
-        counts = [min(t0, len(members))]
-        nodes_seq = [0]
-        remaining_fit = fit.copy()
-        remaining_fit[0] -= counts[0]
-        for i in order:
-            nodes_seq.append(int(i))
-            counts.append(int(remaining_fit[i]))
-        assignment_nodes = np.repeat(np.asarray(nodes_seq, dtype=np.int32),
-                                     np.asarray(counts, dtype=np.int64))
+        if spread:
+            # round-robin over eligible nodes (least-loaded first): one
+            # task per node per round, so members actually spread instead
+            # of filling the emptiest node to its fit count
+            counts_o = fit[order].astype(np.int64)
+            max_r = int(counts_o.max(initial=0))
+            if max_r:
+                rounds = counts_o[None, :] > np.arange(max_r)[:, None]
+                assignment_nodes = order.astype(np.int32)[
+                    np.nonzero(rounds)[1]]
+            else:
+                assignment_nodes = np.zeros(0, dtype=np.int32)
+        else:
+            counts = [min(t0, len(members))]
+            nodes_seq = [0]
+            remaining_fit = fit.copy()
+            remaining_fit[0] -= counts[0]
+            for i in order:
+                nodes_seq.append(int(i))
+                counts.append(int(remaining_fit[i]))
+            assignment_nodes = np.repeat(np.asarray(nodes_seq, dtype=np.int32),
+                                         np.asarray(counts, dtype=np.int64))
         take = min(len(members), len(assignment_nodes))
         if take > 0:
             chosen = assignment_nodes[:take]
@@ -274,10 +300,15 @@ def jax_pack_many(demands, avail, cap, *, strict_spread: bool):
 # jax backend
 # ======================================================================
 
-def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap):
+def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap,
+                         elig=None, spread=None):
     """One scheduling class: partition `members` (bool mask over a flat task
     axis) across nodes. Traced under jit; shared by the runtime assign kernel
     and the benchmark whole-graph tick. Returns (assign_mask, chosen, avail).
+
+    elig [N] bool restricts the class to a node subset (None = all);
+    spread (scalar bool) drops the local-node bias (t0 = 0) — the jitted
+    approximation of SPREAD (the numpy path does true round-robin).
     """
     import jax
     import jax.numpy as jnp
@@ -291,6 +322,8 @@ def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap):
     # dead (removed) nodes have all-zero capacity and must take nothing —
     # even zero-demand tasks, which would otherwise see load 0
     alive = (cap > 0).any(axis=1)
+    if elig is not None:
+        alive = alive & elig
     fit = jnp.where(cap_ok & alive, fit, 0.0)
     fit = jnp.minimum(fit, jnp.float32(batch_cap)).astype(jnp.int32)
 
@@ -308,6 +341,8 @@ def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap):
     t0 = jnp.where((fit[0] > 0) | (~any_active), t0, 0)
     t0 = jnp.where(load_now[0] < threshold, t0, 0)
     t0 = jnp.where(alive[0], t0, 0).astype(jnp.int32)
+    if spread is not None:
+        t0 = jnp.where(spread, 0, t0)
 
     order = jnp.argsort(load_now, stable=True)
     fit_rest = fit.at[0].add(-t0)
@@ -343,7 +378,7 @@ def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap):
 
 
 def _scan_classes(ready, cls, demands, avail, cap, threshold, n_nodes,
-                  batch_cap):
+                  batch_cap, class_mask=None, class_spread=None):
     """Sequential capacity consumption over the class axis via lax.scan.
 
     Class count is DATA (the demands array's leading dim), not a Python
@@ -383,7 +418,9 @@ def _scan_classes(ready, cls, demands, avail, cap, threshold, n_nodes,
             members = ready & (cls == c)
             assign_mask, chosen, avail, per_node = _assign_class_traced(
                 members, demands[c], avail, cap, threshold, n_nodes,
-                batch_cap)
+                batch_cap,
+                None if class_mask is None else class_mask[c],
+                None if class_spread is None else class_spread[c])
             node_of = jnp.where(assign_mask, chosen, node_of)
             assigned = assigned | assign_mask
             release = release + per_node[:, None] * demands[c][None, :]
@@ -393,7 +430,9 @@ def _scan_classes(ready, cls, demands, avail, cap, threshold, n_nodes,
         node_of, assigned, avail, release = carry
         members = ready & (cls == c)
         assign_mask, chosen, avail, per_node = _assign_class_traced(
-            members, demands[c], avail, cap, threshold, n_nodes, batch_cap)
+            members, demands[c], avail, cap, threshold, n_nodes, batch_cap,
+            None if class_mask is None else class_mask[c],
+            None if class_spread is None else class_spread[c])
         node_of = jnp.where(assign_mask, chosen, node_of)
         assigned = assigned | assign_mask
         release = release + per_node[:, None] * demands[c][None, :]
@@ -439,18 +478,21 @@ def _jit_assign(threshold: float):
     power-of-two bucket boundaries (the padding done by jax_assign)."""
     import jax
 
-    def assign(ready_cls, valid, demands, avail, cap):
+    def assign(ready_cls, valid, demands, avail, cap, class_mask,
+               class_spread):
         kpad = ready_cls.shape[0]
         node_of, _assigned, avail, _release = _scan_classes(
             valid, ready_cls, demands, avail, cap, threshold,
-            avail.shape[0], kpad)
+            avail.shape[0], kpad, class_mask, class_spread)
         return node_of, avail
 
     return jax.jit(assign)
 
 
 def jax_assign(ready_cls: np.ndarray, demands: np.ndarray, avail: np.ndarray,
-               cap: np.ndarray, threshold: float
+               cap: np.ndarray, threshold: float,
+               class_mask: Optional[np.ndarray] = None,
+               class_spread: Optional[np.ndarray] = None
                ) -> Tuple[np.ndarray, np.ndarray]:
     """Pad the ready batch AND the class axis to power-of-two buckets
     (bounds recompiles to O(log) in both) and run the jitted assignment.
@@ -462,15 +504,27 @@ def jax_assign(ready_cls: np.ndarray, demands: np.ndarray, avail: np.ndarray,
     valid = np.zeros(kpad, dtype=bool)
     valid[:k] = True
     num_classes = int(demands.shape[0])
+    n_nodes = avail.shape[0]
     kcls = 1 << max(0, (num_classes - 1).bit_length())
     demands = demands.astype(np.float32)
+    if class_mask is None:
+        class_mask = np.ones((num_classes, n_nodes), dtype=bool)
+    if class_spread is None:
+        class_spread = np.zeros(num_classes, dtype=bool)
     if kcls > num_classes:
+        pad_k = kcls - num_classes
         demands = np.concatenate(
-            [demands, np.zeros((kcls - num_classes, demands.shape[1]),
+            [demands, np.zeros((pad_k, demands.shape[1]),
                                dtype=np.float32)], axis=0)
+        class_mask = np.concatenate(
+            [class_mask, np.zeros((pad_k, n_nodes), dtype=bool)], axis=0)
+        class_spread = np.concatenate(
+            [class_spread, np.zeros(pad_k, dtype=bool)])
     fn = _jit_assign(float(threshold))
     node_of, new_avail = fn(padded, valid, demands,
-                            avail.astype(np.float32), cap.astype(np.float32))
+                            avail.astype(np.float32), cap.astype(np.float32),
+                            class_mask.astype(bool),
+                            class_spread.astype(bool))
     return np.asarray(node_of)[:k], np.asarray(new_avail)
 
 

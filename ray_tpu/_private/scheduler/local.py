@@ -26,12 +26,28 @@ from ray_tpu._private.task_spec import resources_to_vector
 
 
 class NodeState:
-    __slots__ = ("capacity", "available", "node_id")
+    __slots__ = ("capacity", "available", "node_id", "pg_id", "bundle_index",
+                 "parent", "defunct")
 
-    def __init__(self, capacity: Tuple[float, ...], node_id=None):
+    def __init__(self, capacity: Tuple[float, ...], node_id=None,
+                 pg_id=None, bundle_index: int = -1, parent: int = -1):
         self.capacity = list(capacity)
         self.available = list(capacity)
         self.node_id = node_id
+        # bundle rows: a committed placement-group bundle is a virtual
+        # node whose capacity was carved out of ``parent``'s availability
+        # (reference: PG bundles become per-bundle resources,
+        # ray: src/ray/gcs/gcs_server/gcs_placement_group_scheduler.cc)
+        self.pg_id = pg_id              # None = physical node
+        self.bundle_index = bundle_index
+        self.parent = parent
+        # removed PG whose in-flight tasks haven't finished: remaining
+        # capacity returns to the parent as each task releases
+        self.defunct = False
+
+    @property
+    def is_bundle(self) -> bool:
+        return self.pg_id is not None
 
     def fits(self, demand: Tuple[float, ...]) -> bool:
         return all(a >= d for a, d in zip(self.available, demand))
@@ -118,7 +134,16 @@ class EventScheduler(SchedulerBase):
             self._num_finished += 1
             self._tasks.pop(task_id, None)
             if 0 <= node_index < len(self._nodes):
-                self._nodes[node_index].release(resources_to_vector(resources))
+                node = self._nodes[node_index]
+                vec = resources_to_vector(resources)
+                if node.defunct:
+                    # removed bundle: this task's share of the carved-out
+                    # capacity returns to the parent now that it is free
+                    self._nodes[node.parent].release(vec)
+                    node.capacity = [max(c - v, 0.0)
+                                     for c, v in zip(node.capacity, vec)]
+                else:
+                    node.release(vec)
             to_dispatch = self._drain_ready_locked()
         self._run_dispatch(to_dispatch)
 
@@ -146,7 +171,9 @@ class EventScheduler(SchedulerBase):
                 "ready_queue": len(self._ready),
                 "infeasible": len(self._infeasible),
                 "nodes": [
-                    {"available": list(n.available), "capacity": list(n.capacity)}
+                    {"available": list(n.available),
+                     "capacity": list(n.capacity),
+                     "is_bundle": n.is_bundle}
                     for n in self._nodes
                 ],
             }
@@ -179,6 +206,73 @@ class EventScheduler(SchedulerBase):
             self._nodes[node_index].available = [0.0] * len(
                 self._nodes[node_index].available)
 
+    # -- placement groups ---------------------------------------------------
+    def pack_snapshot(self):
+        """(avail [N,R], cap [N,R], row indices) over PHYSICAL nodes only —
+        the input to the placement-group bin-pack solve."""
+        import numpy as np
+
+        with self._lock:
+            rows = [i for i, n in enumerate(self._nodes) if not n.is_bundle]
+            avail = np.asarray([self._nodes[i].available for i in rows],
+                               dtype=np.float32)
+            cap = np.asarray([self._nodes[i].capacity for i in rows],
+                             dtype=np.float32)
+            return avail, cap, rows
+
+    def add_bundle_nodes(self, pg_id, placements) -> Optional[List[int]]:
+        """Atomically reserve bundles: placements = [(parent_row,
+        demand_vec), ...] in bundle order. All-or-nothing: validates every
+        reservation against current availability first (2-phase commit of
+        the reference's PrepareBundleResources/CommitBundleResources,
+        ray: src/ray/raylet/placement_group_resource_manager.cc). Returns
+        the new bundle row indices, or None if any reservation no longer
+        fits (caller repacks against a fresh snapshot)."""
+        to_dispatch: List[PendingTask] = []
+        with self._lock:
+            need: Dict[int, List[float]] = {}
+            for parent, vec in placements:
+                acc = need.setdefault(parent, [0.0] * len(vec))
+                for i, v in enumerate(vec):
+                    acc[i] += v
+            for parent, total in need.items():
+                if not self._nodes[parent].fits(tuple(total)):
+                    return None
+            rows = []
+            for bindex, (parent, vec) in enumerate(placements):
+                self._nodes[parent].allocate(tuple(vec))
+                self._nodes.append(NodeState(
+                    tuple(vec), node_id=self._nodes[parent].node_id,
+                    pg_id=pg_id, bundle_index=bindex, parent=parent))
+                rows.append(len(self._nodes) - 1)
+            # bundle rows make parked PG tasks feasible
+            if self._infeasible:
+                self._ready.extend(self._infeasible)
+                self._infeasible.clear()
+            to_dispatch = self._drain_ready_locked()
+        self._run_dispatch(to_dispatch)
+        return rows
+
+    def remove_pg(self, pg_id) -> None:
+        """Release a placement group's bundle rows back to their parents.
+
+        Only the FREE part of each bundle returns immediately; capacity
+        held by still-running tasks stays charged against the bundle (the
+        row goes ``defunct``) and flows back to the parent task-by-task in
+        notify_task_finished — releasing it all at once would overcommit
+        the parent while those tasks still occupy it. Rows are zeroed, not
+        deleted: indices held by in-flight tasks stay valid."""
+        with self._lock:
+            for n in self._nodes:
+                if n.pg_id == pg_id and not n.defunct \
+                        and any(c > 0 for c in n.capacity):
+                    parent = self._nodes[n.parent]
+                    parent.release(tuple(n.available))
+                    in_use = [c - a for c, a in zip(n.capacity, n.available)]
+                    n.capacity = in_use
+                    n.available = [0.0] * len(n.available)
+                    n.defunct = True
+
     # -- internals ---------------------------------------------------------
     def _drain_ready_locked(self) -> List[PendingTask]:
         """Pop ready tasks whose resources fit; assign nodes (hybrid policy)."""
@@ -190,9 +284,11 @@ class EventScheduler(SchedulerBase):
             if task.cancelled:
                 continue
             demand = task.spec.resource_vector()
-            idx = self._pick_node(demand, threshold)
+            placement = task.spec.placement()
+            idx = self._pick_node(demand, threshold, placement)
             if idx is None:
-                if not any(n.feasible(demand) for n in self._nodes):
+                if not any(self._eligible(i, placement) and n.feasible(demand)
+                           for i, n in enumerate(self._nodes)):
                     self._infeasible.append(task)
                 else:
                     deferred.append(task)
@@ -204,16 +300,59 @@ class EventScheduler(SchedulerBase):
         self._ready.extend(deferred)
         return out
 
-    def _pick_node(self, demand: Tuple[float, ...],
-                   threshold: float) -> Optional[int]:
+    def _eligible(self, idx: int, placement: Tuple) -> bool:
+        node = self._nodes[idx]
+        kind = placement[0]
+        if kind == "pg":
+            _, pid, bindex = placement
+            return (node.pg_id is not None
+                    and not node.defunct
+                    and node.pg_id.binary() == pid
+                    and (bindex < 0 or node.bundle_index == bindex))
+        if kind == "aff":
+            nid = placement[1]
+            node_id = node.node_id
+            node_id = node_id.binary() if hasattr(node_id, "binary") \
+                else node_id
+            return not node.is_bundle and node_id == nid
+        return not node.is_bundle   # default / spread
+
+    def _pick_node(self, demand: Tuple[float, ...], threshold: float,
+                   placement: Tuple = ("default",)) -> Optional[int]:
+        kind = placement[0]
+        if kind == "aff":
+            best, best_load = None, float("inf")
+            target_alive = False
+            for i, n in enumerate(self._nodes):
+                if self._eligible(i, placement):
+                    if any(c > 0 for c in n.capacity):
+                        target_alive = True
+                    if n.fits(demand):
+                        ld = n.load()
+                        if ld < best_load:
+                            best, best_load = i, ld
+            if best is not None:
+                return best
+            if target_alive:
+                return None  # node exists but is busy: wait for it
+            # soft affinity falls back to the default policy only when the
+            # target node is missing or dead (documented semantics)
+            if len(placement) > 2 and placement[2]:
+                placement = ("default",)
+            else:
+                return None
+            kind = "default"
         # hybrid: local (node 0) until its load crosses threshold, then the
-        # least-loaded remote node that fits
-        if self._nodes and self._nodes[0].fits(demand) \
+        # least-loaded eligible node that fits. SPREAD and PG classes skip
+        # the local bias (PG rows exclude node 0 anyway).
+        if kind == "default" and self._nodes \
+                and self._eligible(0, placement) \
+                and self._nodes[0].fits(demand) \
                 and self._nodes[0].load() < threshold:
             return 0
         best, best_load = None, float("inf")
         for i, n in enumerate(self._nodes):
-            if n.fits(demand):
+            if self._eligible(i, placement) and n.fits(demand):
                 ld = n.load()
                 if ld < best_load:
                     best, best_load = i, ld

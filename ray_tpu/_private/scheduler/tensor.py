@@ -80,6 +80,13 @@ class TensorScheduler(SchedulerBase):
 
         self._class_index: Dict[Tuple, int] = {}
         self._demands = np.zeros((0, n_res), dtype=np.float32)
+        # node-eligibility masks per scheduling class (placement groups,
+        # SPREAD, node affinity). Rebuilt lazily when classes or the node
+        # set change; the kernels consume them as [K,N] / [K] arrays.
+        self._class_place: List[Tuple] = []
+        self._class_mask = np.zeros((0, 0), dtype=bool)
+        self._class_spread = np.zeros(0, dtype=bool)
+        self._mask_dirty = False
 
         self._submit_q: collections.deque = collections.deque()
         self._ready_obj_q: collections.deque = collections.deque()
@@ -167,7 +174,8 @@ class TensorScheduler(SchedulerBase):
                 "infeasible": infeasible,
                 "nodes": [
                     {"available": self._avail[i].tolist(),
-                     "capacity": self._cap[i].tolist()}
+                     "capacity": self._cap[i].tolist(),
+                     "is_bundle": self._node_states[i].is_bundle}
                     for i in range(len(self._node_states))
                 ],
             }
@@ -192,6 +200,9 @@ class TensorScheduler(SchedulerBase):
             self._avail[node_index] = 0.0
             self._node_states[node_index].capacity = [0.0] * self._cap.shape[1]
             self._node_states[node_index].available = [0.0] * self._cap.shape[1]
+            # soft-affinity classes pinned to this node must re-resolve
+            # (dead target -> fall back to the default node set)
+            self._mask_dirty = True
             self._dirty = True
             self._wake.notify()
 
@@ -206,7 +217,74 @@ class TensorScheduler(SchedulerBase):
             av[0, i] = v
         self._avail = np.concatenate([self._avail, av], axis=0)
         self._node_states.append(node)
+        self._mask_dirty = True
         return len(self._node_states) - 1
+
+    # -- placement groups ---------------------------------------------------
+    def pack_snapshot(self):
+        """(avail [N,R], cap [N,R], row indices) over PHYSICAL nodes only —
+        the input to the placement-group bin-pack solve."""
+        with self._wake:
+            rows = [i for i, n in enumerate(self._node_states)
+                    if not n.is_bundle]
+            return (self._avail[rows].copy(), self._cap[rows].copy(), rows)
+
+    def add_bundle_nodes(self, pg_id, placements) -> Optional[List[int]]:
+        """Atomically reserve bundles: placements = [(parent_row,
+        demand_vec), ...] in bundle order; all-or-nothing (the 2-phase
+        prepare/commit of the reference's GcsPlacementGroupScheduler,
+        ray: src/ray/raylet/placement_group_resource_manager.cc). Returns
+        new bundle rows or None if availability moved since the pack."""
+        with self._wake:
+            n_res = self._cap.shape[1]
+            need: Dict[int, np.ndarray] = {}
+            for parent, vec in placements:
+                acc = need.setdefault(parent, np.zeros(n_res, np.float32))
+                acc[:len(vec)] += np.asarray(vec, dtype=np.float32)[:n_res]
+            for parent, total in need.items():
+                if not (self._avail[parent] >= total - 1e-6).all():
+                    return None
+            rows = []
+            for bindex, (parent, vec) in enumerate(placements):
+                v = np.zeros(n_res, np.float32)
+                v[:len(vec)] = np.asarray(vec, dtype=np.float32)[:n_res]
+                self._avail[parent] -= v
+                self._node_states[parent].allocate(tuple(v.tolist()))
+                row = self._append_node(NodeState(
+                    tuple(v.tolist()),
+                    node_id=self._node_states[parent].node_id,
+                    pg_id=pg_id, bundle_index=bindex, parent=parent))
+                rows.append(row)
+            self._dirty = True
+            self._wake.notify()
+            return rows
+
+    def remove_pg(self, pg_id) -> None:
+        """Release a group's bundle rows back to their parents.
+
+        Only the FREE part of each bundle returns immediately; capacity
+        held by still-running tasks stays charged to the (now defunct)
+        row and flows back to the parent task-by-task as completions
+        drain — releasing it all at once would overcommit the parent.
+        Row indices stay valid."""
+        with self._wake:
+            for i, ns in enumerate(self._node_states):
+                if ns.pg_id == pg_id and not ns.defunct \
+                        and self._cap[i].any():
+                    parent = ns.parent
+                    free = self._avail[i].copy()
+                    self._avail[parent] = np.minimum(
+                        self._avail[parent] + free, self._cap[parent])
+                    self._node_states[parent].release(tuple(free.tolist()))
+                    in_use = self._cap[i] - free
+                    self._cap[i] = in_use
+                    self._avail[i] = 0.0
+                    ns.capacity = in_use.tolist()
+                    ns.available = [0.0] * self._cap.shape[1]
+                    ns.defunct = True
+            self._mask_dirty = True
+            self._dirty = True
+            self._wake.notify()
 
     # -- tick loop ---------------------------------------------------------
     def _tick_loop(self) -> None:
@@ -271,6 +349,9 @@ class TensorScheduler(SchedulerBase):
                 w = min(len(vec), d.shape[1])
                 d[0, :w] = vec[:w]
                 self._demands = np.concatenate([self._demands, d], axis=0)
+                place = spec.placement()
+                self._class_place.append(place)
+                self._append_class_mask_locked(place)
             self._cls[slot] = cidx
             pending_deps = []
             for dep in task.deps:
@@ -300,21 +381,97 @@ class TensorScheduler(SchedulerBase):
             if 0 <= node_index < len(self._node_states):
                 vec = np.asarray(resources_to_vector(resources),
                                  dtype=np.float32)[:self._cap.shape[1]]
-                self._avail[node_index] = np.minimum(
-                    self._avail[node_index] + vec, self._cap[node_index])
-                self._node_states[node_index].release(tuple(vec))
+                ns = self._node_states[node_index]
+                if ns.defunct:
+                    # removed bundle: this task's share of the carved-out
+                    # capacity returns to the parent now that it is free
+                    parent = ns.parent
+                    self._avail[parent] = np.minimum(
+                        self._avail[parent] + vec, self._cap[parent])
+                    self._node_states[parent].release(tuple(vec))
+                    self._cap[node_index] = np.maximum(
+                        self._cap[node_index] - vec, 0.0)
+                    ns.capacity = self._cap[node_index].tolist()
+                else:
+                    self._avail[node_index] = np.minimum(
+                        self._avail[node_index] + vec, self._cap[node_index])
+                    ns.release(tuple(vec))
 
         # snapshot for the out-of-lock assignment pass
         ready_idx = np.flatnonzero((self._state == WAITING) & (self._indeg <= 0))
         if len(ready_idx) == 0:
             return None
+        if self._mask_dirty:
+            self._rebuild_masks_locked()
         return (ready_idx, self._cls[ready_idx].copy(), self._demands.copy(),
-                self._avail.copy(), self._cap.copy())
+                self._avail.copy(), self._cap.copy(),
+                self._class_mask.copy(), self._class_spread.copy())
+
+    def _mask_row(self, place: Tuple) -> Tuple[np.ndarray, bool]:
+        """(eligibility row [N], spread flag) for one placement descriptor
+        (see TaskSpec.placement) against the current node set."""
+        nodes = self._node_states
+        N = len(nodes)
+        non_bundle = np.asarray([not ns.is_bundle for ns in nodes],
+                                dtype=bool) if N else np.zeros(0, bool)
+        row = np.zeros(N, dtype=bool)
+        kind = place[0]
+        if kind == "pg":
+            _, pid, bindex = place
+            for i, ns in enumerate(nodes):
+                if ns.pg_id is not None and not ns.defunct \
+                        and ns.pg_id.binary() == pid \
+                        and (bindex < 0 or ns.bundle_index == bindex):
+                    row[i] = True
+            return row, False
+        if kind == "aff":
+            nid, soft = place[1], place[2]
+            found_alive = False
+            for i, ns in enumerate(nodes):
+                node_id = ns.node_id
+                node_id = node_id.binary() \
+                    if hasattr(node_id, "binary") else node_id
+                if not ns.is_bundle and node_id == nid:
+                    row[i] = True
+                    if any(c > 0 for c in ns.capacity):
+                        found_alive = True
+            # soft affinity falls back only when the node is missing or
+            # DEAD (a live-but-busy node means: wait for it)
+            if soft and not found_alive:
+                row = non_bundle.copy()
+            return row, False
+        return non_bundle.copy(), kind == "spread"
+
+    def _append_class_mask_locked(self, place: Tuple) -> None:
+        """Append one class row without a full K*N rebuild (classes are
+        minted far more often than the node set changes)."""
+        if self._mask_dirty:
+            return  # a full rebuild is due anyway
+        row, spread = self._mask_row(place)
+        if self._class_mask.shape[0] == 0:
+            self._class_mask = row[None, :]
+        else:
+            self._class_mask = np.vstack([self._class_mask, row[None, :]])
+        self._class_spread = np.append(self._class_spread, spread)
+
+    def _rebuild_masks_locked(self) -> None:
+        """Recompute [K,N] class->node eligibility + [K] spread flags
+        (node set or PG membership changed)."""
+        K = len(self._class_place)
+        N = len(self._node_states)
+        mask = np.zeros((K, N), dtype=bool)
+        spread = np.zeros(K, dtype=bool)
+        for k, place in enumerate(self._class_place):
+            mask[k], spread[k] = self._mask_row(place)
+        self._class_mask = mask
+        self._class_spread = spread
+        self._mask_dirty = False
 
     def _assign(self, snapshot):
         """Batched assignment OUTSIDE the lock (jit compilation of the jax
         path can take seconds and must not block submit()/notify_*)."""
-        ready_idx, ready_cls, demands, avail, cap = snapshot
+        (ready_idx, ready_cls, demands, avail, cap, class_mask,
+         class_spread) = snapshot
         backend = GLOBAL_CONFIG.sched_backend
         # class count no longer gates the device path: the kernel scans the
         # class axis (class as data), so many classes don't grow the program
@@ -334,7 +491,7 @@ class TensorScheduler(SchedulerBase):
                 uniq, inv = np.unique(ready_cls, return_inverse=True)
                 node_of_ready, new_avail = kernels.jax_assign(
                     inv.astype(np.int32), demands[uniq], avail, cap,
-                    threshold)
+                    threshold, class_mask[uniq], class_spread[uniq])
             except Exception:
                 logger.exception("jax assign failed; falling back to numpy")
                 use_jax = False
@@ -343,7 +500,8 @@ class TensorScheduler(SchedulerBase):
             cls_full = np.zeros(int(ready_idx.max()) + 1, dtype=np.int32)
             cls_full[ready_idx] = ready_cls
             node_of_ready, new_avail = kernels.assign_np(
-                ready_idx, cls_full, demands, avail, cap, threshold)
+                ready_idx, cls_full, demands, avail, cap, threshold,
+                class_mask, class_spread)
             dt = time.perf_counter() - t0
             self._np_cost = 0.8 * self._np_cost + 0.2 * dt if self._np_cost else dt
         return ready_idx, node_of_ready, new_avail
@@ -356,7 +514,8 @@ class TensorScheduler(SchedulerBase):
         with large ready batches the device kernel wins. Never stalls the
         tick loop: numpy serves until the verdict is in."""
         self._calib_state = "warming"
-        ready_idx, ready_cls, demands, avail, cap = snapshot
+        (ready_idx, ready_cls, demands, avail, cap, class_mask,
+         class_spread) = snapshot
         threshold = GLOBAL_CONFIG.sched_hybrid_threshold
 
         def _calibrate() -> None:
@@ -364,7 +523,7 @@ class TensorScheduler(SchedulerBase):
             try:
                 uniq, inv = np.unique(ready_cls, return_inverse=True)
                 args = (inv.astype(np.int32), demands[uniq], avail, cap,
-                        threshold)
+                        threshold, class_mask[uniq], class_spread[uniq])
                 kernels.jax_assign(*args)          # compile + warm
                 t0 = time.perf_counter()
                 kernels.jax_assign(*args)          # steady-state cost
@@ -401,6 +560,8 @@ class TensorScheduler(SchedulerBase):
             # zero-demand task would otherwise pass the fit check (0 >= 0)
             if not (self._cap[node] > 0).any():
                 continue  # node removed since snapshot
+            if self._node_states[node].defunct:
+                continue  # bundle's group removed since snapshot
             if not (self._cap[node] >= demand).all():
                 continue  # node shrunk since snapshot; next tick
             task = self._tasks.get(slot)

@@ -63,6 +63,7 @@ class TaskSpec:
     scheduling_strategy: Any = None
     placement_group_id: Optional[PlacementGroupID] = None
     placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
     runtime_env: Optional[dict] = None
     serialized_func: Optional[bytes] = None  # for process workers
     attempt_number: int = 0
@@ -72,9 +73,37 @@ class TaskSpec:
         return [ObjectID.for_task_return(self.task_id, i)
                 for i in range(self.num_returns)]
 
+    def placement(self) -> Tuple:
+        """Hashable placement descriptor consumed by the schedulers'
+        node-eligibility masks (reference: scheduling_strategy field of
+        TaskSpec, ray: python/ray/util/scheduling_strategies.py).
+
+        ("default",)                       any non-bundle node, hybrid policy
+        ("spread",)                        any non-bundle node, no local bias
+        ("aff", node_id_bytes, soft)       pinned to one node
+        ("pg", pg_id_bytes, bundle_index)  the group's reserved bundles
+        """
+        if self.placement_group_id is not None:
+            return ("pg", self.placement_group_id.binary(),
+                    self.placement_group_bundle_index)
+        strat = self.scheduling_strategy
+        if isinstance(strat, str):
+            if strat == "SPREAD":
+                return ("spread",)
+            return ("default",)
+        if strat is not None and hasattr(strat, "node_id") \
+                and getattr(strat, "node_id") is not None:
+            nid = strat.node_id
+            nid = nid.binary() if hasattr(nid, "binary") else nid
+            return ("aff", nid, bool(getattr(strat, "soft", False)))
+        return ("default",)
+
     def scheduling_class(self) -> Tuple:
-        """Tasks in the same class can reuse leases / batch together."""
-        return (self.func_descriptor, tuple(sorted(self.resources.items())))
+        """Tasks in the same class can reuse leases / batch together.
+        Placement is part of the class: tasks differing only in strategy
+        or bundle must not share one batched assignment row."""
+        return (self.func_descriptor, tuple(sorted(self.resources.items())),
+                self.placement())
 
     def resource_vector(self) -> Tuple[float, ...]:
         return resources_to_vector(self.resources)

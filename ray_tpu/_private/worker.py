@@ -161,6 +161,10 @@ class Worker:
         else:
             self.scheduler = EventScheduler([node], self._dispatch, contains)
 
+        # placement groups (bundle reservation over the scheduler)
+        from ray_tpu._private.placement_groups import PlacementGroupManager
+        self.placement_groups = PlacementGroupManager(self)
+
         # actors: ActorID -> _ActorRuntime (see actor.py)
         self.actors: Dict[ActorID, Any] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
@@ -359,6 +363,11 @@ class Worker:
         self._context.task_id = exec_task_id
         self._context.put_counter = 0
         retry_task: Optional[PendingTask] = None
+        pg_token = None
+        if spec.placement_group_id is not None \
+                and spec.placement_group_capture_child_tasks:
+            from ray_tpu.util.placement_group import _current_pg
+            pg_token = _current_pg.set(spec.placement_group_id)
         try:
             args, kwargs, dep_error = self._resolve_args(spec)
             if dep_error is not None:
@@ -376,6 +385,9 @@ class Worker:
                 return
             self._store_returns(spec, return_ids, result)
         finally:
+            if pg_token is not None:
+                from ray_tpu.util.placement_group import _current_pg
+                _current_pg.reset(pg_token)
             self._context.task_id = prev_task
             self._context.put_counter = prev_put
             with self._running_lock:
@@ -384,6 +396,7 @@ class Worker:
             self.reference_counter.remove_submitted_task_references(deps)
             self.scheduler.notify_task_finished(
                 exec_task_id, pending.node_index, spec.resources)
+            self.placement_groups.poke()
             # resubmit AFTER the finished notification so the scheduler
             # releases this execution's slot before seeing the retry
             if retry_task is not None:
@@ -496,6 +509,7 @@ class Worker:
 
     def shutdown(self) -> None:
         self.alive = False
+        self.placement_groups.shutdown()
         with self._actors_lock:
             actors = list(self.actors.values())
         for rt in actors:

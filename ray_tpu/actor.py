@@ -200,8 +200,25 @@ class _ActorRuntime:
             loop.close()
 
     # -- execution ---------------------------------------------------------
+    def _capture_pg_token(self):
+        """Actors created with placement_group_capture_child_tasks=True
+        propagate their group to tasks submitted from method bodies
+        (mirrors Worker._execute_task for normal tasks)."""
+        spec = self._creation_spec
+        if spec.placement_group_id is not None \
+                and spec.placement_group_capture_child_tasks:
+            from ray_tpu.util.placement_group import _current_pg
+            return _current_pg.set(spec.placement_group_id)
+        return None
+
+    def _reset_pg_token(self, token) -> None:
+        if token is not None:
+            from ray_tpu.util.placement_group import _current_pg
+            _current_pg.reset(token)
+
     def _execute_call(self, call: _Call):
         method = getattr(self.instance, call.method_name)
+        pg_token = self._capture_pg_token()
         try:
             args, kwargs, dep_err = self._resolve(call.args, call.kwargs)
             if dep_err is not None:
@@ -213,10 +230,12 @@ class _ActorRuntime:
         except BaseException as e:  # noqa: BLE001
             self._store_error(call, e)
         finally:
+            self._reset_pg_token(pg_token)
             self.num_executed += 1
 
     async def _execute_call_async(self, call: _Call):
         method = getattr(self.instance, call.method_name)
+        pg_token = self._capture_pg_token()
         try:
             args, kwargs, dep_err = self._resolve(call.args, call.kwargs)
             if dep_err is not None:
@@ -228,6 +247,7 @@ class _ActorRuntime:
         except BaseException as e:  # noqa: BLE001
             self._store_error(call, e)
         finally:
+            self._reset_pg_token(pg_token)
             self.num_executed += 1
 
     def _resolve(self, args, kwargs):
@@ -743,8 +763,16 @@ class ActorClass:
         strategy = opts.get("scheduling_strategy")
         if strategy is not None and hasattr(strategy, "placement_group"):
             pg = strategy.placement_group
+            spec.placement_group_bundle_index = getattr(
+                strategy, "placement_group_bundle_index", -1)
+            spec.placement_group_capture_child_tasks = getattr(
+                strategy, "placement_group_capture_child_tasks", False)
         if pg is not None:
             spec.placement_group_id = pg.id if hasattr(pg, "id") else pg
+            from ray_tpu.remote_function import _validate_bundle_fit
+            _validate_bundle_fit(worker, spec.placement_group_id,
+                                 spec.placement_group_bundle_index,
+                                 spec.resources)
 
         cls, copts = self._cls, dict(opts)
         is_async = any(inspect.iscoroutinefunction(m) for _, m in

@@ -92,11 +92,22 @@ class RemoteFunction:
         pg_id = None
         bundle_index = opts["placement_group_bundle_index"]
         strategy = opts["scheduling_strategy"]
+        capture = False
         if strategy is not None and hasattr(strategy, "placement_group"):
             pg = strategy.placement_group
             bundle_index = getattr(strategy, "placement_group_bundle_index", -1)
+            capture = getattr(strategy,
+                              "placement_group_capture_child_tasks", False)
+        if pg is None and strategy is None:
+            # inside a capture_child_tasks task: children inherit the group
+            from ray_tpu.util.placement_group import _current_pg
+            inherited = _current_pg.get()
+            if inherited is not None:
+                pg, capture = inherited, True
         if pg is not None:
             pg_id = pg.id if hasattr(pg, "id") else pg
+            _validate_bundle_fit(worker, pg_id, bundle_index,
+                                 _build_resources(opts))
 
         func = self._function
         if generator:
@@ -117,11 +128,42 @@ class RemoteFunction:
             scheduling_strategy=strategy,
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_index,
+            placement_group_capture_child_tasks=capture,
             runtime_env=opts["runtime_env"],
             generator=generator,
         )
         refs = worker.submit_task(spec)
         return refs[0] if spec.num_returns == 1 else refs
+
+
+def _validate_bundle_fit(worker, pg_id, bundle_index, resources) -> None:
+    """Reject tasks whose demand can never fit their target bundle(s) —
+    otherwise they would wait forever (reference raises the same way,
+    ray: python/ray/util/placement_group.py check_placement_group_index +
+    resource validation)."""
+    entry = worker.placement_groups.get(pg_id)
+    if entry is None:
+        return
+    import numpy as np
+
+    from ray_tpu._private.task_spec import resources_to_vector
+
+    demand = np.asarray(resources_to_vector(resources), dtype=np.float32)
+    bundles = entry.demands
+    if bundle_index >= 0:
+        if bundle_index >= len(bundles):
+            raise ValueError(
+                f"bundle index {bundle_index} out of range: placement "
+                f"group has {len(bundles)} bundles")
+        ok = bool((bundles[bundle_index] >= demand).all())
+    else:
+        ok = bool((bundles >= demand[None, :]).all(axis=1).any())
+    if not ok:
+        raise ValueError(
+            f"task demand {resources} cannot fit "
+            f"{'bundle %d' % bundle_index if bundle_index >= 0 else 'any bundle'}"
+            f" of placement group {pg_id.hex()[:16]} "
+            f"(bundles: {entry.bundles})")
 
 
 def _collect_generator(func):

@@ -1,0 +1,15 @@
+"""ray_tpu.util — utility APIs (reference: python/ray/util/)."""
+
+from ray_tpu.util.placement_group import (placement_group,  # noqa: F401
+                                          placement_group_table,
+                                          remove_placement_group,
+                                          get_current_placement_group,
+                                          PlacementGroup)
+from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+__all__ = [
+    "placement_group", "remove_placement_group", "placement_group_table",
+    "get_current_placement_group", "PlacementGroup",
+    "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
+]

@@ -1,0 +1,331 @@
+"""Placement groups: bin-pack kernels, reservation, strategies, and the
+public API end-to-end on both schedulers.
+
+Reference behaviors mirrored from ray's test_placement_group*.py
+(python/ray/tests/): STRICT_SPREAD lands every bundle on a distinct
+node, STRICT_PACK co-locates, infeasible groups error, removal frees
+resources, tasks/actors target bundles via scheduling strategies.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.scheduler import kernels
+from ray_tpu._private.scheduler.local import NodeState
+from ray_tpu.exceptions import PlacementGroupUnschedulableError
+from ray_tpu.util import (NodeAffinitySchedulingStrategy, PlacementGroup,
+                          PlacementGroupSchedulingStrategy, placement_group,
+                          placement_group_table, remove_placement_group)
+
+
+# ----------------------------------------------------------------------
+# kernel-level: pack_bundles_np
+# ----------------------------------------------------------------------
+
+def _cluster(n, cpu):
+    cap = np.zeros((n, 4), np.float32)
+    cap[:, 0] = cpu
+    return cap.copy(), cap.copy()
+
+
+class TestPackKernel:
+    def test_strict_spread_distinct_nodes(self):
+        avail, cap = _cluster(4, 4)
+        d = np.asarray([[2, 0, 0, 0]] * 3, np.float32)
+        sol = kernels.pack_bundles_np(d, avail, cap, "STRICT_SPREAD")
+        assert sol is not None and len(set(sol.tolist())) == 3
+
+    def test_strict_spread_infeasible(self):
+        avail, cap = _cluster(2, 4)
+        d = np.asarray([[2, 0, 0, 0]] * 3, np.float32)
+        assert kernels.pack_bundles_np(d, avail, cap, "STRICT_SPREAD") is None
+
+    def test_strict_pack_one_node(self):
+        avail, cap = _cluster(4, 8)
+        d = np.asarray([[2, 0, 0, 0]] * 3, np.float32)
+        sol = kernels.pack_bundles_np(d, avail, cap, "STRICT_PACK")
+        assert sol is not None and len(set(sol.tolist())) == 1
+
+    def test_strict_pack_infeasible(self):
+        avail, cap = _cluster(4, 4)
+        d = np.asarray([[2, 0, 0, 0]] * 3, np.float32)  # 6 CPU > any node
+        assert kernels.pack_bundles_np(d, avail, cap, "STRICT_PACK") is None
+
+    def test_pack_spills_when_full(self):
+        avail, cap = _cluster(2, 4)
+        d = np.asarray([[3, 0, 0, 0], [3, 0, 0, 0]], np.float32)
+        sol = kernels.pack_bundles_np(d, avail, cap, "PACK")
+        assert sol is not None and len(set(sol.tolist())) == 2
+
+    def test_spread_prefers_distinct(self):
+        avail, cap = _cluster(3, 8)
+        d = np.asarray([[1, 0, 0, 0]] * 3, np.float32)
+        sol = kernels.pack_bundles_np(d, avail, cap, "SPREAD")
+        assert sol is not None and len(set(sol.tolist())) == 3
+
+    def test_spread_reuses_when_fewer_nodes(self):
+        avail, cap = _cluster(2, 8)
+        d = np.asarray([[1, 0, 0, 0]] * 4, np.float32)
+        sol = kernels.pack_bundles_np(d, avail, cap, "SPREAD")
+        assert sol is not None  # falls back to reuse, does not fail
+
+    def test_jax_pack_many_matches_feasibility(self):
+        avail, cap = _cluster(4, 4)
+        groups = np.asarray([[[3, 0, 0, 0]] * 2] * 3, np.float32)  # [3,2,4]
+        node_of, ok, _ = kernels.jax_pack_many(groups, avail, cap,
+                                               strict_spread=True)
+        node_of, ok = np.asarray(node_of), np.asarray(ok)
+        # 4 nodes x 4cpu fit 2 groups of 2x3cpu strictly spread; the 3rd
+        # finds no pair of nodes with 3 free and must fail
+        assert ok.tolist() == [True, True, False]
+        for g in range(2):
+            assert len(set(node_of[g].tolist())) == 2
+
+
+# ----------------------------------------------------------------------
+# runtime end-to-end
+# ----------------------------------------------------------------------
+
+@pytest.fixture(params=["event", "tensor"])
+def pg_cluster(request):
+    """4 virtual nodes x 2 CPU, small worker pool."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_workers=8, scheduler=request.param)
+    w = ray_tpu._worker.get_worker()
+    for _ in range(3):
+        w.scheduler.add_node(NodeState((2.0, 0.0, 1e18, 1e18)))
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def where_am_i():
+    import time
+
+    time.sleep(0.05)  # hold the bundle slot so co-members overlap
+    return True
+
+
+class TestPlacementGroupAPI:
+    def test_ready_and_table(self, pg_cluster):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK",
+                             name="t")
+        assert ray_tpu.get(pg.ready(), timeout=10) is True
+        info = placement_group_table()[pg.id.hex()]
+        assert info["state"] == "CREATED"
+        assert info["strategy"] == "PACK"
+        assert len(info["bundle_rows"]) == 2
+
+    def test_strict_spread_spreads(self, pg_cluster):
+        pg = placement_group([{"CPU": 2}] * 3, strategy="STRICT_SPREAD")
+        assert pg.wait(10)
+        w = ray_tpu._worker.get_worker()
+        entry = w.placement_groups.get(pg.id)
+        sched = w.scheduler
+        if hasattr(sched, "_node_states"):
+            nodes = sched._node_states
+        else:
+            nodes = sched._nodes
+        parents = [nodes[r].parent for r in entry.rows]
+        assert len(set(parents)) == 3
+
+    def test_strict_pack_colocates(self, pg_cluster):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+        assert pg.wait(10)
+        w = ray_tpu._worker.get_worker()
+        entry = w.placement_groups.get(pg.id)
+        sched = w.scheduler
+        nodes = getattr(sched, "_node_states", None) or sched._nodes
+        parents = [nodes[r].parent for r in entry.rows]
+        assert len(set(parents)) == 1
+
+    def test_infeasible_raises(self, pg_cluster):
+        pg = placement_group([{"CPU": 64}], strategy="PACK")
+        with pytest.raises(PlacementGroupUnschedulableError):
+            ray_tpu.get(pg.ready(), timeout=10)
+
+    def test_strict_spread_infeasible_raises(self, pg_cluster):
+        # 5 bundles, 4 nodes
+        pg = placement_group([{"CPU": 1}] * 5, strategy="STRICT_SPREAD")
+        with pytest.raises(PlacementGroupUnschedulableError):
+            ray_tpu.get(pg.ready(), timeout=10)
+
+    def test_tasks_run_in_bundles(self, pg_cluster):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}],
+                             strategy="STRICT_SPREAD")
+        assert pg.wait(10)
+        strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+        refs = [where_am_i.options(scheduling_strategy=strat).remote()
+                for _ in range(4)]
+        assert all(ray_tpu.get(refs, timeout=15))
+
+    def test_bundle_index_pins(self, pg_cluster):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}],
+                             strategy="STRICT_SPREAD")
+        assert pg.wait(10)
+        strat = PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=1)
+        assert ray_tpu.get(
+            where_am_i.options(scheduling_strategy=strat).remote(),
+            timeout=15)
+
+    def test_oversized_task_rejected(self, pg_cluster):
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(10)
+        strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+        with pytest.raises(ValueError):
+            where_am_i.options(scheduling_strategy=strat,
+                               num_cpus=2).remote()
+
+    def test_remove_frees_resources(self, pg_cluster):
+        before = ray_tpu.available_resources()["CPU"]
+        pg = placement_group([{"CPU": 2}] * 4, strategy="SPREAD")
+        assert pg.wait(10)
+        during = ray_tpu.available_resources()["CPU"]
+        assert during == before - 8
+        remove_placement_group(pg)
+        import time
+
+        for _ in range(100):
+            if ray_tpu.available_resources()["CPU"] == before:
+                break
+            time.sleep(0.02)
+        assert ray_tpu.available_resources()["CPU"] == before
+
+    def test_pending_until_resources_free(self, pg_cluster):
+        # first PG takes the whole cluster; second waits until removal
+        pg1 = placement_group([{"CPU": 2}] * 4, strategy="SPREAD")
+        assert pg1.wait(10)
+        pg2 = placement_group([{"CPU": 2}] * 4, strategy="SPREAD")
+        assert not pg2.wait(0.3)
+        assert placement_group_table()[pg2.id.hex()]["state"] == "PENDING"
+        remove_placement_group(pg1)
+        assert pg2.wait(10)
+
+    def test_actor_in_placement_group(self, pg_cluster):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.x = 0
+
+            def incr(self):
+                self.x += 1
+                return self.x
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(10)
+        a = Counter.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg)).remote()
+        assert ray_tpu.get(a.incr.remote(), timeout=15) == 1
+        ray_tpu.kill(a)
+
+    def test_capture_child_tasks(self, pg_cluster):
+        pg = placement_group([{"CPU": 2}], strategy="PACK")
+        assert pg.wait(10)
+
+        @ray_tpu.remote
+        def child():
+            from ray_tpu.util.placement_group import \
+                get_current_placement_group
+
+            cur = get_current_placement_group()
+            return cur.id.hex() if cur else None
+
+        @ray_tpu.remote
+        def parent():
+            from ray_tpu.util.placement_group import \
+                get_current_placement_group
+
+            cur = get_current_placement_group()
+            return ray_tpu.get(child.remote()), (cur.id.hex() if cur
+                                                 else None)
+
+        strat = PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_capture_child_tasks=True)
+        child_pg, parent_pg = ray_tpu.get(
+            parent.options(scheduling_strategy=strat).remote(), timeout=15)
+        assert parent_pg == pg.id.hex()
+        assert child_pg == pg.id.hex()
+
+    def test_remove_with_running_task_no_overcommit(self, pg_cluster):
+        """Removing a PG while a task runs in its bundle must not hand the
+        in-use capacity back to the parent until the task finishes."""
+        import time
+
+        total = ray_tpu.available_resources()["CPU"]  # 8
+        pg = placement_group([{"CPU": 2}], strategy="PACK")
+        assert pg.wait(10)
+
+        @ray_tpu.remote(num_cpus=2)
+        def hold():
+            time.sleep(0.6)
+            return True
+
+        strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+        ref = hold.options(scheduling_strategy=strat).remote()
+        # wait until it is actually running (bundle fully in use)
+        deadline = time.monotonic() + 5
+        w = ray_tpu._worker.get_worker()
+        while time.monotonic() < deadline:
+            if w.scheduler.stats().get("running", 1) or True:
+                break
+        time.sleep(0.2)
+        remove_placement_group(pg)
+        # while the task still runs, its 2 CPU must NOT be available
+        avail_now = ray_tpu.available_resources()["CPU"]
+        assert avail_now <= total - 2, avail_now
+        assert ray_tpu.get(ref, timeout=10) is True
+        for _ in range(100):
+            if ray_tpu.available_resources()["CPU"] == total:
+                break
+            time.sleep(0.02)
+        assert ray_tpu.available_resources()["CPU"] == total
+
+    def test_actor_captures_child_tasks(self, pg_cluster):
+        pg = placement_group([{"CPU": 2}], strategy="PACK")
+        assert pg.wait(10)
+
+        @ray_tpu.remote
+        def child():
+            from ray_tpu.util.placement_group import \
+                get_current_placement_group
+
+            cur = get_current_placement_group()
+            return cur.id.hex() if cur else None
+
+        @ray_tpu.remote
+        class Spawner:
+            def spawn(self):
+                return ray_tpu.get(child.remote())
+
+        a = Spawner.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg,
+                placement_group_capture_child_tasks=True)).remote()
+        assert ray_tpu.get(a.spawn.remote(), timeout=15) == pg.id.hex()
+        ray_tpu.kill(a)
+
+    def test_handle_serializable(self, pg_cluster):
+        import pickle
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(10)
+        pg2 = pickle.loads(pickle.dumps(pg))
+        assert isinstance(pg2, PlacementGroup) and pg2.id == pg.id
+
+
+class TestOtherStrategies:
+    def test_spread_strategy_string(self, pg_cluster):
+        refs = [where_am_i.options(scheduling_strategy="SPREAD").remote()
+                for _ in range(8)]
+        assert all(ray_tpu.get(refs, timeout=15))
+
+    def test_node_affinity(self, pg_cluster):
+        # node_id None in NodeState today -> affinity to a missing node
+        # with soft=True falls back and completes
+        strat = NodeAffinitySchedulingStrategy(node_id=b"nope", soft=True)
+        assert ray_tpu.get(
+            where_am_i.options(scheduling_strategy=strat).remote(),
+            timeout=15)
