@@ -1,0 +1,267 @@
+"""GCS — the cluster control plane.
+
+Reference surface: the GCS server (ray: src/ray/gcs/gcs_server/ —
+GcsNodeManager, GcsActorManager, GcsJobManager, GcsKVManager,
+GcsPublisher, GcsHealthCheckManager) and its client accessors
+(src/ray/gcs/gcs_client/). The reference runs this as a separate
+process reached over gRPC; here it is an in-process service object on
+the head — the table/pubsub/health semantics are the same, and the
+process boundary can be added behind this interface without changing
+callers (single global scheduler + control plane on one host is the
+TPU-first stance, SURVEY.md §7.1 P4).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, NodeID
+
+logger = logging.getLogger(__name__)
+
+# pubsub channels (reference: src/ray/pubsub/ channel types)
+CH_NODE = "NODE"
+CH_ACTOR = "ACTOR"
+CH_JOB = "JOB"
+CH_ERROR = "ERROR"
+
+
+class NodeEntry:
+    __slots__ = ("node_id", "index", "resources", "state", "kind",
+                 "last_heartbeat", "pool", "death_reason")
+
+    def __init__(self, node_id: NodeID, index: int,
+                 resources: Dict[str, float], kind: str, pool=None):
+        self.node_id = node_id
+        self.index = index              # scheduler row
+        self.resources = dict(resources)
+        self.state = "ALIVE"
+        self.kind = kind                # "local" | "process"
+        self.last_heartbeat = time.monotonic()
+        self.pool = pool                # ProcessWorkerPool for kind=process
+        self.death_reason: Optional[str] = None
+
+
+class ActorEntry:
+    __slots__ = ("actor_id", "name", "namespace", "state", "node_index",
+                 "class_name", "job_id")
+
+    def __init__(self, actor_id: ActorID, name: str, namespace: str,
+                 class_name: str, job_id: Optional[JobID],
+                 node_index: int = -1):
+        self.actor_id = actor_id
+        self.name = name
+        self.namespace = namespace
+        self.state = "PENDING_CREATION"
+        self.node_index = node_index
+        self.class_name = class_name
+        self.job_id = job_id
+
+
+class GcsService:
+    """Node/actor/job tables + KV + pubsub + health checks."""
+
+    def __init__(self, worker):
+        self._worker = worker
+        self._lock = threading.RLock()
+        self._nodes: Dict[NodeID, NodeEntry] = {}
+        self._node_by_index: Dict[int, NodeEntry] = {}
+        self._actors: Dict[ActorID, ActorEntry] = {}
+        self._actor_names: Dict[Tuple[str, str], ActorID] = {}
+        self._jobs: Dict[JobID, Dict[str, Any]] = {}
+        self._kv: Dict[Tuple[str, bytes], bytes] = {}
+        self._subs: Dict[str, Dict[int, Callable[[dict], None]]] = {}
+        self._sub_seq = 0
+        self._health_thread: Optional[threading.Thread] = None
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    # node table (reference: GcsNodeManager)
+    # ------------------------------------------------------------------
+    def register_node(self, node_id: NodeID, index: int,
+                      resources: Dict[str, float], kind: str = "local",
+                      pool=None) -> NodeEntry:
+        entry = NodeEntry(node_id, index, resources, kind, pool)
+        with self._lock:
+            self._nodes[node_id] = entry
+            self._node_by_index[index] = entry
+        self.publish(CH_NODE, {"event": "ALIVE", "node_id": node_id,
+                               "index": index})
+        return entry
+
+    def heartbeat(self, node_id: NodeID) -> None:
+        with self._lock:
+            e = self._nodes.get(node_id)
+            if e is not None:
+                e.last_heartbeat = time.monotonic()
+
+    def mark_node_dead(self, node_id: NodeID, reason: str = "") -> None:
+        with self._lock:
+            e = self._nodes.get(node_id)
+            if e is None or e.state == "DEAD":
+                return
+            e.state = "DEAD"
+            e.death_reason = reason
+        self.publish(CH_NODE, {"event": "DEAD", "node_id": node_id,
+                               "index": e.index, "reason": reason})
+
+    def node_table(self) -> List[NodeEntry]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def node_by_index(self, index: int) -> Optional[NodeEntry]:
+        with self._lock:
+            return self._node_by_index.get(index)
+
+    def alive_process_nodes(self) -> List[NodeEntry]:
+        with self._lock:
+            return [e for e in self._nodes.values()
+                    if e.state == "ALIVE" and e.kind == "process"]
+
+    # ------------------------------------------------------------------
+    # actor table (reference: GcsActorManager — source of truth for
+    # actor metadata and name resolution)
+    # ------------------------------------------------------------------
+    def register_actor(self, actor_id: ActorID, name: str, namespace: str,
+                       class_name: str, job_id=None) -> ActorEntry:
+        entry = ActorEntry(actor_id, name, namespace, class_name, job_id)
+        with self._lock:
+            if name and (namespace, name) in self._actor_names:
+                raise ValueError(
+                    f"actor name {name!r} already taken in namespace "
+                    f"{namespace!r}")
+            self._actors[actor_id] = entry
+            if name:
+                self._actor_names[(namespace, name)] = actor_id
+        self.publish(CH_ACTOR, {"event": "REGISTERED",
+                                "actor_id": actor_id})
+        return entry
+
+    def update_actor_state(self, actor_id: ActorID, state: str,
+                           node_index: int = -1) -> None:
+        with self._lock:
+            e = self._actors.get(actor_id)
+            if e is None:
+                return
+            e.state = state
+            if node_index >= 0:
+                e.node_index = node_index
+            if state == "DEAD" and e.name:
+                self._actor_names.pop((e.namespace, e.name), None)
+        self.publish(CH_ACTOR, {"event": state, "actor_id": actor_id})
+
+    def get_actor_by_name(self, name: str,
+                          namespace: str = "") -> Optional[ActorID]:
+        with self._lock:
+            return self._actor_names.get((namespace, name))
+
+    def actor_table(self) -> List[ActorEntry]:
+        with self._lock:
+            return list(self._actors.values())
+
+    def actors_on_node(self, index: int) -> List[ActorEntry]:
+        with self._lock:
+            return [e for e in self._actors.values()
+                    if e.node_index == index and e.state not in ("DEAD",)]
+
+    # ------------------------------------------------------------------
+    # job table (reference: GcsJobManager)
+    # ------------------------------------------------------------------
+    def register_job(self, job_id: JobID,
+                     metadata: Optional[dict] = None) -> None:
+        with self._lock:
+            self._jobs[job_id] = {"state": "RUNNING",
+                                  "start_time": time.time(),
+                                  **(metadata or {})}
+        self.publish(CH_JOB, {"event": "STARTED", "job_id": job_id})
+
+    def finish_job(self, job_id: JobID) -> None:
+        with self._lock:
+            if job_id in self._jobs:
+                self._jobs[job_id]["state"] = "FINISHED"
+        self.publish(CH_JOB, {"event": "FINISHED", "job_id": job_id})
+
+    def job_table(self) -> Dict[JobID, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._jobs.items()}
+
+    # ------------------------------------------------------------------
+    # KV store (reference: GcsKVManager / internal_kv)
+    # ------------------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes,
+               namespace: str = "") -> None:
+        with self._lock:
+            self._kv[(namespace, bytes(key))] = bytes(value)
+
+    def kv_get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get((namespace, bytes(key)))
+
+    def kv_del(self, key: bytes, namespace: str = "") -> bool:
+        with self._lock:
+            return self._kv.pop((namespace, bytes(key)), None) is not None
+
+    def kv_keys(self, prefix: bytes = b"",
+                namespace: str = "") -> List[bytes]:
+        with self._lock:
+            return [k for (ns, k) in self._kv
+                    if ns == namespace and k.startswith(prefix)]
+
+    # ------------------------------------------------------------------
+    # pubsub (reference: GcsPublisher / src/ray/pubsub/)
+    # ------------------------------------------------------------------
+    def subscribe(self, channel: str,
+                  callback: Callable[[dict], None]) -> int:
+        with self._lock:
+            self._sub_seq += 1
+            self._subs.setdefault(channel, {})[self._sub_seq] = callback
+            return self._sub_seq
+
+    def unsubscribe(self, channel: str, sub_id: int) -> None:
+        with self._lock:
+            self._subs.get(channel, {}).pop(sub_id, None)
+
+    def publish(self, channel: str, message: dict) -> None:
+        with self._lock:
+            callbacks = list(self._subs.get(channel, {}).values())
+        for cb in callbacks:
+            try:
+                cb(message)
+            except Exception:
+                logger.exception("pubsub callback failed on %s", channel)
+
+    # ------------------------------------------------------------------
+    # health checks (reference: GcsHealthCheckManager — periodic pings;
+    # here: process liveness of each node's worker pool)
+    # ------------------------------------------------------------------
+    def start_health_checks(self, interval: float = 0.2) -> None:
+        if self._health_thread is not None:
+            return
+        self._health_thread = threading.Thread(
+            target=self._health_loop, args=(interval,), daemon=True,
+            name="ray_tpu_gcs_health")
+        self._health_thread.start()
+
+    def _health_loop(self, interval: float) -> None:
+        while not self._shutdown:
+            time.sleep(interval)
+            for e in self.alive_process_nodes():
+                pool = e.pool
+                if pool is None:
+                    continue
+                procs = pool.live_process_count()
+                if procs == 0:
+                    logger.warning("health check: node %s has no live "
+                                   "workers; marking DEAD",
+                                   e.node_id.hex()[:16])
+                    self._worker.on_node_failure(
+                        e.node_id, reason="health check: all worker "
+                        "processes dead")
+                else:
+                    self.heartbeat(e.node_id)
+
+    def shutdown(self) -> None:
+        self._shutdown = True

@@ -93,6 +93,7 @@ class PlacementGroupManager:
                 self._pending.remove(pg_id)
         if was == "CREATED":
             self._worker.scheduler.remove_pg(pg_id)
+            self._fail_group_tasks(entry)
             # freed capacity can make other pending groups placeable
             self.poke()
         else:
@@ -102,6 +103,21 @@ class PlacementGroupManager:
                     f"placement group {pg_id.hex()[:16]} removed before "
                     "it was placed"),
                 is_exception=True)
+
+    def _fail_group_tasks(self, entry: _Entry) -> None:
+        """Resolve every queued task of a removed group with an error —
+        their eligibility set is empty forever and get() would hang."""
+        w = self._worker
+        exc = PlacementGroupUnschedulableError(
+            f"placement group {entry.pg_id.hex()[:16]} was removed")
+        for pending in w.scheduler.drain_pg_tasks(entry.pg_id):
+            spec = pending.spec
+            return_ids = (getattr(spec, "_retry_return_ids", None)
+                          or spec.return_ids())
+            for oid in return_ids:
+                w.memory_store.put(oid, exc, is_exception=True)
+                w.scheduler.notify_object_ready(oid)
+            w.task_manager.complete(spec.task_id)
 
     def get(self, pg_id: PlacementGroupID) -> Optional[_Entry]:
         with self._lock:
@@ -174,16 +190,55 @@ class PlacementGroupManager:
             return
         with self._lock:
             self._pending.append(entry.pg_id)
-            # ONE long-lived retry thread: an exit-when-empty design races
-            # poke() (thread observed alive while exiting -> wake lost and
-            # the pending group never retries), so the thread only exits
-            # on shutdown and sleeps eventless while nothing is pending
-            if self._retry_thread is None:
-                self._retry_thread = threading.Thread(
-                    target=self._retry_loop, daemon=True,
-                    name="ray_tpu_pg_retry")
-                self._retry_thread.start()
+            self._ensure_retry_thread_locked()
         self._retry_wake.set()
+
+    def _ensure_retry_thread_locked(self) -> None:
+        # ONE long-lived retry thread: an exit-when-empty design races
+        # poke() (thread observed alive while exiting -> wake lost and
+        # the pending group never retries), so the thread only exits
+        # on shutdown and sleeps eventless while nothing is pending
+        if self._retry_thread is None:
+            self._retry_thread = threading.Thread(
+                target=self._retry_loop, daemon=True,
+                name="ray_tpu_pg_retry")
+            self._retry_thread.start()
+
+    def on_node_dead(self, node_index: int) -> None:
+        """Node death: groups with bundles parented to the dead node lose
+        their reservation and return to PENDING for re-placement on the
+        survivors (reference: GcsPlacementGroupManager reschedules bundles
+        of dead nodes; ready() stays fulfilled across the move).
+
+        Order matters: the old rows are torn down while the group sits in
+        RESCHEDULING — if it went PENDING first, the retry thread could
+        re-place it and the deferred remove_pg would then destroy the NEW
+        rows (same pg_id)."""
+        scheduler = self._worker.scheduler
+        with self._lock:
+            affected = []
+            for e in self._table.values():
+                if e.state != "CREATED":
+                    continue
+                parents = [getattr(scheduler.node_state(r), "parent", -1)
+                           for r in e.rows]
+                if node_index in parents:
+                    affected.append(e)
+            for e in affected:
+                e.state = "RESCHEDULING"
+                e.rows = []
+        for e in affected:
+            scheduler.remove_pg(e.pg_id)
+        with self._lock:
+            for e in affected:
+                if e.state == "RESCHEDULING":
+                    e.state = "PENDING"
+                    if e.pg_id not in self._pending:
+                        self._pending.append(e.pg_id)
+            if affected:
+                self._ensure_retry_thread_locked()
+        if affected:
+            self._retry_wake.set()
 
     def _retry_loop(self) -> None:
         while not self._shutdown:

@@ -98,9 +98,13 @@ class _Handle:
 
 
 class ProcessWorkerPool:
-    def __init__(self, worker, num_workers: int, shm_store):
+    def __init__(self, worker, num_workers: int, shm_store,
+                 node_index: int = 0):
         self._worker = worker
         self._shm = shm_store
+        self.node_index = node_index   # scheduler row this pool serves
+        self._node_dead = False        # node died: fail, don't respawn
+        self._respawn_disabled = False  # chaos: machine gone, no self-heal
         self._lock = threading.Lock()
         self._idle: Deque[_Handle] = collections.deque()
         self._queue: Deque[Tuple[PendingTask, dict]] = collections.deque()
@@ -187,6 +191,58 @@ class ProcessWorkerPool:
         with self._lock:
             return [h.pid for h in self._handles if h.pid is not None]
 
+    def live_process_count(self) -> int:
+        """Workers whose OS process is still running (health checks)."""
+        with self._lock:
+            handles = list(self._handles) + list(self._actor_handles)
+        n = 0
+        for h in handles:
+            if h.proc is not None and h.proc.poll() is None:
+                n += 1
+        return n
+
+    def simulate_machine_death(self) -> None:
+        """Chaos helper: the machine is gone — workers die and the pool
+        cannot self-heal (a lone worker crash respawns a replacement; a
+        dead machine cannot). The control plane is NOT told; the GCS
+        health checker must detect it."""
+        self._respawn_disabled = True
+        with self._lock:
+            handles = list(self._handles) + list(self._actor_handles)
+        for h in handles:
+            if h.proc is not None:
+                try:
+                    h.proc.kill()
+                except Exception:
+                    pass
+
+    def fail_node(self, reason: str) -> None:
+        """The node this pool backs died: fail queued work retriably, kill
+        every worker process, and stop respawning replacements (the
+        monitors' _on_worker_failure handles each running task). Actor
+        workers get killed too; their runtimes observe _on_process_died
+        and restart on another node or go DEAD."""
+        with self._lock:
+            if self._node_dead:
+                return
+            self._node_dead = True
+            queued = list(self._queue)
+            self._queue.clear()
+            handles = list(self._handles) + list(self._actor_handles)
+        for pending, payload in queued:
+            spec = pending.spec
+            return_ids = [ObjectID(b) for b in payload["return_ids"]]
+            exc = rex.NodeDiedError(
+                f"node died before task {spec.name} started: {reason}")
+            retry = self._worker._handle_task_failure(spec, return_ids, exc)
+            self._finish_task(pending, spec.task_id, retry)
+        for h in handles:
+            if h.proc is not None:
+                try:
+                    h.proc.kill()
+                except Exception:
+                    pass
+
     # ------------------------------------------------------------------
     # dedicated actor workers (reference: every actor gets its own
     # worker process; GcsActorScheduler leases one at creation)
@@ -230,6 +286,12 @@ class ProcessWorkerPool:
         exec_task_id = spec.task_id
         return_ids = (getattr(spec, "_retry_return_ids", None)
                       or spec.return_ids())
+        if self._node_dead:
+            exc = rex.NodeDiedError(
+                f"task {spec.name} dispatched to a dead node")
+            retry = self._worker._handle_task_failure(spec, return_ids, exc)
+            self._finish_task(pending, exec_task_id, retry)
+            return
         try:
             payload, borrows = self._build_payload(spec, return_ids)
         except _DepError as e:
@@ -268,6 +330,12 @@ class ProcessWorkerPool:
             return_ids=[o.binary() for o in return_ids],
             inject_prob=self._inject_prob,
         )
+        if spec.placement_group_id is not None \
+                and spec.placement_group_capture_child_tasks:
+            # capture context crosses the process boundary so nested
+            # .remote() calls inherit the group (thread mode uses a
+            # contextvar in Worker._execute_task)
+            payload["pg"] = spec.placement_group_id.binary()
         payload["_contained"] = [r.object_id() for r in contained]
         return payload, contained
 
@@ -435,6 +503,9 @@ class ProcessWorkerPool:
             spec = pending.spec
             if h.force_cancelled:
                 exc: BaseException = rex.TaskCancelledError(h.exec_task_id)
+            elif self._node_dead:
+                exc = rex.NodeDiedError(
+                    f"node died while running {spec.name}")
             else:
                 exc = rex.WorkerCrashedError(
                     f"worker process {h.pid} died while running "
@@ -446,7 +517,8 @@ class ProcessWorkerPool:
                     oid, h.worker_id)
             with self._lock:
                 self._by_task.pop(h.exec_task_id, None)
-        if not shutting_down:
+        if not shutting_down and not self._node_dead \
+                and not self._respawn_disabled:
             # replacement worker keeps the pool at capacity
             replacement = self._spawn()
             with self._lock:
@@ -513,6 +585,8 @@ class ProcessWorkerPool:
         return [o.binary() for o in oids if o in ready]
 
     def _rpc_submit(self, h: _Handle, blob: bytes) -> list:
+        from ray_tpu._private.ids import PlacementGroupID
+
         d = cloudpickle.loads(blob)
         func = cloudpickle.loads(d["func_blob"])
         args, kwargs = cloudpickle.loads(d["args_blob"])
@@ -527,6 +601,10 @@ class ProcessWorkerPool:
             resources=d["resources"],
             max_retries=d["max_retries"],
             retry_exceptions=d["retry_exceptions"],
+            placement_group_id=(PlacementGroupID(d["pg_id"])
+                                if d.get("pg_id") is not None else None),
+            placement_group_bundle_index=d.get("pg_bundle_index", -1),
+            placement_group_capture_child_tasks=d.get("pg_capture", False),
         )
         refs = self._worker.submit_task(spec)
         for r in refs:

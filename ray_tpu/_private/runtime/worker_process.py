@@ -157,6 +157,10 @@ def _dump_spec(spec) -> bytes:
         max_retries=spec.max_retries,
         retry_exceptions=spec.retry_exceptions,
     )
+    if spec.placement_group_id is not None:
+        d["pg_id"] = spec.placement_group_id.binary()
+        d["pg_bundle_index"] = spec.placement_group_bundle_index
+        d["pg_capture"] = spec.placement_group_capture_child_tasks
     return cloudpickle.dumps(d)
 
 
@@ -264,6 +268,13 @@ class _WorkerRunner:
         task_id = TaskID(payload["task_id"])
         self.current_task_id = task_id
         self.put_counter = 0
+        pg_token = None
+        if payload.get("pg") is not None:
+            # placement-group capture context shipped from the owner
+            from ray_tpu._private.ids import PlacementGroupID
+            from ray_tpu.util.placement_group import _current_pg
+
+            pg_token = _current_pg.set(PlacementGroupID(payload["pg"]))
         try:
             args, kwargs = cloudpickle.loads(payload["args_blob"])
             args = tuple(self._resolve(a) for a in args)
@@ -299,6 +310,10 @@ class _WorkerRunner:
                     RuntimeError(f"[unpicklable {type(e).__name__}] {e}"))
             self.conn.send(("err", payload["task_id"], blob, tb))
         finally:
+            if pg_token is not None:
+                from ray_tpu.util.placement_group import _current_pg
+
+                _current_pg.reset(pg_token)
             self.cancelled.discard(task_id.binary())
             self.current_task_id = None
 

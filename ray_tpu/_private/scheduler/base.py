@@ -26,6 +26,13 @@ class SchedulerBase:
     def submit(self, task: PendingTask) -> None:
         raise NotImplementedError
 
+    def node_state(self, index: int):
+        """NodeState at a row (locked read). None if out of range."""
+        raise NotImplementedError
+
+    def node_count(self) -> int:
+        raise NotImplementedError
+
     def notify_object_ready(self, object_id: ObjectID) -> None:
         """An object a pending task depends on became available."""
         raise NotImplementedError

@@ -184,6 +184,28 @@ class EventScheduler(SchedulerBase):
             self._waiters.clear()
             self._dep_count.clear()
 
+    def node_state(self, index: int) -> Optional[NodeState]:
+        with self._lock:
+            return self._nodes[index] if 0 <= index < len(self._nodes) \
+                else None
+
+    def try_allocate(self, index: int, resources: Dict[str, float]) -> bool:
+        """Directly charge a row if it fits (actor restart-elsewhere:
+        the replacement node must account for the actor's resources)."""
+        vec = resources_to_vector(resources)
+        with self._lock:
+            if not (0 <= index < len(self._nodes)):
+                return False
+            n = self._nodes[index]
+            if n.fits(vec) and any(c > 0 for c in n.capacity):
+                n.allocate(vec)
+                return True
+            return False
+
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
     # -- node management (used by the virtual cluster test util) -----------
     def add_node(self, node: NodeState) -> int:
         to_dispatch = []
@@ -253,6 +275,42 @@ class EventScheduler(SchedulerBase):
         self._run_dispatch(to_dispatch)
         return rows
 
+    def drain_pg_tasks(self, pg_id) -> List[PendingTask]:
+        """Remove and return every not-yet-dispatched task targeting the
+        group (its rows are gone; leaving them queued would hang their
+        callers forever)."""
+        pid = pg_id.binary()
+
+        def match(t: PendingTask) -> bool:
+            p = t.spec.placement_group_id
+            return p is not None and p.binary() == pid
+
+        out: List[PendingTask] = []
+        with self._lock:
+            for bucket in (self._ready, self._infeasible):
+                kept = [t for t in bucket if not match(t)]
+                out.extend(t for t in bucket if match(t))
+                bucket.clear()
+                bucket.extend(kept)
+            for oid, waiters in list(self._waiters.items()):
+                kept = [t for t in waiters if not match(t)]
+                out.extend(t for t in waiters if match(t))
+                if kept:
+                    self._waiters[oid] = kept
+                else:
+                    del self._waiters[oid]
+            seen = set()
+            uniq = []
+            for t in out:
+                tid = t.spec.task_id
+                if tid in seen:
+                    continue
+                seen.add(tid)
+                uniq.append(t)
+                self._tasks.pop(tid, None)
+                self._dep_count.pop(tid, None)
+        return uniq
+
     def remove_pg(self, pg_id) -> None:
         """Release a placement group's bundle rows back to their parents.
 
@@ -284,7 +342,11 @@ class EventScheduler(SchedulerBase):
             if task.cancelled:
                 continue
             demand = task.spec.resource_vector()
-            placement = task.spec.placement()
+            # resolve soft affinity ONCE: the fallback placement must be
+            # used for the infeasibility check too, or a soft-aff task
+            # whose fallback nodes are momentarily full parks forever
+            placement = self._effective_placement_locked(
+                task.spec.placement())
             idx = self._pick_node(demand, threshold, placement)
             if idx is None:
                 if not any(self._eligible(i, placement) and n.feasible(demand)
@@ -299,6 +361,18 @@ class EventScheduler(SchedulerBase):
             out.append(task)
         self._ready.extend(deferred)
         return out
+
+    def _effective_placement_locked(self, placement: Tuple) -> Tuple:
+        """Soft node affinity whose target is missing/dead resolves to the
+        default placement (mirrors TensorScheduler._mask_row)."""
+        if placement[0] == "aff" and len(placement) > 2 and placement[2]:
+            target_alive = any(
+                self._eligible(i, placement)
+                and any(c > 0 for c in n.capacity)
+                for i, n in enumerate(self._nodes))
+            if not target_alive:
+                return ("default",)
+        return placement
 
     def _eligible(self, idx: int, placement: Tuple) -> bool:
         node = self._nodes[idx]

@@ -186,6 +186,30 @@ class TensorScheduler(SchedulerBase):
             self._wake.notify()
         self._tick_thread.join(timeout=2.0)
 
+    def node_state(self, index: int) -> Optional[NodeState]:
+        with self._lock:
+            return self._node_states[index] \
+                if 0 <= index < len(self._node_states) else None
+
+    def try_allocate(self, index: int, resources: Dict[str, float]) -> bool:
+        """Directly charge a row if it fits (actor restart-elsewhere:
+        the replacement node must account for the actor's resources)."""
+        with self._wake:
+            if not (0 <= index < len(self._node_states)):
+                return False
+            vec = np.asarray(resources_to_vector(resources),
+                             dtype=np.float32)[:self._cap.shape[1]]
+            if self._cap[index].any() \
+                    and (self._avail[index] >= vec - 1e-6).all():
+                self._avail[index] -= vec
+                self._node_states[index].allocate(tuple(vec.tolist()))
+                return True
+            return False
+
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self._node_states)
+
     # -- node management ---------------------------------------------------
     def add_node(self, node: NodeState) -> int:
         with self._wake:
@@ -258,6 +282,29 @@ class TensorScheduler(SchedulerBase):
             self._dirty = True
             self._wake.notify()
             return rows
+
+    def drain_pg_tasks(self, pg_id) -> List[PendingTask]:
+        """Remove and return every not-yet-dispatched task targeting the
+        group (its rows are gone; leaving them queued would hang their
+        callers forever)."""
+        pid = pg_id.binary()
+
+        def match(task) -> bool:
+            p = task.spec.placement_group_id
+            return p is not None and p.binary() == pid
+
+        out: List[PendingTask] = []
+        with self._wake:
+            kept = collections.deque()
+            while self._submit_q:
+                t = self._submit_q.popleft()
+                (out if match(t) else kept).append(t)
+            self._submit_q.extend(kept)
+            for slot, task in list(self._tasks.items()):
+                if self._state[slot] == WAITING and match(task):
+                    out.append(task)
+                    self._release_slot(slot)
+        return out
 
     def remove_pg(self, pg_id) -> None:
         """Release a group's bundle rows back to their parents.

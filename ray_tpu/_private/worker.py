@@ -23,8 +23,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import exceptions as rex
 from ray_tpu._private.config import GLOBAL_CONFIG
-from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID,
-                                  _Counter)
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
+                                  WorkerID, _Counter)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import MemoryStore
 from ray_tpu._private.ref_counting import ReferenceCounter
@@ -82,7 +82,8 @@ class TaskManager:
     def should_retry(self, spec: TaskSpec, exc: BaseException) -> bool:
         if spec.attempt_number >= spec.max_retries:
             return False
-        if isinstance(exc, (rex.WorkerCrashedError, rex.OutOfMemoryError)):
+        if isinstance(exc, (rex.WorkerCrashedError, rex.OutOfMemoryError,
+                            rex.NodeDiedError)):
             return True  # system failures always retriable up to max_retries
         retry_exc = spec.retry_exceptions
         if retry_exc is True:
@@ -153,7 +154,9 @@ class Worker:
                                                   self.shm_store)
 
         # node 0 = "this node"; virtual cluster tests add more
-        node = NodeState((capacity_cpu, _detect_tpu_count(), 1e18, 1e18))
+        self.node_id = NodeID.from_random()
+        node = NodeState((capacity_cpu, _detect_tpu_count(), 1e18, 1e18),
+                         node_id=self.node_id)
         contains = self.memory_store.contains
         if scheduler_factory is not None:
             self.scheduler: SchedulerBase = scheduler_factory(
@@ -161,13 +164,27 @@ class Worker:
         else:
             self.scheduler = EventScheduler([node], self._dispatch, contains)
 
+        # control plane (node/actor/job tables, KV, pubsub, health checks)
+        from ray_tpu._private.gcs import GcsService
+        self.gcs = GcsService(self)
+        self.gcs.register_node(
+            self.node_id, 0,
+            {"CPU": capacity_cpu, "TPU": _detect_tpu_count()},
+            kind="process" if self.process_pool is not None else "local",
+            pool=self.process_pool)
+        self.gcs.register_job(self.job_id)
+        # per-node worker pools for virtual multi-node clusters
+        # (row -> ProcessWorkerPool); node 0's pool is process_pool
+        self._node_pools: Dict[int, Any] = {}
+        if self.process_pool is not None:
+            self._node_pools[0] = self.process_pool
+
         # placement groups (bundle reservation over the scheduler)
         from ray_tpu._private.placement_groups import PlacementGroupManager
         self.placement_groups = PlacementGroupManager(self)
 
         # actors: ActorID -> _ActorRuntime (see actor.py)
         self.actors: Dict[ActorID, Any] = {}
-        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
         self.dead_actors: set = set()
         self._actors_lock = threading.Lock()
 
@@ -328,15 +345,28 @@ class Worker:
     # ------------------------------------------------------------------
     # Execution (dispatcher target)
     # ------------------------------------------------------------------
+    def pool_for_node(self, node_index: int):
+        """ProcessWorkerPool backing a scheduler row (bundle rows resolve
+        through their parent node), or None for host-local execution."""
+        pool = self._node_pools.get(node_index)
+        if pool is not None:
+            return pool
+        ns = self.scheduler.node_state(node_index)
+        if ns is not None and ns.is_bundle and ns.parent >= 0:
+            return self._node_pools.get(ns.parent)
+        return None
+
     def _dispatch(self, pending: PendingTask) -> None:
         boot = getattr(pending.spec, "_actor_boot", None)
+        pool = self.pool_for_node(pending.node_index)
         if boot is not None:
             self._pool.submit(self._boot_actor, pending, boot)
-        elif (self.process_pool is not None
+        elif (pool is not None
               and pending.spec.task_type == TaskType.NORMAL_TASK):
             # lease grant: the decision becomes a payload shipped to a
-            # worker process (payload build runs off the tick thread)
-            self._pool.submit(self.process_pool.run_task, pending)
+            # worker process on the ASSIGNED node (payload build runs off
+            # the tick thread)
+            self._pool.submit(pool.run_task, pending)
         else:
             self._pool.submit(self._execute_task, pending)
 
@@ -345,6 +375,62 @@ class Worker:
             boot(pending, pending.node_index)
         except Exception:
             logger.exception("actor bootstrap failed")
+
+    # ------------------------------------------------------------------
+    # Virtual multi-node (reference: python/ray/cluster_utils.py — each
+    # added node is a REAL per-node runtime: its own exec'd worker
+    # processes behind its own pool, with declared resources)
+    # ------------------------------------------------------------------
+    def add_cluster_node(self, num_cpus: float = 4.0, num_tpus: float = 0.0,
+                         num_workers: Optional[int] = None,
+                         resources: Optional[Dict[str, float]] = None):
+        from ray_tpu._private.runtime.process_pool import ProcessWorkerPool
+        from ray_tpu._private.runtime.shm_store import ShmObjectStore
+
+        if self.shm_store is None:
+            # thread-mode head: the cluster's shared object arena appears
+            # with the first process-backed node
+            self.shm_store = ShmObjectStore(GLOBAL_CONFIG.object_store_memory)
+        custom = sum((resources or {}).values())
+        node_id = NodeID.from_random()
+        state = NodeState((num_cpus, num_tpus, 1e18, custom or 1e18),
+                          node_id=node_id)
+        row = self.scheduler.add_node(state)
+        pool = ProcessWorkerPool(self, num_workers or max(int(num_cpus), 1),
+                                 self.shm_store, node_index=row)
+        self._node_pools[row] = pool
+        entry = self.gcs.register_node(
+            node_id, row, {"CPU": num_cpus, "TPU": num_tpus,
+                           **(resources or {})},
+            kind="process", pool=pool)
+        self.gcs.start_health_checks()
+        return entry
+
+    def on_node_failure(self, node_id: NodeID, reason: str = "") -> None:
+        """Node death: mark dead, stop scheduling to it, fail/retry its
+        in-flight work, reschedule its placement-group bundles, and fail
+        or restart its actors (reference: NodeManager/GcsNodeManager
+        death handling + lineage-driven resubmission)."""
+        entry = None
+        for e in self.gcs.node_table():
+            if e.node_id == node_id:
+                entry = e
+                break
+        if entry is None or entry.state == "DEAD":
+            return
+        self.gcs.mark_node_dead(node_id, reason)
+        # 1) no new assignments to the node (also invalidates in-flight
+        #    snapshot decisions at apply time)
+        self.scheduler.remove_node(entry.index)
+        # 2) placement groups with bundles on the node reschedule
+        self.placement_groups.on_node_dead(entry.index)
+        # 3) fail queued + running work retriably; kill worker processes.
+        #    Monitors drive per-task retries; actor runtimes observe their
+        #    worker's death and restart elsewhere or go DEAD.
+        pool = self._node_pools.pop(entry.index, None)
+        if pool is not None:
+            pool.fail_node(reason or "node removed")
+        self.placement_groups.poke()
 
     def _execute_task(self, pending: PendingTask) -> None:
         spec = pending.spec
@@ -518,6 +604,10 @@ class Worker:
             except Exception:
                 pass
         self.scheduler.shutdown()
+        self.gcs.shutdown()
+        for row, pool in list(self._node_pools.items()):
+            if pool is not self.process_pool:
+                pool.shutdown()
         if self.process_pool is not None:
             self.process_pool.shutdown()
         self._pool.shutdown(wait=False, cancel_futures=True)

@@ -96,6 +96,9 @@ class _ActorRuntime:
         self.detached = opts.get("lifetime") == "detached"
         self._creation_spec = creation_spec
         self._creation_node_index = creation_node_index
+        # the row currently charged for the actor's lifetime resources;
+        # restart-elsewhere moves the charge (and release at death)
+        self._current_node_index = creation_node_index
         self._explicit_resources = bool(
             opts.get("resources") or opts.get("num_tpus")
             or (opts.get("num_cpus") not in (None, 1.0, 1)))
@@ -142,7 +145,7 @@ class _ActorRuntime:
             # default actors release their creation CPU once alive
             if not self._explicit_resources:
                 self.worker.scheduler.notify_task_finished(
-                    self._creation_spec.task_id, self._creation_node_index,
+                    self._creation_spec.task_id, self._current_node_index,
                     self._creation_spec.resources)
 
     def _sync_main(self, thread_index: int):
@@ -342,13 +345,12 @@ class _ActorRuntime:
         # lifetime-held resources released at death
         if self._explicit_resources:
             self.worker.scheduler.notify_task_finished(
-                self._creation_spec.task_id, self._creation_node_index,
+                self._creation_spec.task_id, self._current_node_index,
                 self._creation_spec.resources)
         with self.worker._actors_lock:
             self.worker.actors.pop(self.actor_id, None)
             self.worker.dead_actors.add(self.actor_id)
-            if self.name:
-                self.worker.named_actors.pop((self.namespace, self.name), None)
+        self.worker.gcs.update_actor_state(self.actor_id, "DEAD")
 
 
 class _ProcessActorRuntime(_ActorRuntime):
@@ -363,11 +365,52 @@ class _ProcessActorRuntime(_ActorRuntime):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        self._pool = self.worker.process_pool
+        self._pool = (self.worker.pool_for_node(self._creation_node_index)
+                      or self.worker.process_pool)
         self._h = None
         self._round_done = threading.Event()
         self._round_result = None
         self._restart_lock = threading.Lock()
+
+    def _select_pool(self):
+        """Pool to (re)spawn the actor worker on.
+
+        Same node while it lives (resources stay charged there). On node
+        death: a placement-grouped actor follows its (rescheduled) bundle
+        rows; a plain actor moves to an alive node that can ACCEPT its
+        resource charge (scheduler.try_allocate) so the replacement node
+        is never overcommitted. Returns None when nothing qualifies."""
+        w = self.worker
+        spec = self._creation_spec
+        if self._pool is not None and not self._pool._node_dead:
+            return self._pool
+        if spec.placement_group_id is not None:
+            entry = w.placement_groups.get(spec.placement_group_id)
+            if entry is None or entry.state != "CREATED":
+                return None
+            bindex = spec.placement_group_bundle_index
+            rows = entry.rows if bindex < 0 else (
+                [entry.rows[bindex]] if bindex < len(entry.rows) else [])
+            for r in rows:
+                ns = w.scheduler.node_state(r)
+                if ns is None or ns.defunct:
+                    continue
+                pool = w.pool_for_node(r)
+                if pool is None or pool._node_dead:
+                    continue
+                if not self._explicit_resources \
+                        or w.scheduler.try_allocate(r, spec.resources):
+                    self._current_node_index = r
+                    return pool
+            return None
+        for e in w.gcs.alive_process_nodes():
+            if e.pool is None or e.pool._node_dead:
+                continue
+            if not self._explicit_resources \
+                    or w.scheduler.try_allocate(e.index, spec.resources):
+                self._current_node_index = e.index
+                return e.pool
+        return None
 
     def start(self):
         self._h = self._pool.spawn_actor_worker(self)
@@ -517,47 +560,73 @@ class _ProcessActorRuntime(_ActorRuntime):
             self.init_done.set()
             if not self._explicit_resources:
                 self.worker.scheduler.notify_task_finished(
-                    self._creation_spec.task_id, self._creation_node_index,
+                    self._creation_spec.task_id, self._current_node_index,
                     self._creation_spec.resources)
 
     def _execute_call(self, call: _Call):
         import cloudpickle
         import time as _time
 
-        # a restart may be in flight; calls queue until it settles
-        deadline = _time.monotonic() + 60
-        while self.state == ActorState.RESTARTING \
-                and _time.monotonic() < deadline:
-            _time.sleep(0.005)
-        if self.state == ActorState.DEAD:
-            self._store_error(call, self.death_cause
-                              or rex.ActorDiedError(actor_id=self.actor_id))
-            return
-        h = self._h
-        try:
-            payload, borrows = self._build_payload(
-                h, call.task_id, call.return_ids, call.args, call.kwargs,
-                dict(method=call.method_name))
-        except Exception as e:
-            self._store_error(call, e)
-            return
-        res = self._remote_round("actor_call", payload)
-        if res[0] == "done":
-            self._pool.store_result_entries(call.return_ids, res[1])
-        elif res[0] == "err":
+        max_task_retries = int(self.opts.get("max_task_retries", 0))
+        attempt = 0
+        failed_h = None
+        while True:
+            # a restart may be in flight; calls queue until it settles.
+            # After a died round, ALSO wait for the handle to actually
+            # change: the failing send can observe the old handle before
+            # stop() swaps it, and instant retries would burn every
+            # attempt against the same dead worker.
+            deadline = _time.monotonic() + 60
+            while (self.state == ActorState.RESTARTING or self._h is None
+                   or self._h is failed_h) \
+                    and self.state != ActorState.DEAD \
+                    and _time.monotonic() < deadline:
+                _time.sleep(0.005)
+            if self.state == ActorState.DEAD:
+                self._store_error(call, self.death_cause
+                                  or rex.ActorDiedError(
+                                      actor_id=self.actor_id))
+                return
+            h = self._h
+            if h is None:
+                self._store_error(call, rex.ActorUnavailableError(
+                    f"actor worker unavailable for {call.method_name}"))
+                return
             try:
-                exc = cloudpickle.loads(res[1])
-            except Exception:
-                exc = RuntimeError("actor call failed (undecodable)")
-            self._store_error(call, exc)
-        else:  # worker died mid-call; restart handled by _on_process_died
-            self._store_error(call, rex.ActorDiedError(
-                f"actor worker died during {call.method_name}: {res[1]}",
-                actor_id=self.actor_id))
-        # results registered first, THEN borrows dropped (a returned ref
-        # gets its driver-side local ref before the borrow goes away)
-        self._remove_borrows(h, borrows)
-        self.num_executed += 1
+                payload, borrows = self._build_payload(
+                    h, call.task_id, call.return_ids, call.args, call.kwargs,
+                    dict(method=call.method_name))
+            except Exception as e:
+                self._store_error(call, e)
+                return
+            res = self._remote_round("actor_call", payload)
+            if res[0] == "done":
+                self._pool.store_result_entries(call.return_ids, res[1])
+            elif res[0] == "err":
+                try:
+                    exc = cloudpickle.loads(res[1])
+                except Exception:
+                    exc = RuntimeError("actor call failed (undecodable)")
+                self._store_error(call, exc)
+            elif attempt < max_task_retries:
+                # worker died mid-call (restart driven by
+                # _on_process_died): retry on the restarted instance
+                # (reference: max_task_retries re-runs actor tasks after
+                # restart, ray: python/ray/actor.py)
+                attempt += 1
+                failed_h = h
+                self._remove_borrows(h, borrows)
+                continue
+            else:
+                self._store_error(call, rex.ActorDiedError(
+                    f"actor worker died during {call.method_name}: "
+                    f"{res[1]}", actor_id=self.actor_id))
+            # results registered first, THEN borrows dropped (a returned
+            # ref gets its driver-side local ref before the borrow goes
+            # away)
+            self._remove_borrows(h, borrows)
+            self.num_executed += 1
+            return
 
     def stop(self, no_restart: bool = True,
              cause: Optional[BaseException] = None):
@@ -578,17 +647,27 @@ class _ProcessActorRuntime(_ActorRuntime):
                     self._round_result = ("died", cause or "killed")
                     self._round_done.set()
             if can_restart:
-                self.num_restarts += 1
-                self.state = ActorState.RESTARTING
-                self._h = self._pool.spawn_actor_worker(self)
-                res = self._create_remote()
-                if res is True:
-                    self.state = ActorState.ALIVE
-                    return
-                self.death_cause = (
-                    res if isinstance(res, BaseException)
-                    else rex.TaskError(
-                        f"{self.cls.__name__}.__init__ (restart)", res, ""))
+                pool = self._select_pool()
+                if pool is None:
+                    cause = cause or rex.ActorDiedError(
+                        "no alive node to restart the actor on",
+                        actor_id=self.actor_id)
+                else:
+                    self.num_restarts += 1
+                    self.state = ActorState.RESTARTING
+                    self._pool = pool
+                    self._h = pool.spawn_actor_worker(self)
+                    res = self._create_remote()
+                    if res is True:
+                        self.state = ActorState.ALIVE
+                        self.worker.gcs.update_actor_state(
+                            self.actor_id, "ALIVE", pool.node_index)
+                        return
+                    self.death_cause = (
+                        res if isinstance(res, BaseException)
+                        else rex.TaskError(
+                            f"{self.cls.__name__}.__init__ (restart)",
+                            res, ""))
             self.state = ActorState.DEAD
             self.death_cause = self.death_cause or cause \
                 or rex.ActorDiedError("actor killed via ray_tpu.kill()",
@@ -599,14 +678,12 @@ class _ProcessActorRuntime(_ActorRuntime):
             self._drain_with_error()
             if self._explicit_resources:
                 self.worker.scheduler.notify_task_finished(
-                    self._creation_spec.task_id, self._creation_node_index,
+                    self._creation_spec.task_id, self._current_node_index,
                     self._creation_spec.resources)
             with self.worker._actors_lock:
                 self.worker.actors.pop(self.actor_id, None)
                 self.worker.dead_actors.add(self.actor_id)
-                if self.name:
-                    self.worker.named_actors.pop(
-                        (self.namespace, self.name), None)
+            self.worker.gcs.update_actor_state(self.actor_id, "DEAD")
 
 
 def _creation_object_id(actor_id: ActorID) -> ObjectID:
@@ -732,12 +809,10 @@ class ActorClass:
         opts = self._options
         name = opts.get("name")
         namespace = opts.get("namespace") or "default"
-        if name:
-            with worker._actors_lock:
-                if (namespace, name) in worker.named_actors:
-                    raise ValueError(
-                        f"actor name {name!r} already taken in namespace "
-                        f"{namespace!r}")
+        if name and worker.gcs.get_actor_by_name(name, namespace) is not None:
+            raise ValueError(
+                f"actor name {name!r} already taken in namespace "
+                f"{namespace!r}")
 
         actor_id = ActorID.of(worker.job_id)
         creation_task_id = TaskID.for_actor_task(actor_id, 0)
@@ -777,23 +852,27 @@ class ActorClass:
         cls, copts = self._cls, dict(opts)
         is_async = any(inspect.iscoroutinefunction(m) for _, m in
                        inspect.getmembers(cls, inspect.isfunction))
+        # actor registry: the GCS actor table is the source of truth
+        # (reference: GcsActorManager)
+        worker.gcs.register_actor(actor_id, name or "", namespace,
+                                  self._cls.__name__, worker.job_id)
 
         def create(pending, node_index, _worker=worker):
             # process mode: sync single-threaded actors get a dedicated
-            # worker process (reference behavior); async/threaded actors
-            # stay host-side (their event loop / thread pool lives with
-            # the driver until process-side loops land)
+            # worker process on the ASSIGNED node (reference behavior);
+            # async/threaded actors stay host-side (their event loop /
+            # thread pool lives with the driver until process-side loops
+            # land)
             rt_cls = _ActorRuntime
-            if (_worker.process_pool is not None and not is_async
+            if (_worker.pool_for_node(node_index) is not None and not is_async
                     and int(copts.get("max_concurrency", 1)) == 1):
                 rt_cls = _ProcessActorRuntime
             rt = rt_cls(_worker, actor_id, cls, args, kwargs, copts,
                         spec, node_index)
             with _worker._actors_lock:
                 _worker.actors[actor_id] = rt
-                if name:
-                    _worker.named_actors[(rt.namespace, name)] = actor_id
             rt.start()
+            _worker.gcs.update_actor_state(actor_id, "ALIVE", node_index)
 
         from ray_tpu._private.scheduler.base import PendingTask
         deps = [a.object_id() for a in args if isinstance(a, ObjectRef)]
@@ -817,12 +896,15 @@ def _submit_actor_creation(worker, pending, create):
 
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     worker = worker_mod.get_worker()
+    actor_id = worker.gcs.get_actor_by_name(name, namespace)
+    if actor_id is None:
+        raise ValueError(f"no actor named {name!r} in namespace "
+                         f"{namespace!r}")
     with worker._actors_lock:
-        actor_id = worker.named_actors.get((namespace, name))
-        if actor_id is None:
-            raise ValueError(f"no actor named {name!r} in namespace "
-                             f"{namespace!r}")
-        rt = worker.actors[actor_id]
+        rt = worker.actors.get(actor_id)
+    if rt is None:
+        raise ValueError(f"actor {name!r} is registered but not running "
+                         "(still being created, or dead)")
     return ActorHandle(actor_id, rt.cls.__name__)
 
 

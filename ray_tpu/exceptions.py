@@ -63,6 +63,12 @@ def _rebuild_task_error(function_name, cause, tb_str):
     return TaskError(function_name, cause, tb_str).as_instanceof_cause()
 
 
+class NodeDiedError(RayTpuError):
+    """The node a task/actor was placed on died (reference:
+    ray.exceptions.NodeDiedError; detected by GCS health checks or
+    explicit Cluster.remove_node)."""
+
+
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 

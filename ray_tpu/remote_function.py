@@ -141,9 +141,16 @@ def _validate_bundle_fit(worker, pg_id, bundle_index, resources) -> None:
     otherwise they would wait forever (reference raises the same way,
     ray: python/ray/util/placement_group.py check_placement_group_index +
     resource validation)."""
-    entry = worker.placement_groups.get(pg_id)
+    manager = getattr(worker, "placement_groups", None)
+    if manager is None:
+        return  # worker-process shim: the owner validates at admission
+    entry = manager.get(pg_id)
     if entry is None:
         return
+    if entry.state in ("REMOVED", "INFEASIBLE"):
+        raise ValueError(
+            f"placement group {pg_id.hex()[:16]} is {entry.state} and "
+            "cannot accept tasks")
     import numpy as np
 
     from ray_tpu._private.task_spec import resources_to_vector

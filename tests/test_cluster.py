@@ -1,0 +1,206 @@
+"""Virtual multi-node cluster + GCS control plane.
+
+Reference pattern: python/ray/cluster_utils.py tests — real per-node
+runtimes on one machine, node death mid-run, rescheduling, actor
+restart. Driven through the public Cluster API and the GCS tables.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.cluster_utils import Cluster
+
+
+def wait_for(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster(initialize_head=True,
+                head_node_args=dict(num_cpus=2, num_workers=2,
+                                    scheduler="tensor"))
+    yield c
+    c.shutdown()
+
+
+class TestGcsTables:
+    def test_node_table(self, cluster):
+        w = worker_mod.get_worker()
+        assert len(w.gcs.node_table()) == 1
+        n1 = cluster.add_node(num_cpus=4)
+        n2 = cluster.add_node(num_cpus=4)
+        table = {e.node_id: e for e in w.gcs.node_table()}
+        assert len(table) == 3
+        assert table[n1.node_id].state == "ALIVE"
+        assert ray_tpu.cluster_resources()["CPU"] == 10
+        cluster.remove_node(n2)
+        assert wait_for(lambda: n2.state == "DEAD")
+        assert ray_tpu.cluster_resources()["CPU"] == 6
+
+    def test_job_table(self, cluster):
+        w = worker_mod.get_worker()
+        jobs = w.gcs.job_table()
+        assert w.job_id in jobs and jobs[w.job_id]["state"] == "RUNNING"
+
+    def test_kv_store(self, cluster):
+        kv = worker_mod.get_worker().gcs
+        kv.kv_put(b"k1", b"v1")
+        kv.kv_put(b"k2", b"v2", namespace="ns")
+        assert kv.kv_get(b"k1") == b"v1"
+        assert kv.kv_get(b"k1", namespace="ns") is None
+        assert kv.kv_get(b"k2", namespace="ns") == b"v2"
+        assert set(kv.kv_keys(b"k")) == {b"k1"}
+        assert kv.kv_del(b"k1") is True
+        assert kv.kv_get(b"k1") is None
+
+    def test_pubsub(self, cluster):
+        w = worker_mod.get_worker()
+        seen = []
+        sub = w.gcs.subscribe("NODE", seen.append)
+        n = cluster.add_node(num_cpus=1)
+        assert any(m["event"] == "ALIVE" and m["node_id"] == n.node_id
+                   for m in seen)
+        cluster.remove_node(n)
+        assert wait_for(lambda: any(m["event"] == "DEAD" for m in seen))
+        w.gcs.unsubscribe("NODE", sub)
+
+    def test_actor_table(self, cluster):
+        w = worker_mod.get_worker()
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.options(name="tabled").remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=10) == "pong"
+        # ALIVE is published by the boot thread right after start();
+        # the first method reply can race it by a few microseconds
+        assert wait_for(
+            lambda: {e.name: e for e in
+                     w.gcs.actor_table()}["tabled"].state == "ALIVE")
+        assert w.gcs.get_actor_by_name("tabled", "default") is not None
+        ray_tpu.kill(a)
+        assert wait_for(
+            lambda: {e.name: e for e in
+                     w.gcs.actor_table()}["tabled"].state == "DEAD")
+        assert w.gcs.get_actor_by_name("tabled", "default") is None
+
+
+@ray_tpu.remote(max_retries=3)
+def sq(x):
+    return x * x
+
+
+class TestMultiNodeExecution:
+    def test_tasks_run_across_nodes(self, cluster):
+        cluster.add_node(num_cpus=4, num_workers=2)
+        cluster.add_node(num_cpus=4, num_workers=2)
+        cluster.wait_for_nodes()
+        out = ray_tpu.get([sq.remote(i) for i in range(40)], timeout=60)
+        assert out == [i * i for i in range(40)]
+
+    def test_remove_node_mid_run_reschedules(self, cluster):
+        """The VERDICT 'done when': killing a node mid-run re-schedules its
+        queued tasks onto survivors and the job completes."""
+        n1 = cluster.add_node(num_cpus=4, num_workers=2)
+        n2 = cluster.add_node(num_cpus=4, num_workers=2)
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote(max_retries=5)
+        def slow(i):
+            time.sleep(0.15)
+            return i
+
+        refs = [slow.remote(i) for i in range(30)]
+        time.sleep(0.2)  # let tasks land on both nodes
+        cluster.remove_node(n1)
+        out = ray_tpu.get(refs, timeout=90)
+        assert out == list(range(30))
+        assert n1.state == "DEAD"
+
+    def test_health_check_detects_killed_processes(self, cluster):
+        """Chaos: SIGKILL a node's workers without telling anyone; the GCS
+        health checker must mark it dead and work must still finish."""
+        n1 = cluster.add_node(num_cpus=4, num_workers=2)
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote(max_retries=5)
+        def slow(i):
+            time.sleep(0.1)
+            return i
+
+        refs = [slow.remote(i) for i in range(20)]
+        time.sleep(0.15)
+        n1.kill_worker_processes()
+        out = ray_tpu.get(refs, timeout=90)
+        assert out == list(range(20))
+        assert wait_for(lambda: n1.state == "DEAD", timeout=15)
+
+    def test_actor_restarts_on_surviving_node(self, cluster):
+        n1 = cluster.add_node(num_cpus=4, num_workers=1)
+        n2 = cluster.add_node(num_cpus=4, num_workers=1)
+        cluster.wait_for_nodes()
+        w = worker_mod.get_worker()
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        # pin creation to n1 via node affinity
+        from ray_tpu.util import NodeAffinitySchedulingStrategy
+
+        a = Counter.options(
+            max_restarts=2, max_task_retries=2,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=n1.node_id, soft=True)).remote()
+        assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+        rt = w.actors[a._actor_id]
+        assert rt._pool.node_index == n1.index
+
+        cluster.remove_node(n1)
+        # restart elsewhere: state resets (fresh __init__); the call rides
+        # max_task_retries across the restart
+        assert ray_tpu.get(a.incr.remote(), timeout=60) == 1
+        assert w.actors[a._actor_id]._pool.node_index != n1.index
+        assert w.actors[a._actor_id].state.name == "ALIVE"
+
+    def test_pg_bundles_reschedule_on_node_death(self, cluster):
+        from ray_tpu.util import placement_group, placement_group_table
+
+        n1 = cluster.add_node(num_cpus=4, num_workers=1)
+        cluster.add_node(num_cpus=4, num_workers=1)
+        cluster.wait_for_nodes()
+        w = worker_mod.get_worker()
+
+        # head has 2 CPUs: a 4-CPU bundle only fits an added node
+        pg = placement_group([{"CPU": 4}], strategy="PACK")
+        assert pg.wait(10)
+        entry = w.placement_groups.get(pg.id)
+        nodes = getattr(w.scheduler, "_node_states", None) or \
+            w.scheduler._nodes
+        parent0 = nodes[entry.rows[0]].parent
+        victim = n1 if parent0 == n1.index else \
+            next(n for n in cluster.list_all_nodes if n.index == parent0)
+        cluster.remove_node(victim)
+        assert wait_for(
+            lambda: placement_group_table()[pg.id.hex()]["state"]
+            == "CREATED"
+            and nodes[w.placement_groups.get(pg.id).rows[0]].parent
+            != victim.index,
+            timeout=15)

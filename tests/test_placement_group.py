@@ -307,6 +307,65 @@ class TestPlacementGroupAPI:
         assert ray_tpu.get(a.spawn.remote(), timeout=15) == pg.id.hex()
         ray_tpu.kill(a)
 
+    def test_removed_pg_fails_waiting_tasks(self, pg_cluster):
+        """Tasks queued against a group whose removal empties their
+        eligibility set must error, not hang."""
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(10)
+        strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+
+        @ray_tpu.remote(num_cpus=1)
+        def blocker():
+            import time
+
+            time.sleep(0.8)
+            return True
+
+        # saturate the single 1-CPU bundle, then queue another task
+        first = blocker.options(scheduling_strategy=strat).remote()
+        import time
+
+        time.sleep(0.15)
+        queued = blocker.options(scheduling_strategy=strat).remote()
+        remove_placement_group(pg)
+        with pytest.raises(PlacementGroupUnschedulableError):
+            ray_tpu.get(queued, timeout=10)
+        assert ray_tpu.get(first, timeout=10) is True  # in-flight completes
+        # submission AFTER removal is rejected outright
+        with pytest.raises(ValueError):
+            blocker.options(scheduling_strategy=strat).remote()
+
+    def test_capture_child_tasks_process_mode(self):
+        """The capture context must cross the process boundary."""
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process"})
+        try:
+            pg = placement_group([{"CPU": 2}], strategy="PACK")
+            assert pg.wait(10)
+
+            @ray_tpu.remote
+            def child():
+                from ray_tpu.util.placement_group import \
+                    get_current_placement_group
+
+                cur = get_current_placement_group()
+                return cur.id.hex() if cur else None
+
+            @ray_tpu.remote
+            def parent():
+                return ray_tpu.get(child.remote())
+
+            strat = PlacementGroupSchedulingStrategy(
+                placement_group=pg,
+                placement_group_capture_child_tasks=True)
+            got = ray_tpu.get(
+                parent.options(scheduling_strategy=strat).remote(),
+                timeout=30)
+            assert got == pg.id.hex()
+        finally:
+            ray_tpu.shutdown()
+
     def test_handle_serializable(self, pg_cluster):
         import pickle
 
