@@ -150,10 +150,12 @@ class MemoryStore:
 
     # -- lifecycle ---------------------------------------------------------
     def delete(self, object_ids: List[ObjectID]) -> None:
+        # Callbacks are NOT dropped: a waiter blocked on a not-yet-stored
+        # object must still wake when the value (or its reconstruction)
+        # arrives — delete-before-put would otherwise strand it forever.
         with self._lock:
             for o in object_ids:
                 self._objects.pop(o, None)
-                self._callbacks.pop(o, None)
 
     def size(self) -> int:
         with self._lock:

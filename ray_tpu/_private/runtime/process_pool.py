@@ -294,6 +294,15 @@ class ProcessWorkerPool:
             return
         try:
             payload, borrows = self._build_payload(spec, return_ids)
+        except _RequeueDeps as e:
+            from ray_tpu._private.worker import _top_level_deps
+
+            self._worker.reference_counter.add_submitted_task_references(
+                _top_level_deps(spec.args, spec.kwargs))
+            self._finish_task(pending, exec_task_id,
+                              PendingTask(spec=spec, deps=list(e.oids),
+                                          execute=lambda t, n: None))
+            return
         except _DepError as e:
             self._worker._store_error(spec, return_ids, e.error)
             self._finish_task(pending, exec_task_id, None)
@@ -348,6 +357,12 @@ class ProcessWorkerPool:
         if loc is not None:
             return _ShmValue(*loc)
         entry = self._worker.memory_store.get_entry(oid)
+        if entry is None:
+            # lost since scheduling: reconstruct from lineage; the task
+            # re-queues behind the recovery instead of failing
+            if self._worker.object_recovery.maybe_recover(oid):
+                raise _RequeueDeps([oid])
+            entry = self._worker.memory_store.get_entry(oid)
         if entry is None:
             raise _DepError(rex.ObjectLostError(oid.hex()))
         if entry.is_exception:
@@ -692,3 +707,10 @@ class ProcessWorkerPool:
 class _DepError(Exception):
     def __init__(self, error: BaseException):
         self.error = error
+
+
+class _RequeueDeps(Exception):
+    """Deps lost but reconstructing: re-queue the task behind them."""
+
+    def __init__(self, oids):
+        self.oids = oids

@@ -60,6 +60,8 @@ class TaskManager:
         self._worker = worker
         self._lock = threading.RLock()
         self._pending: Dict[TaskID, Tuple[TaskSpec, List[ObjectID]]] = {}
+        # original-id -> current retry id for in-flight retries
+        self._pending_origin: Dict[TaskID, TaskID] = {}
         self._lineage: Dict[TaskID, TaskSpec] = {}
         self._lineage_bytes = 0
         self.num_retries = 0
@@ -68,13 +70,32 @@ class TaskManager:
         with self._lock:
             self._pending[spec.task_id] = (spec, deps)
 
+    def rekey_pending(self, old_id: TaskID, spec: TaskSpec,
+                      deps: List[ObjectID]) -> None:
+        """A retry gets a fresh attempt id: move the pending entry (the
+        old id would otherwise leak and shadow lineage lookups forever)
+        and remember the ORIGINAL id — return ids derive from it, and
+        recovery/lineage must resolve through it."""
+        with self._lock:
+            self._pending.pop(old_id, None)
+            self._pending[spec.task_id] = (spec, deps)
+            rr = getattr(spec, "_retry_return_ids", None)
+            origin = rr[0].task_id() if rr else old_id
+            self._pending_origin[origin] = spec.task_id
+
     def complete(self, task_id: TaskID) -> None:
         with self._lock:
             entry = self._pending.pop(task_id, None)
             if entry is not None:
                 spec, _ = entry
-                # retain lineage for reconstruction while returns in scope
-                self._lineage[task_id] = spec
+                # retain lineage for reconstruction while returns in
+                # scope — keyed by the id the RETURN ids derive from, so
+                # recovery of a retried/reconstructed task's outputs
+                # still finds the spec
+                rr = getattr(spec, "_retry_return_ids", None)
+                key = rr[0].task_id() if rr else task_id
+                self._pending_origin.pop(key, None)
+                self._lineage[key] = spec
                 self._lineage_bytes += 256  # coarse estimate per spec
                 if self._lineage_bytes > GLOBAL_CONFIG.max_lineage_bytes:
                     self._evict_lineage_locked()
@@ -99,6 +120,11 @@ class TaskManager:
     def get_pending_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
         with self._lock:
             entry = self._pending.get(task_id)
+            if entry is None:
+                # the task may be in flight under a retry id
+                current = self._pending_origin.get(task_id)
+                if current is not None:
+                    entry = self._pending.get(current)
             return entry[0] if entry is not None else None
 
     def evict_lineage(self, task_id: TaskID) -> None:
@@ -183,6 +209,10 @@ class Worker:
         from ray_tpu._private.placement_groups import PlacementGroupManager
         self.placement_groups = PlacementGroupManager(self)
 
+        # lineage reconstruction for lost objects
+        from ray_tpu._private.object_recovery import ObjectRecoveryManager
+        self.object_recovery = ObjectRecoveryManager(self)
+
         # actors: ActorID -> _ActorRuntime (see actor.py)
         self.actors: Dict[ActorID, Any] = {}
         self.dead_actors: set = set()
@@ -263,6 +293,11 @@ class Worker:
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         ids = [r.object_id() for r in refs]
+        # lost objects (freed/evicted while still referenced) reconstruct
+        # from lineage before we block on the store
+        missing = [oid for oid in ids if not self.memory_store.contains(oid)]
+        if missing:
+            self.object_recovery.recover_all(missing)
         try:
             entries = self.memory_store.wait_and_get(ids, timeout)
         except TimeoutError as e:
@@ -303,8 +338,15 @@ class Worker:
         self.reference_counter.add_submitted_task_references(deps)
         self.task_manager.add_pending(spec, deps)
 
-        # drop deps already available locally
-        unresolved = [d for d in deps if not self.memory_store.contains(d)]
+        # drop deps already available locally; a missing dep with no
+        # pending producer was LOST and must reconstruct or the task
+        # waits forever
+        unresolved = []
+        for d in deps:
+            if self.memory_store.contains(d):
+                continue
+            unresolved.append(d)
+            self.object_recovery.maybe_recover(d)
         pending = PendingTask(spec=spec, deps=unresolved,
                               execute=lambda t, n: None)
         self.scheduler.submit(pending)
@@ -455,7 +497,16 @@ class Worker:
             from ray_tpu.util.placement_group import _current_pg
             pg_token = _current_pg.set(spec.placement_group_id)
         try:
-            args, kwargs, dep_error = self._resolve_args(spec)
+            args, kwargs, dep_error, requeue_deps = self._resolve_args(spec)
+            if requeue_deps:
+                # lost deps are reconstructing: give the slot back and
+                # wait for them through the normal dependency machinery
+                # (the finally block releases this execution first)
+                self.reference_counter.add_submitted_task_references(
+                    _top_level_deps(spec.args, spec.kwargs))
+                retry_task = PendingTask(spec=spec, deps=requeue_deps,
+                                         execute=lambda t, n: None)
+                return
             if dep_error is not None:
                 self._store_error(spec, return_ids, dep_error)
                 return
@@ -490,27 +541,41 @@ class Worker:
 
     def _resolve_args(self, spec: TaskSpec):
         """Replace top-level ObjectRefs by values (reference semantics: only
-        top-level args are awaited/inlined; nested refs pass through)."""
+        top-level args are awaited/inlined; nested refs pass through).
+
+        Returns (args, kwargs, dep_error, requeue_deps): requeue_deps
+        lists LOST deps now under lineage reconstruction — the caller
+        re-queues the task to wait for them instead of blocking an
+        executor thread (which the reconstruction itself may need)."""
         dep_error = None
+        requeue_deps: List[ObjectID] = []
 
         def resolve(v):
             nonlocal dep_error
             if isinstance(v, ObjectRef):
-                entry = self.memory_store.get_entry(v.object_id())
+                oid = v.object_id()
+                entry = self.memory_store.get_entry(oid)
                 if entry is None:
-                    # scheduler guaranteed readiness; treat as lost
+                    # scheduler guaranteed readiness, so the object was
+                    # LOST since: reconstruct from lineage
+                    if self.object_recovery.maybe_recover(oid):
+                        requeue_deps.append(oid)
+                        return None
+                    # unrecoverable: a tombstoned loss stored its error
+                    entry = self.memory_store.get_entry(oid)
+                if entry is None:
                     dep_error = rex.ObjectLostError(v.hex())
                     return None
                 if entry.is_exception:
                     dep_error = entry.value
                     return None
-                return (self._entry_value(v.object_id(), entry)
+                return (self._entry_value(oid, entry)
                         if self.shm_store is not None else entry.value)
             return v
 
         args = tuple(resolve(a) for a in spec.args)
         kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
-        return args, kwargs, dep_error
+        return args, kwargs, dep_error, requeue_deps
 
     def _store_returns(self, spec: TaskSpec, return_ids: List[ObjectID], result):
         if spec.num_returns == 1:
@@ -534,6 +599,7 @@ class Worker:
         once this execution's finished-notification has gone out."""
         if self.task_manager.should_retry(spec, exc):
             spec.attempt_number += 1
+            old_id = spec.task_id
             spec.task_id = self.next_task_id()  # retries get a fresh attempt id
             self.task_manager.num_retries += 1
             logger.warning("retrying task %s (attempt %d/%d): %s", spec.name,
@@ -541,6 +607,7 @@ class Worker:
             # resubmit under the ORIGINAL return ids
             spec._retry_return_ids = return_ids  # type: ignore[attr-defined]
             deps = _top_level_deps(spec.args, spec.kwargs)
+            self.task_manager.rekey_pending(old_id, spec, deps)
             unresolved = [d for d in deps if not self.memory_store.contains(d)]
             return PendingTask(spec=spec, deps=unresolved,
                                execute=lambda t, n: None)
@@ -586,6 +653,18 @@ class Worker:
                     self.reference_counter.remove_local_reference(oid)
                 except Exception:
                     logger.exception("unref failed for %s", oid)
+
+    def free_objects(self, refs: Sequence[ObjectRef]) -> None:
+        """Drop stored values WITHOUT touching reference counts — the
+        analog of ray._private.internal_api.free (and of losing the
+        objects to eviction/node death). A later get() reconstructs them
+        from lineage if their producing tasks are still recoverable."""
+        for r in refs:
+            oid = r.object_id()
+            self.object_recovery.note_freed(oid)
+            self.memory_store.delete([oid])
+            if self.shm_store is not None:
+                self.shm_store.free_object(oid)
 
     def _on_object_out_of_scope(self, object_id: ObjectID) -> None:
         self.memory_store.delete([object_id])

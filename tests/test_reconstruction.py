@@ -1,0 +1,135 @@
+"""Lineage reconstruction: lost objects re-materialize by re-running
+their producing tasks (reference behaviors from ray's
+test_reconstruction*.py: recursive recovery, retry caps, put() objects
+unrecoverable)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.exceptions import ObjectLostError
+
+
+@pytest.fixture(params=["event", "tensor"])
+def rt(request):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=4, scheduler=request.param)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+EXEC_COUNT = {"n": 0}
+
+
+@ray_tpu.remote(max_retries=3)
+def produce(x):
+    EXEC_COUNT["n"] += 1
+    return x * 10
+
+
+@ray_tpu.remote(max_retries=3)
+def combine(a, b):
+    EXEC_COUNT["n"] += 1
+    return a + b
+
+
+class TestReconstruction:
+    def test_lost_object_reexecutes(self, rt):
+        """The VERDICT 'done when': delete an intermediate object; get()
+        still returns the right value via re-execution."""
+        ref = produce.remote(7)
+        assert ray_tpu.get(ref, timeout=10) == 70
+        w = worker_mod.get_worker()
+        before = EXEC_COUNT["n"]
+        w.free_objects([ref])  # simulate loss (eviction/node death)
+        assert ray_tpu.get(ref, timeout=10) == 70
+        assert EXEC_COUNT["n"] == before + 1  # actually re-ran
+
+    def test_recursive_reconstruction(self, rt):
+        """A lost object whose inputs are ALSO lost rebuilds the chain."""
+        a = produce.remote(1)
+        b = produce.remote(2)
+        c = combine.remote(a, b)
+        assert ray_tpu.get(c, timeout=10) == 30
+        w = worker_mod.get_worker()
+        w.free_objects([a, b, c])
+        assert ray_tpu.get(c, timeout=20) == 30
+
+    def test_reconstruction_counts_against_retries(self, rt):
+        @ray_tpu.remote(max_retries=1)
+        def once(x):
+            return x + 1
+
+        ref = once.remote(1)
+        assert ray_tpu.get(ref, timeout=10) == 2
+        w = worker_mod.get_worker()
+        w.free_objects([ref])
+        assert ray_tpu.get(ref, timeout=10) == 2  # attempt 1/1
+        w.free_objects([ref])
+        with pytest.raises(Exception):  # retries exhausted -> timeout/lost
+            ray_tpu.get(ref, timeout=1.0)
+
+    def test_put_objects_are_unrecoverable(self, rt):
+        """An unrecoverable loss raises ObjectLostError promptly even
+        with no timeout (a hang here was the review's top finding)."""
+        ref = ray_tpu.put(41)
+        w = worker_mod.get_worker()
+        w.free_objects([ref])
+        with pytest.raises(ObjectLostError):
+            ray_tpu.get(ref)  # timeout=None must NOT hang
+
+    def test_unrecoverable_dep_fails_consumer(self, rt):
+        ref = ray_tpu.put(5)
+        w = worker_mod.get_worker()
+        w.free_objects([ref])
+        c = combine.remote(ref, ref)
+        with pytest.raises(ObjectLostError):
+            ray_tpu.get(c, timeout=10)
+
+    def test_reconstruction_after_a_normal_retry(self, rt):
+        """Objects produced by a task that RETRIED once must still be
+        reconstructable (lineage keys through the original id)."""
+        state = {"fails": 1}
+
+        @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+        def flaky(x):
+            if state["fails"] > 0:
+                state["fails"] -= 1
+                raise RuntimeError("transient")
+            return x * 2
+
+        ref = flaky.remote(21)
+        assert ray_tpu.get(ref, timeout=10) == 42
+        w = worker_mod.get_worker()
+        w.free_objects([ref])
+        assert ray_tpu.get(ref, timeout=10) == 42
+
+    def test_lost_dependency_of_running_task(self, rt):
+        """A task dispatched whose arg got freed re-materializes the arg
+        during argument resolution."""
+        a = produce.remote(3)
+        assert ray_tpu.get(a, timeout=10) == 30
+        w = worker_mod.get_worker()
+        w.free_objects([a])
+        # submit a consumer whose dep is (locally) missing right now
+        c = combine.remote(a, a)
+        assert ray_tpu.get(c, timeout=20) == 60
+
+    def test_reconstruction_in_process_mode(self):
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process"})
+        try:
+            @ray_tpu.remote(max_retries=2)
+            def gen(x):
+                return list(range(x))
+
+            ref = gen.remote(5)
+            assert ray_tpu.get(ref, timeout=20) == [0, 1, 2, 3, 4]
+            w = worker_mod.get_worker()
+            w.free_objects([ref])
+            assert ray_tpu.get(ref, timeout=20) == [0, 1, 2, 3, 4]
+        finally:
+            ray_tpu.shutdown()
