@@ -70,6 +70,11 @@ class ObjectRecoveryManager:
             return False
         if w.memory_store.contains(object_id):
             return True
+        if object_id.is_put():
+            # put() objects have no producing task to re-run; a re-run of
+            # the task that CALLED put would store under a fresh task id,
+            # never this one
+            return False
         producer: TaskID = object_id.task_id()
         with self._lock:
             if producer in self._in_flight:

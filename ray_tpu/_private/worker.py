@@ -95,8 +95,9 @@ class TaskManager:
                 rr = getattr(spec, "_retry_return_ids", None)
                 key = rr[0].task_id() if rr else task_id
                 self._pending_origin.pop(key, None)
+                if key not in self._lineage:  # overwrites don't grow
+                    self._lineage_bytes += 256  # coarse estimate per spec
                 self._lineage[key] = spec
-                self._lineage_bytes += 256  # coarse estimate per spec
                 if self._lineage_bytes > GLOBAL_CONFIG.max_lineage_bytes:
                     self._evict_lineage_locked()
 

@@ -45,6 +45,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False          # jax.checkpoint each block (HBM vs FLOPs)
+    # sequence/context parallelism: ring attention over the mesh's `seq`
+    # axis (ray_tpu/ops/ring_attention.py). Takes effect when the model
+    # runs under parallel.mesh.use_mesh(mesh) with seq > 1.
+    ring_attention: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -117,15 +121,35 @@ class Attention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-        # GQA: repeat kv heads up to query heads
-        rep = cfg.n_heads // cfg.n_kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        ring_mesh = None
+        if cfg.ring_attention and mask is None:
+            # ring path implements CAUSAL attention only: an explicit
+            # mask (padding etc.) falls back to the standard path rather
+            # than being silently ignored
+            from ray_tpu.parallel import mesh as mesh_lib
 
-        scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(hd)
-        scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhst,bthk->bshk", probs, v)
+            m = mesh_lib.current_mesh()
+            if m is not None and m.shape.get(mesh_lib.AXIS_SEQ, 1) > 1:
+                ring_mesh = m
+        if ring_mesh is not None:
+            # sequence parallelism: blockwise ring attention, UNREPEATED
+            # GQA KV rotated over the seq axis (repeat happens inside the
+            # per-step block so ICI traffic stays at n_kv_heads size)
+            from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+            out = ring_attention_sharded(q, k, v, ring_mesh, causal=True)
+        else:
+            # GQA: repeat kv heads up to query heads
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            if mask is None:
+                s = x.shape[1]
+                mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+            scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(hd)
+            scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhst,bthk->bshk", probs, v)
         out = jnp.einsum("bshk,hkd->bsd", out, wo.astype(cfg.dtype))
         return with_sharding_constraint(out, ("batch", "act_seq",
                                               "act_embed"))
@@ -177,7 +201,9 @@ class Transformer(nn.Module):
 
         s = tokens.shape[1]
         positions = jnp.arange(s)[None, :]
-        mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None, :, :]
+        # mask=None means CAUSAL — built on demand by the standard path;
+        # the ring-attention path handles causality via global offsets
+        mask = None
 
         block = Block
         if cfg.remat:

@@ -1,0 +1,8 @@
+"""ray_tpu.ops — TPU kernels (Pallas) + their XLA reference paths."""
+
+from ray_tpu.ops.ring_attention import (  # noqa: F401
+    attention_reference, block_attention, ring_attention,
+    ring_attention_sharded)
+
+__all__ = ["ring_attention", "ring_attention_sharded", "block_attention",
+           "attention_reference"]

@@ -94,6 +94,49 @@ def make_mesh(config: Optional[MeshConfig] = None,
     return Mesh(dev_array, axis_names=tuple(names))
 
 
+import contextvars
+
+# per-context stack (tuple, immutable): traces on different threads /
+# async tasks must each see only their own active mesh
+_CURRENT_MESH: contextvars.ContextVar[Tuple] = contextvars.ContextVar(
+    "ray_tpu_mesh_stack", default=())
+
+
+class use_mesh:
+    """Context manager: activates the mesh for BOTH jax (``with mesh:``)
+    and framework code that needs the mesh object itself (e.g. the ring
+    attention path asking "is there a seq axis > 1?")."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._token = None
+        self._entered = False
+
+    def __enter__(self):
+        self._token = _CURRENT_MESH.set(_CURRENT_MESH.get() + (self.mesh,))
+        try:
+            self.mesh.__enter__()
+            self._entered = True
+        except BaseException:
+            _CURRENT_MESH.reset(self._token)
+            raise
+        return self.mesh
+
+    def __exit__(self, *exc):
+        try:
+            if self._entered:
+                self.mesh.__exit__(*exc)
+        finally:
+            _CURRENT_MESH.reset(self._token)
+        return False
+
+
+def current_mesh():
+    """The innermost use_mesh() mesh of THIS context, or None."""
+    stack = _CURRENT_MESH.get()
+    return stack[-1] if stack else None
+
+
 def default_logical_rules() -> List[Tuple[str, object]]:
     """Logical-axis -> mesh-axis mapping for the model family.
 
