@@ -63,6 +63,23 @@ def main() -> int:
             e2e[mode] = None
     out["e2e_tasks_per_sec"] = e2e
 
+    # --- Data library: 100k-block map_batches pipeline -----------------
+    try:
+        r = perf.data_pipeline_throughput(
+            num_blocks=1_000 if smoke else 100_000)
+        out["data_pipeline"] = {
+            "blocks_per_sec": round(r["blocks_per_sec"], 1),
+            "rows_per_sec": round(r["rows_per_sec"], 1),
+            "num_blocks": r["num_blocks"],
+            "seconds": round(r["seconds"], 2),
+        }
+        print(f"  data: {r['blocks_per_sec']:.0f} blocks/s "
+              f"({r['num_blocks']} blocks in {r['seconds']:.1f}s)",
+              file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
+        out["data_pipeline"] = None
+
     # --- model perf: step time / tokens/s / MFU ------------------------
     try:
         m = perf.model_mfu(smoke=smoke)

@@ -147,6 +147,10 @@ _d("sched_jax_min_batch", int, 512,
 _d("task_max_retries", int, 3, "default retries for tasks on worker failure")
 _d("actor_max_restarts", int, 0, "default actor restarts")
 _d("max_lineage_bytes", int, 64 * 1024 * 1024, "owner lineage cap")
+_d("data_op_inflight", int, 8,
+   "ray_tpu.data: max in-flight tasks per streaming operator")
+_d("data_buffer_blocks", int, 32,
+   "ray_tpu.data: max live blocks across the pipeline (backpressure)")
 _d("health_check_period_s", float, 1.0, "control-plane health check period")
 _d("health_check_timeout_s", float, 5.0, "mark node dead after this")
 

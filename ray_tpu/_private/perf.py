@@ -78,6 +78,38 @@ def e2e_task_throughput(n_tasks: int = 10_000, mode: str = "thread",
     }
 
 
+def data_pipeline_throughput(num_blocks: int = 100_000,
+                             rows_per_block: int = 10,
+                             num_workers: int = 8) -> Dict[str, Any]:
+    """BASELINE config 3 through the REAL library: a map_batches pipeline
+    over num_blocks blocks via the public ray_tpu.data API (streaming
+    executor, backpressure, fused read+map), not a synthetic DAG."""
+    import ray_tpu
+    from ray_tpu import data
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=num_workers, scheduler="tensor")
+    try:
+        n_rows = num_blocks * rows_per_block
+        ds = data.range(n_rows, parallelism=num_blocks).map_batches(
+            lambda b: [x * 2 for x in b])
+        t0 = time.perf_counter()
+        total = ds.count()
+        dt = time.perf_counter() - t0
+        assert total == n_rows, (total, n_rows)
+        stats = ds.stats()
+    finally:
+        ray_tpu.shutdown()
+    return {
+        "num_blocks": num_blocks,
+        "rows": n_rows,
+        "seconds": dt,
+        "blocks_per_sec": num_blocks / dt,
+        "rows_per_sec": n_rows / dt,
+        "stages": stats["stages"] if stats else None,
+    }
+
+
 def _flops_per_step(compiled, params, batch: int, seq: int) -> float:
     """XLA's own FLOP count for the compiled step; analytic fallback."""
     try:

@@ -1,0 +1,343 @@
+"""Streaming executor — back-pressured block pipeline over the runtime.
+
+Reference: ray: python/ray/data/_internal/execution/streaming_executor.py
+(+ operators/map_operator.py, actor_pool_map_operator.py,
+ logical/operators/ LimitOperator). Semantics kept: blocks flow between
+operators as ObjectRefs (values never gather on the driver except at
+consumption and at limit truncation), every operator has bounded
+in-flight work and bounded buffered output (backpressure), consecutive
+task-compute maps FUSE into one task per block (the Read->Map fusion
+rule), actor-pool stages run on long-lived actors, block order is
+preserved end-to-end, and limit() applies AT ITS POSITION in the plan
+(an ordered streaming truncation that also quenches upstream admission
+once satisfied).
+
+The driver loop is the scheduler's client, not a scheduler itself: it
+only decides *admission* (which block enters which stage under the
+budget); placement/dispatch stay with the core scheduler.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.data.dataset import _LogicalOp
+
+
+def _compose(fns: List[Callable]) -> Callable:
+    if len(fns) == 1:
+        return fns[0]
+
+    def composed(block, _fns=tuple(fns)):
+        for f in _fns:
+            block = f(block)
+        return block
+
+    return composed
+
+
+@ray_tpu.remote
+def _source_task(make_block, post_fn, i):
+    block = make_block(i)
+    return post_fn(block) if post_fn is not None else block
+
+
+@ray_tpu.remote
+def _map_task(fn, block):
+    return fn(block)
+
+
+@ray_tpu.remote
+class _MapActor:
+    """One worker of an ActorPoolStrategy stage."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, block):
+        return self.fn(block)
+
+
+class _Stage:
+    __slots__ = ("kind", "name", "fn", "pool_size", "actors", "actor_load",
+                 "inputs", "inflight", "submitted", "completed", "busy_s",
+                 "limit_remaining", "limit_next_in", "limit_buf",
+                 "limit_out_idx")
+
+    def __init__(self, kind: str, name: str, fn: Optional[Callable] = None,
+                 pool_size: int = 0, limit: int = 0):
+        self.kind = kind                # "task" | "actor" | "limit"
+        self.name = name
+        self.fn = fn
+        self.pool_size = pool_size
+        self.actors: List[Any] = []
+        self.actor_load: Dict[int, int] = {}
+        self.inputs: collections.deque = collections.deque()  # (idx, ref)
+        self.inflight: Dict[Any, Tuple[int, float, int]] = {}
+        self.submitted = 0
+        self.completed = 0
+        self.busy_s = 0.0
+        # limit-stage state: processed IN ORDER, renumbering outputs
+        self.limit_remaining = limit
+        self.limit_next_in = 0
+        self.limit_buf: Dict[int, Any] = {}
+        self.limit_out_idx = 0
+
+
+class StreamingExecutor:
+    def __init__(self, ops: List[_LogicalOp],
+                 row_limit: Optional[int] = None):
+        self._row_limit = row_limit
+        self._source, self._stages = self._plan(ops)
+        self._max_inflight = max(4, GLOBAL_CONFIG.data_op_inflight)
+        self._buffer_blocks = max(self._max_inflight * 2,
+                                  GLOBAL_CONFIG.data_buffer_blocks)
+        self._stopped = False
+        self._quenched = False   # a limit stage satisfied: stop sources
+        self._t0 = None
+
+    # -- planning -------------------------------------------------------
+    @staticmethod
+    def _plan(ops: List[_LogicalOp]):
+        """Logical chain -> (source op, physical stages IN ORDER).
+        Consecutive task-compute map_blocks fuse; actor-compute and
+        limit ops are their own stages at their position."""
+        assert ops and ops[0].kind == "read", "plan must start with a read"
+        source = ops[0]
+        stages: List[_Stage] = []
+        pending_fns: List[Callable] = []
+        pending_names: List[str] = []
+
+        def flush():
+            nonlocal pending_fns, pending_names
+            if pending_fns:
+                stages.append(_Stage("task", "+".join(pending_names),
+                                     _compose(pending_fns)))
+                pending_fns, pending_names = [], []
+
+        for op in ops[1:]:
+            if op.kind == "limit":
+                flush()
+                stages.append(_Stage("limit", f"limit({op.limit})",
+                                     limit=op.limit))
+            elif op.kind == "map_block" and op.compute is None:
+                pending_fns.append(op.fn)
+                pending_names.append(op.name)
+            elif op.kind == "map_block":
+                flush()
+                stages.append(_Stage("actor", op.name, op.fn,
+                                     pool_size=op.compute.size))
+            else:
+                raise ValueError(f"unknown op {op.kind}")
+        flush()
+
+        # fuse the FIRST task stage into the source (Read->Map fusion)
+        fused_post = None
+        if stages and stages[0].kind == "task":
+            fused_post = stages.pop(0)
+        src_stage = _Stage(
+            "task",
+            source.name + (f"+{fused_post.name}" if fused_post else ""),
+            fused_post.fn if fused_post else None)
+        return source, [src_stage] + stages
+
+    # -- execution ------------------------------------------------------
+    def run_refs(self) -> Iterator[Any]:
+        """Yield final-stage block refs IN ORDER."""
+        self._t0 = time.perf_counter()
+        for stage in self._stages:
+            if stage.kind == "actor":
+                stage.actors = [_MapActor.remote(stage.fn)
+                                for _ in range(stage.pool_size)]
+                stage.actor_load = {i: 0 for i in range(stage.pool_size)}
+        try:
+            yield from self._loop()
+        finally:
+            self._shutdown()
+
+    def run_blocks(self) -> Iterator[List[Any]]:
+        """Yield final block VALUES in order; truncates at row_limit."""
+        remaining = self._row_limit
+        for ref in self.run_refs():
+            block = ray_tpu.get(ref)
+            if remaining is not None:
+                if len(block) >= remaining:
+                    yield block[:remaining]
+                    return
+                remaining -= len(block)
+            yield block
+
+    def _make_block_fn(self):
+        """Source block generator. from_items-style sources whose data
+        lives on the driver move it through the object store ONCE (a ref
+        per block) instead of closing the whole dataset into every
+        task's pickled closure."""
+        source = self._source
+        if source.make_block is not None:
+            return source.make_block
+        items = source.items
+        per = -(-len(items) // source.num_blocks) if items else 0
+        refs = [ray_tpu.put(items[i * per:(i + 1) * per])
+                for i in range(source.num_blocks)]
+
+        def make_block(i: int, _refs=tuple(refs)) -> List[Any]:
+            return ray_tpu.get(_refs[i])
+
+        return make_block
+
+    def _loop(self) -> Iterator[Any]:
+        source, stages = self._source, self._stages
+        make_block = self._make_block_fn()
+        num_blocks = source.num_blocks
+        next_block = 0
+        emit_buf: Dict[int, Any] = {}
+        next_emit = 0
+        final = stages[-1]
+
+        def live_blocks() -> int:
+            n = len(emit_buf)
+            for st in stages:
+                n += (len(st.inputs) + len(st.inflight)
+                      + len(st.limit_buf))
+            return n
+
+        def route_output(pos: int, idx: int, ref: Any) -> None:
+            """Block leaving stage pos goes to the next stage or emits."""
+            if stages[pos] is final:
+                emit_buf[idx] = ref
+            else:
+                stages[pos + 1].inputs.append((idx, ref))
+
+        def process_limit(pos: int) -> None:
+            """Ordered streaming truncation: consumes this limit stage's
+            buffered inputs in index order; truncation fetches the one
+            straddling block (bounded by the limit itself)."""
+            stage = stages[pos]
+            while stage.limit_next_in in stage.limit_buf:
+                ref = stage.limit_buf.pop(stage.limit_next_in)
+                stage.limit_next_in += 1
+                if stage.limit_remaining <= 0:
+                    continue  # drop: quota already satisfied
+                block = ray_tpu.get(ref)
+                stage.completed += 1
+                if len(block) > stage.limit_remaining:
+                    ref = ray_tpu.put(block[:stage.limit_remaining])
+                    stage.limit_remaining = 0
+                else:
+                    stage.limit_remaining -= len(block)
+                out_idx = stage.limit_out_idx
+                stage.limit_out_idx += 1
+                route_output(pos, out_idx, ref)
+                if stage.limit_remaining <= 0:
+                    self._quenched = True
+
+        while not self._stopped:
+            # admission: source tasks under both budgets (bounded memory);
+            # a satisfied limit quenches all upstream admission
+            src = stages[0]
+            while (not self._quenched
+                   and next_block < num_blocks
+                   and len(src.inflight) < self._max_inflight
+                   and live_blocks() < self._buffer_blocks):
+                ref = _source_task.remote(make_block, src.fn, next_block)
+                src.inflight[ref] = (next_block, time.perf_counter(), 0)
+                src.submitted += 1
+                next_block += 1
+
+            # downstream stages: feed from their input queues
+            for pos, stage in enumerate(stages):
+                if pos == 0:
+                    continue
+                if stage.kind == "limit":
+                    while stage.inputs:
+                        idx, in_ref = stage.inputs.popleft()
+                        stage.limit_buf[idx] = in_ref
+                        stage.submitted += 1
+                    process_limit(pos)
+                    continue
+                quenched_upstream = self._quenched and any(
+                    s.kind == "limit" for s in stages[pos:])
+                while stage.inputs and len(stage.inflight) < \
+                        self._max_inflight:
+                    idx, in_ref = stage.inputs.popleft()
+                    if quenched_upstream:
+                        continue  # feeding a satisfied limit: drop
+                    if stage.kind == "actor":
+                        a = min(stage.actor_load,
+                                key=stage.actor_load.__getitem__)
+                        stage.actor_load[a] += 1
+                        ref = stage.actors[a].apply.remote(in_ref)
+                        stage.inflight[ref] = (idx, time.perf_counter(), a)
+                    else:
+                        ref = _map_task.remote(stage.fn, in_ref)
+                        stage.inflight[ref] = (idx, time.perf_counter(), 0)
+                    stage.submitted += 1
+
+            # emit in order
+            while next_emit in emit_buf:
+                yield emit_buf.pop(next_emit)
+                next_emit += 1
+
+            all_inflight = [r for st in stages for r in st.inflight]
+            if not all_inflight:
+                drained = (next_block >= num_blocks or self._quenched) \
+                    and not any(st.inputs for st in stages) \
+                    and not any(st.limit_buf and st.limit_remaining > 0
+                                and not self._quenched
+                                for st in stages)
+                if drained:
+                    while next_emit in emit_buf:
+                        yield emit_buf.pop(next_emit)
+                        next_emit += 1
+                    return
+                continue
+
+            ready, _ = ray_tpu.wait(all_inflight,
+                                    num_returns=1, timeout=5.0)
+            for ref in ready:
+                for pos, stage in enumerate(stages):
+                    info = stage.inflight.pop(ref, None)
+                    if info is None:
+                        continue
+                    idx, t_start, actor = info
+                    stage.completed += 1
+                    stage.busy_s += time.perf_counter() - t_start
+                    if stage.kind == "actor":
+                        stage.actor_load[actor] -= 1
+                    route_output(pos, idx, ref)
+                    break
+
+    def _shutdown(self) -> None:
+        self._stopped = True
+        for stage in self._stages:
+            for ref in list(stage.inflight):
+                try:
+                    ray_tpu.cancel(ref)
+                except Exception:
+                    pass
+            stage.inflight.clear()
+            for a in stage.actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+            stage.actors = []
+
+    def stats(self) -> Dict[str, Any]:
+        wall = (time.perf_counter() - self._t0) if self._t0 else 0.0
+        return {
+            "wall_s": wall,
+            "stages": [
+                {"name": st.name,
+                 "compute": (f"actors({st.pool_size})"
+                             if st.kind == "actor" else st.kind),
+                 "submitted": st.submitted,
+                 "completed": st.completed,
+                 "busy_s": round(st.busy_s, 4)}
+                for st in self._stages
+            ],
+        }

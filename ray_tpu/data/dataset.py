@@ -1,0 +1,219 @@
+"""Dataset — the lazy logical plan + user API.
+
+Reference: ray: python/ray/data/dataset.py (Dataset),
+_internal/logical/ (LogicalPlan operators). Execution happens only at
+consumption (take/count/materialize/iter_*), through the streaming
+executor (ray_tpu/data/_streaming.py).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+# ----------------------------------------------------------------------
+# logical operators
+# ----------------------------------------------------------------------
+
+
+class _LogicalOp:
+    """Node in the lazy plan. kind:
+    read        make_block(i) -> block  (runs IN a task)
+    map_block   fn(block) -> block      (1:1, fusible)
+    limit       truncate to n rows (applied streaming, driver-side)
+    """
+
+    def __init__(self, kind: str, *, name: str = "", fn=None,
+                 num_blocks: int = 0, make_block=None, items=None,
+                 limit: int = 0, compute=None,
+                 parent: Optional["_LogicalOp"] = None):
+        self.kind = kind
+        self.name = name or kind
+        self.fn = fn
+        self.num_blocks = num_blocks
+        self.make_block = make_block
+        self.items = items           # driver-resident source data
+        self.limit = limit
+        self.compute = compute       # None = tasks | ActorPoolStrategy
+        self.parent = parent
+
+    def chain(self) -> List["_LogicalOp"]:
+        ops: List[_LogicalOp] = []
+        node: Optional[_LogicalOp] = self
+        while node is not None:
+            ops.append(node)
+            node = node.parent
+        return list(reversed(ops))
+
+
+class ActorPoolStrategy:
+    """compute= strategy: run map_batches on a pool of long-lived actors
+    (reference: ray.data.ActorPoolStrategy / ActorPoolMapOperator)."""
+
+    def __init__(self, size: int = 2):
+        if size < 1:
+            raise ValueError("actor pool size must be >= 1")
+        self.size = size
+
+
+class Dataset:
+    """Lazy, immutable; every transform returns a new Dataset."""
+
+    def __init__(self, op: _LogicalOp):
+        self._op = op
+        self._last_stats = None
+
+    # -- transforms (lazy) ----------------------------------------------
+    def map_batches(self, fn: Callable[[List[Any]], List[Any]],
+                    batch_size: Optional[int] = None,
+                    compute: Optional[ActorPoolStrategy] = None,
+                    name: str = "") -> "Dataset":
+        """fn: batch -> batch. compute=None runs tasks (fusible);
+        ActorPoolStrategy(n) runs on a pool of n actors. batch_size
+        slices each block into fn-sized batches (batches do not cross
+        block boundaries — the reference re-bundles across blocks)."""
+        if batch_size is not None:
+            inner = fn
+
+            def fn(block, _f=inner, _bs=int(batch_size)):  # noqa: F811
+                out: List[Any] = []
+                for i in builtins.range(0, len(block), _bs):
+                    out.extend(_f(block[i:i + _bs]))
+                return out
+
+        return Dataset(_LogicalOp("map_block", fn=fn, compute=compute,
+                                  name=name or getattr(fn, "__name__",
+                                                       "map_batches"),
+                                  parent=self._op))
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self.map_batches(
+            lambda block, _f=fn: [_f(x) for x in block],
+            name=getattr(fn, "__name__", "map"))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self.map_batches(
+            lambda block, _f=fn: [x for x in block if _f(x)],
+            name=f"filter({getattr(fn, '__name__', 'fn')})")
+
+    def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
+        return self.map_batches(
+            lambda block, _f=fn: [y for x in block for y in _f(x)],
+            name=f"flat_map({getattr(fn, '__name__', 'fn')})")
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(_LogicalOp("limit", limit=n, parent=self._op))
+
+    # -- consumption (triggers streaming execution) ---------------------
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for block in self._execute(limit=n):
+            out.extend(block)
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> List[Any]:
+        out: List[Any] = []
+        for block in self._execute():
+            out.extend(block)
+        return out
+
+    def count(self) -> int:
+        return sum(len(b) for b in self._execute())
+
+    def sum(self) -> Any:
+        total = 0
+        for b in self._execute():
+            total = total + builtins.sum(b)
+        return total
+
+    def iter_batches(self) -> Iterator[List[Any]]:
+        yield from self._execute()
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._execute():
+            yield from block
+
+    def materialize(self) -> "MaterializedDataset":
+        """Run the pipeline, keeping blocks in the object store as refs
+        (the reference's ds.materialize())."""
+        from ray_tpu.data._streaming import StreamingExecutor
+
+        ex = StreamingExecutor(self._op.chain())
+        refs = list(ex.run_refs())
+        self._last_stats = ex.stats()
+        return MaterializedDataset(refs)
+
+    def stats(self):
+        """Per-operator stats of the LAST execution (None before any)."""
+        return self._last_stats
+
+    def _execute(self, limit: Optional[int] = None) -> Iterator[List[Any]]:
+        from ray_tpu.data._streaming import StreamingExecutor
+
+        ex = StreamingExecutor(self._op.chain(), row_limit=limit)
+        try:
+            yield from ex.run_blocks()
+        finally:
+            self._last_stats = ex.stats()
+
+    def __repr__(self) -> str:
+        names = " -> ".join(op.name for op in self._op.chain())
+        return f"Dataset({names})"
+
+
+class MaterializedDataset:
+    """Executed dataset: blocks pinned as ObjectRefs."""
+
+    def __init__(self, block_refs):
+        self._refs = block_refs
+
+    @property
+    def block_refs(self):
+        return list(self._refs)
+
+    def num_blocks(self) -> int:
+        return len(self._refs)
+
+    def take_all(self) -> List[Any]:
+        import ray_tpu
+
+        out: List[Any] = []
+        for b in ray_tpu.get(self._refs):
+            out.extend(b)
+        return out
+
+    def iter_rows(self):
+        import ray_tpu
+
+        for ref in self._refs:
+            yield from ray_tpu.get(ref)
+
+
+# ----------------------------------------------------------------------
+# sources (reference: ray.data.range / from_items / read_* datasources)
+# ----------------------------------------------------------------------
+
+def range(n: int, *, parallelism: int = 200) -> Dataset:  # noqa: A001
+    """Integers [0, n) in ~parallelism blocks, generated INSIDE tasks."""
+    num_blocks = max(1, min(parallelism, n)) if n else 1
+    per = -(-n // num_blocks) if n else 0
+
+    def make_block(i: int) -> List[int]:
+        lo = i * per
+        return list(builtins.range(lo, min(lo + per, n)))
+
+    return Dataset(_LogicalOp("read", name=f"range({n})",
+                              num_blocks=num_blocks,
+                              make_block=make_block))
+
+
+def from_items(items: Sequence[Any], *, parallelism: int = 200) -> Dataset:
+    """Driver-resident data; the executor moves it through the object
+    store once (a ref per block) rather than closing the whole list into
+    every source task's pickled closure."""
+    items = list(items)
+    num_blocks = max(1, min(parallelism, len(items) or 1))
+    return Dataset(_LogicalOp("read", name=f"from_items({len(items)})",
+                              num_blocks=num_blocks, items=items))
