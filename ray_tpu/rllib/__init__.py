@@ -1,0 +1,17 @@
+"""ray_tpu.rllib — RL at framework scale, minimum viable core.
+
+Reference surface: RLlib (ray: rllib/ — Algorithm/AlgorithmConfig,
+EnvRunnerGroup sampling actors, Learner). Semantics kept: config ->
+build -> algo.train() iterations; env-runner ACTORS collect rollouts
+with the current policy and feed sample batches through the object
+store to the learner; runner death is survived (respawn + resample).
+
+TPU-first difference: the learner is a single jitted PPO update (GAE +
+clipped surrogate + value/entropy terms) on device — no DDP learner
+group; scaling the learner is a sharding annotation, not more actors.
+"""
+
+from ray_tpu.rllib.env import CartPoleEnv  # noqa: F401
+from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
+
+__all__ = ["PPOConfig", "PPO", "CartPoleEnv"]

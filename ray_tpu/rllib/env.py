@@ -1,0 +1,66 @@
+"""Built-in environments (no gym dependency in this image).
+
+CartPole uses the standard published dynamics (Barto, Sutton & Anderson
+1983; the classic control formulation): pole on a cart, +1 reward per
+step, terminate at |x| > 2.4 or |theta| > 12 degrees or 500 steps.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class CartPoleEnv:
+    """Vector-friendly single env; reset() -> obs[4], step(a) ->
+    (obs, reward, done)."""
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    LENGTH = 0.5          # half pole length
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * np.pi / 180
+    MAX_STEPS = 500
+
+    observation_dim = 4
+    num_actions = 2
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._state = None
+        self._t = 0
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._t = 0
+        return self._state.astype(np.float32)
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool]:
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE_MAG if action == 1 else -self.FORCE_MAG
+        costh, sinth = np.cos(theta), np.sin(theta)
+        total_mass = self.MASSCART + self.MASSPOLE
+        polemass_length = self.MASSPOLE * self.LENGTH
+
+        temp = (force + polemass_length * theta_dot ** 2 * sinth) \
+            / total_mass
+        theta_acc = (self.GRAVITY * sinth - costh * temp) / (
+            self.LENGTH * (4.0 / 3.0
+                           - self.MASSPOLE * costh ** 2 / total_mass))
+        x_acc = temp - polemass_length * theta_acc * costh / total_mass
+
+        x += self.TAU * x_dot
+        x_dot += self.TAU * x_acc
+        theta += self.TAU * theta_dot
+        theta_dot += self.TAU * theta_acc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._t += 1
+
+        done = bool(abs(x) > self.X_LIMIT
+                    or abs(theta) > self.THETA_LIMIT
+                    or self._t >= self.MAX_STEPS)
+        return self._state.astype(np.float32), 1.0, done

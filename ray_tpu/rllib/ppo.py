@@ -1,0 +1,337 @@
+"""PPO — env-runner actors + jitted learner.
+
+Reference: ray: rllib/algorithms/ppo/ (PPO/PPOConfig),
+rllib/env/env_runner_group.py (sampling actors),
+rllib/core/learner/ (update). BASELINE config 5's workload, through the
+real library instead of a synthetic DAG: rollouts on CPU actors,
+the PPO update as ONE jitted program (GAE computed on host, clipped
+surrogate + value + entropy loss on device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import exceptions as rex
+
+# ----------------------------------------------------------------------
+# policy network (flax MLP: logits + value head)
+# ----------------------------------------------------------------------
+
+
+def _policy_apply(params, obs):
+    import jax.numpy as jnp
+
+    x = obs
+    for i, (w, b) in enumerate(params["layers"]):
+        x = x @ w + b
+        if i < len(params["layers"]) - 1:
+            x = jnp.tanh(x)
+    logits = x[..., :-1]
+    value = x[..., -1]
+    return logits, value
+
+
+def _policy_init(rng, obs_dim: int, num_actions: int, hidden: int):
+    import jax
+
+    sizes = [obs_dim, hidden, hidden, num_actions + 1]
+    keys = jax.random.split(rng, len(sizes) - 1)
+    layers = []
+    for k, (m, n) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (m, n)) * (1.0 / np.sqrt(m))
+        layers.append((w, np.zeros(n, np.float32)))
+    return {"layers": layers}
+
+
+# ----------------------------------------------------------------------
+# env runner actor (reference: rllib EnvRunner)
+# ----------------------------------------------------------------------
+
+@ray_tpu.remote
+class _EnvRunner:
+    def __init__(self, env_maker, num_envs: int, rollout_len: int,
+                 seed: int):
+        import jax
+
+        self.envs = [env_maker(seed * 1000 + i) for i in range(num_envs)]
+        self.obs = np.stack([e.reset() for e in self.envs])
+        self.rollout_len = rollout_len
+        self.episode_returns: List[float] = []
+        self.running = np.zeros(len(self.envs))
+        self.rng = np.random.default_rng(seed)
+        # jit ONCE per runner: a per-sample jax.jit would discard the
+        # trace/compile cache every rollout
+        self._apply = jax.jit(_policy_apply)
+
+    def sample(self, params) -> Dict[str, Any]:
+        """One rollout with the given policy params: batch arrays +
+        completed-episode returns."""
+        import jax.numpy as jnp
+
+        apply = self._apply
+        T, N = self.rollout_len, len(self.envs)
+        obs_buf = np.zeros((T, N, self.envs[0].observation_dim),
+                           np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        self.episode_returns = []
+
+        for t in range(T):
+            logits, value = apply(params, jnp.asarray(self.obs))
+            logits = np.asarray(logits)
+            value = np.asarray(value)
+            # sample from the categorical
+            u = self.rng.gumbel(size=logits.shape)
+            actions = np.argmax(logits + u, axis=-1)
+            logp_all = logits - _logsumexp(logits)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = logp_all[np.arange(N), actions]
+            val_buf[t] = value
+            for i, env in enumerate(self.envs):
+                nobs, r, done = env.step(int(actions[i]))
+                rew_buf[t, i] = r
+                self.running[i] += r
+                if done:
+                    done_buf[t, i] = 1.0
+                    self.episode_returns.append(self.running[i])
+                    self.running[i] = 0.0
+                    nobs = env.reset()
+                self.obs[i] = nobs
+
+        _, last_val = apply(params, jnp.asarray(self.obs))
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_values": np.asarray(last_val),
+            "episode_returns": list(self.episode_returns),
+        }
+
+
+def _logsumexp(x):
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))
+
+
+# ----------------------------------------------------------------------
+# GAE (host) + jitted PPO update (device)
+# ----------------------------------------------------------------------
+
+def _gae(batch, gamma: float, lam: float):
+    rew, val, done = batch["rewards"], batch["values"], batch["dones"]
+    T, N = rew.shape
+    adv = np.zeros((T, N), np.float32)
+    last_adv = np.zeros(N, np.float32)
+    next_val = batch["last_values"]
+    for t in reversed(range(T)):
+        nonterminal = 1.0 - done[t]
+        delta = rew[t] + gamma * next_val * nonterminal - val[t]
+        last_adv = delta + gamma * lam * nonterminal * last_adv
+        adv[t] = last_adv
+        next_val = val[t]
+    returns = adv + val
+    return adv, returns
+
+
+def _make_update(lr: float, clip: float, vf_coeff: float,
+                 ent_coeff: float, max_grad_norm: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.chain(optax.clip_by_global_norm(max_grad_norm),
+                            optax.adam(lr))
+
+    def loss_fn(params, obs, actions, old_logp, adv, returns):
+        logits, value = _policy_apply(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, actions[:, None],
+                                   axis=-1)[:, 0]
+        ratio = jnp.exp(logp - old_logp)
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        pi_loss = -surr.mean()
+        vf_loss = jnp.square(value - returns).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return total, (pi_loss, vf_loss, entropy)
+
+    @jax.jit
+    def update(params, opt_state, obs, actions, old_logp, adv, returns):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, obs, actions, old_logp, adv, returns)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, aux
+
+    return optimizer, update
+
+
+# ----------------------------------------------------------------------
+# config + algorithm (reference: PPOConfig / Algorithm.train())
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PPOConfig:
+    env_maker: Any = None            # seed -> env (default CartPole)
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_len: int = 128
+    hidden: int = 32
+    lr: float = 3e-3
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip: float = 0.2
+    vf_coeff: float = 0.5
+    ent_coeff: float = 0.01
+    max_grad_norm: float = 0.5
+    num_epochs: int = 4
+    minibatches: int = 4
+    seed: int = 0
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    def __init__(self, config: PPOConfig):
+        import jax
+
+        self.config = config
+        if config.env_maker is not None:
+            self._env_maker = config.env_maker
+        else:
+            from ray_tpu.rllib.env import CartPoleEnv
+
+            self._env_maker = lambda seed: CartPoleEnv(seed)
+        env = self._env_maker(0)
+        self._obs_dim = env.observation_dim
+        self._num_actions = env.num_actions
+        self.params = _policy_init(jax.random.PRNGKey(config.seed),
+                                   self._obs_dim, self._num_actions,
+                                   config.hidden)
+        self._optimizer, self._update = _make_update(
+            config.lr, config.clip, config.vf_coeff, config.ent_coeff,
+            config.max_grad_norm)
+        self.opt_state = self._optimizer.init(self.params)
+        self.iteration = 0
+        self._runners: List[Any] = []
+        self._respawns = 0
+        self._spawn_runners()
+
+    def _spawn_runners(self) -> None:
+        cfg = self.config
+        self._runners = [
+            _EnvRunner.remote(self._env_maker, cfg.num_envs_per_runner,
+                              cfg.rollout_len, seed=cfg.seed + 1 + i)
+            for i in range(cfg.num_env_runners)
+        ]
+
+    def _respawn_runner(self, i: int) -> None:
+        cfg = self.config
+        old = self._runners[i]
+        try:
+            ray_tpu.kill(old)  # a merely-slow runner must not leak
+        except Exception:
+            pass
+        # fresh seed per respawn: a fixed one would replay the same env
+        # stream after every death, biasing the on-policy batch
+        self._respawns += 1
+        self._runners[i] = _EnvRunner.remote(
+            self._env_maker, cfg.num_envs_per_runner, cfg.rollout_len,
+            seed=cfg.seed + 101 + i + 1000 * self._respawns)
+
+    def _collect(self) -> List[Dict[str, Any]]:
+        """Fan the current params out, gather rollouts; a dead runner is
+        respawned and re-sampled (reference: EnvRunnerGroup
+        fault tolerance)."""
+        params_ref = ray_tpu.put(self.params)
+        batches: List[Optional[Dict[str, Any]]] = [None] * len(
+            self._runners)
+        for attempt in range(3):
+            missing = [i for i, b in enumerate(batches) if b is None]
+            if not missing:
+                break
+            refs = {}
+            for i in missing:
+                try:
+                    # a dead runner can fail at SUBMIT (handle resolves
+                    # to a dead actor) or at get (death mid-rollout).
+                    # Only ActorError means death — a TaskError (env bug)
+                    # or timeout leaves the actor alive and must not
+                    # silently respawn around it
+                    refs[i] = self._runners[i].sample.remote(params_ref)
+                except rex.ActorError:
+                    self._respawn_runner(i)
+            for i, ref in refs.items():
+                try:
+                    batches[i] = ray_tpu.get(ref, timeout=120)
+                except rex.ActorError:
+                    self._respawn_runner(i)
+        got = [b for b in batches if b is not None]
+        if not got:
+            raise rex.RayTpuError("all env runners failed")
+        return got
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: sample -> GAE -> minibatched PPO epochs."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        batches = self._collect()
+        obs, actions, logp, adv, returns, ep_returns = [], [], [], [], \
+            [], []
+        for b in batches:
+            a, r = _gae(b, cfg.gamma, cfg.gae_lambda)
+            obs.append(b["obs"].reshape(-1, self._obs_dim))
+            actions.append(b["actions"].reshape(-1))
+            logp.append(b["logp"].reshape(-1))
+            adv.append(a.reshape(-1))
+            returns.append(r.reshape(-1))
+            ep_returns.extend(b["episode_returns"])
+        obs = np.concatenate(obs)
+        actions = np.concatenate(actions)
+        logp = np.concatenate(logp)
+        adv = np.concatenate(adv)
+        returns = np.concatenate(returns)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        n = len(obs)
+        idx = np.arange(n)
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        losses = []
+        for _ in range(cfg.num_epochs):
+            rng.shuffle(idx)
+            for mb in np.array_split(idx, cfg.minibatches):
+                self.params, self.opt_state, loss, _aux = self._update(
+                    self.params, self.opt_state,
+                    jnp.asarray(obs[mb]), jnp.asarray(actions[mb]),
+                    jnp.asarray(logp[mb]), jnp.asarray(adv[mb]),
+                    jnp.asarray(returns[mb]))
+                losses.append(float(loss))
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (float(np.mean(ep_returns))
+                                    if ep_returns else float("nan")),
+            "num_episodes": len(ep_returns),
+            "num_env_steps": int(n),
+            "loss": float(np.mean(losses)),
+        }
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._runners = []

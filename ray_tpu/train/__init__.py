@@ -1,0 +1,27 @@
+"""ray_tpu.train — distributed training orchestration.
+
+Reference surface: Ray Train (ray: python/ray/train/ —
+DataParallelTrainer/BackendExecutor/WorkerGroup, ScalingConfig/
+RunConfig/FailureConfig, Checkpoint, ray.train.report). Semantics kept:
+a controller spawns a worker group of actors, each running the user's
+train loop; workers report metrics + checkpoints; worker death triggers
+a group restart from the latest checkpoint under FailureConfig.
+
+TPU-first difference: the reference's workers wire torch.distributed
+(NCCL) inside each process; here the COMPUTE path is a jitted sharded
+train step (models/train_step.py — XLA inserts the collectives), and
+checkpoints are Orbax-style sharded pytrees (save_jax_checkpoint /
+load_jax_checkpoint).
+"""
+
+from ray_tpu.train.api import (Checkpoint, FailureConfig,  # noqa: F401
+                               Result, RunConfig, ScalingConfig, Trainer,
+                               get_checkpoint, get_context,
+                               load_jax_checkpoint, report,
+                               save_jax_checkpoint)
+
+__all__ = [
+    "Trainer", "ScalingConfig", "RunConfig", "FailureConfig",
+    "Checkpoint", "Result", "report", "get_checkpoint", "get_context",
+    "save_jax_checkpoint", "load_jax_checkpoint",
+]

@@ -1,0 +1,301 @@
+"""Train controller, worker group, session API, checkpoints.
+
+Reference: ray: python/ray/train/ — v2 controller
+(train/v2/_internal/execution/controller.py), WorkerGroup
+(backend_executor.py), session (ray.train.report / get_checkpoint /
+get_context), Checkpoint (train/_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import exceptions as rex
+
+# ----------------------------------------------------------------------
+# configs (reference: ray.train.ScalingConfig / RunConfig / FailureConfig)
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0   # group restarts allowed; -1 = unlimited
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str = ""
+    storage_path: str = ""
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+
+
+class Checkpoint:
+    """Directory abstraction (reference: ray.train.Checkpoint)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def __repr__(self) -> str:
+        return f"Checkpoint({self.path})"
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    metrics_history: List[Dict[str, Any]]
+
+
+# ----------------------------------------------------------------------
+# worker-side session (reference: ray.train.report/get_checkpoint)
+# ----------------------------------------------------------------------
+
+class _Session:
+    def __init__(self, rank: int, world_size: int,
+                 checkpoint: Optional[Checkpoint]):
+        self.rank = rank
+        self.world_size = world_size
+        self.restore_checkpoint = checkpoint
+        self.lock = threading.Lock()
+        self.reports: List[Dict[str, Any]] = []
+        self.latest_checkpoint: Optional[str] = None
+
+
+# session registry keyed by executing THREAD: thread-mode actors share
+# one process (a module global would cross-talk between workers), and
+# the controller polls from a different thread than the user loop
+_sessions: Dict[int, _Session] = {}
+
+
+def _current_session() -> Optional[_Session]:
+    return _sessions.get(threading.get_ident())
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Called from inside train_loop_per_worker."""
+    session = _current_session()
+    if session is None:
+        raise RuntimeError("ray_tpu.train.report() called outside a "
+                           "train worker")
+    with session.lock:
+        entry = dict(metrics)
+        if checkpoint is not None:
+            entry["_checkpoint_path"] = checkpoint.path
+            session.latest_checkpoint = checkpoint.path
+        session.reports.append(entry)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from (set after a failure restart)."""
+    session = _current_session()
+    if session is None:
+        return None
+    return session.restore_checkpoint
+
+
+class _Context:
+    def __init__(self, rank: int, world: int):
+        self._rank, self._world = rank, world
+
+    def get_world_size(self) -> int:
+        return self._world
+
+    def get_world_rank(self) -> int:
+        return self._rank
+
+
+def get_context() -> _Context:
+    session = _current_session()
+    if session is None:
+        return _Context(0, 1)
+    return _Context(session.rank, session.world_size)
+
+
+# ----------------------------------------------------------------------
+# worker actor
+# ----------------------------------------------------------------------
+
+@ray_tpu.remote
+class _TrainWorker:
+    """One member of the WorkerGroup. max_concurrency=2 so the
+    controller can poll reports while the user loop runs."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+
+    def run(self, fn, config, checkpoint_path: Optional[str]):
+        session = _Session(
+            self.rank, self.world_size,
+            Checkpoint(checkpoint_path) if checkpoint_path else None)
+        self._session = session
+        _sessions[threading.get_ident()] = session
+        try:
+            fn(config)
+        finally:
+            _sessions.pop(threading.get_ident(), None)
+        with session.lock:
+            return list(session.reports)
+
+    def poll(self):
+        """Latest checkpoint path (or None) — runs on the actor's second
+        thread while run() executes. Only the checkpoint crosses the
+        wire: the full report history would be O(steps^2) re-shipping
+        over a long run."""
+        session = getattr(self, "_session", None)
+        if session is None:
+            return None
+        with session.lock:
+            return session.latest_checkpoint
+
+
+# ----------------------------------------------------------------------
+# controller (reference: train v2 controller + BackendExecutor)
+# ----------------------------------------------------------------------
+
+class Trainer:
+    """fit() runs train_loop_per_worker on a group of
+    scaling_config.num_workers actors; restarts the whole group from the
+    latest reported checkpoint on worker failure, up to
+    failure_config.max_failures times."""
+
+    def __init__(self, train_loop_per_worker: Callable[[dict], None],
+                 *, train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._fn = train_loop_per_worker
+        self._config = dict(train_loop_config or {})
+        self._scaling = scaling_config or ScalingConfig()
+        self._run = run_config or RunConfig()
+        if not self._run.storage_path:
+            self._run.storage_path = tempfile.mkdtemp(
+                prefix=f"ray_tpu_train_{self._run.name or 'run'}_")
+
+    def fit(self) -> Result:
+        max_failures = self._run.failure_config.max_failures
+        failures = 0
+        restore: Optional[str] = None
+        while True:
+            try:
+                return self._run_attempt(restore)
+            except _GroupFailure as gf:
+                failures += 1
+                if max_failures != -1 and failures > max_failures:
+                    raise rex.RayTpuError(
+                        f"training failed after {failures - 1} group "
+                        f"restarts: {gf.cause}") from gf.cause
+                restore = gf.latest_checkpoint
+                # surviving actors are torn down; a fresh group restarts
+                # from the last checkpoint (reference FailurePolicy)
+
+    def _run_attempt(self, restore: Optional[str]) -> Result:
+        n = self._scaling.num_workers
+        workers = [
+            _TrainWorker.options(
+                max_concurrency=2,
+                **({"resources": self._scaling.resources_per_worker}
+                   if self._scaling.resources_per_worker else {})
+            ).remote(rank, n)
+            for rank in range(n)
+        ]
+        try:
+            run_refs = [w.run.remote(self._fn, self._config, restore)
+                        for w in workers]
+            rank_of = {ref.object_id(): rank
+                       for rank, ref in enumerate(run_refs)}
+            latest_ckpt = restore
+            reports_by_rank: Dict[int, List[Dict[str, Any]]] = {}
+            pending = list(run_refs)
+            while pending:
+                done, pending = ray_tpu.wait(pending, num_returns=1,
+                                             timeout=0.25)
+                # track checkpoints as they appear so a later failure
+                # restores the freshest state
+                for w in workers:
+                    try:
+                        ck = ray_tpu.get(w.poll.remote(), timeout=10)
+                    except Exception:
+                        continue
+                    if ck:
+                        latest_ckpt = ck
+                for ref in done:
+                    try:
+                        reports = ray_tpu.get(ref)
+                    except Exception as e:
+                        raise _GroupFailure(latest_ckpt, e) from e
+                    reports_by_rank[rank_of[ref.object_id()]] = reports
+            # rank-0 reports drive the Result (reference behavior) —
+            # keyed by rank, NOT completion order
+            history = reports_by_rank.get(0, [])
+            final = dict(history[-1]) if history else {}
+            ckpt_path = final.pop("_checkpoint_path", None) or latest_ckpt
+            return Result(
+                metrics=final,
+                checkpoint=Checkpoint(ckpt_path) if ckpt_path else None,
+                path=self._run.storage_path,
+                metrics_history=[{k: v for k, v in r.items()
+                                 if k != "_checkpoint_path"}
+                                 for r in history],
+            )
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+
+
+class _GroupFailure(Exception):
+    def __init__(self, latest_checkpoint: Optional[str],
+                 cause: BaseException):
+        self.latest_checkpoint = latest_checkpoint
+        self.cause = cause
+
+
+# ----------------------------------------------------------------------
+# sharded jax checkpoints (reference role: ray.train.Checkpoint +
+# torch.save; TPU-native: Orbax sharded pytrees)
+# ----------------------------------------------------------------------
+
+def save_jax_checkpoint(path: str, tree: Any) -> Checkpoint:
+    """Synchronous Orbax save of a (possibly sharded) pytree."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, tree, force=True)
+    return Checkpoint(path)
+
+
+def load_jax_checkpoint(checkpoint: Checkpoint,
+                        target: Optional[Any] = None) -> Any:
+    """Restore a pytree (optionally into target's structure/shardings)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    if target is not None:
+        return ckptr.restore(checkpoint.path, item=target)
+    return ckptr.restore(checkpoint.path)
