@@ -34,9 +34,18 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "cancel", "kill", "get_actor", "ObjectRef", "ActorHandle", "method",
-    "available_resources", "cluster_resources", "nodes",
+    "available_resources", "cluster_resources", "nodes", "timeline",
     "get_runtime_context", "__version__",
 ]
+
+
+def timeline(filename: Optional[str] = None):
+    """Chrome-trace events for task execution (reference: ray.timeline);
+    writes JSON to filename when given, else returns the event list."""
+    events = _worker.get_worker().events
+    if filename is not None:
+        return events.dump_timeline(filename)
+    return events.timeline()
 
 
 def init(*args, **kwargs):

@@ -1,0 +1,75 @@
+"""Task profile events + chrome-trace timeline export.
+
+Reference: core worker profile events -> GCS -> `ray timeline` chrome
+tracing JSON (ray: src/ray/core_worker/profile-event area +
+python/ray/_private/state.py timeline). Events live in a bounded ring
+per worker (config event_buffer_size); the timeline pairs
+started/finished into duration events keyed by node row.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+
+class EventBuffer:
+    """Bounded ring of (ts, task_id_hex, task_name, event, node)."""
+
+    def __init__(self, maxlen: Optional[int] = None):
+        self._buf: collections.deque = collections.deque(
+            maxlen=maxlen or GLOBAL_CONFIG.event_buffer_size)
+        self._lock = threading.Lock()
+
+    def record(self, task_id, name: str, event: str,
+               node: int = -1) -> None:
+        with self._lock:
+            self._buf.append((time.perf_counter(), task_id.hex(), name,
+                              event, node))
+
+    def snapshot(self) -> List[tuple]:
+        with self._lock:
+            return list(self._buf)
+
+    def timeline(self) -> List[Dict[str, Any]]:
+        """Chrome-trace events: one complete ("X") span per
+        started->finished pair; unpaired events become instants."""
+        events = self.snapshot()
+        spans: List[Dict[str, Any]] = []
+        open_start: Dict[str, tuple] = {}
+        for ts, tid, name, event, node in events:
+            if event == "started":
+                open_start[tid] = (ts, name, node)
+            elif event == "finished" and tid in open_start:
+                t0, name0, node0 = open_start.pop(tid)
+                spans.append({
+                    "name": name0, "ph": "X", "pid": 0,
+                    "tid": max(node0, node, 0),
+                    "ts": t0 * 1e6, "dur": (ts - t0) * 1e6,
+                    "args": {"task_id": tid},
+                })
+            else:
+                spans.append({
+                    "name": f"{name}:{event}", "ph": "i", "pid": 0,
+                    "tid": max(node, 0), "ts": ts * 1e6, "s": "t",
+                    "args": {"task_id": tid},
+                })
+        # still-running (or crashed-mid-run) tasks: emit their start as
+        # an instant so the trace records them instead of dropping them
+        for tid, (t0, name0, node0) in open_start.items():
+            spans.append({
+                "name": f"{name0}:started", "ph": "i", "pid": 0,
+                "tid": max(node0, 0), "ts": t0 * 1e6, "s": "t",
+                "args": {"task_id": tid, "unfinished": True},
+            })
+        return spans
+
+    def dump_timeline(self, filename: str) -> str:
+        with open(filename, "w") as f:
+            json.dump(self.timeline(), f)
+        return filename

@@ -1,0 +1,234 @@
+"""Prometheus text-format metrics endpoint + user metrics API backing.
+
+Reference: ray::stats + per-node dashboard agent Prometheus endpoints
+(ray: src/ray/stats/, dashboard reporter) and ray.util.metrics
+(Counter/Gauge/Histogram). Serves GET /metrics on
+config metrics_export_port (0 = disabled).
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# -- user metrics registry (ray_tpu.util.metrics facade) ----------------
+
+_user_metrics: Dict[str, "_Metric"] = {}
+_user_lock = threading.Lock()
+
+
+def _escape_label(v: str) -> str:
+    """Prometheus text-format label escaping (backslash, quote, LF)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def clear_registry() -> None:
+    """Drop all user metrics (called at worker shutdown so a new
+    session's endpoint doesn't render the previous session's values)."""
+    with _user_lock:
+        _user_metrics.clear()
+
+
+class _Metric:
+    def __init__(self, name: str, description: str, kind: str):
+        self.name = name
+        self.description = description
+        self.kind = kind
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, float] = {}
+        # NOTE: subclasses call _register() at the END of their own
+        # __init__, once all their state exists
+
+    def _register(self) -> None:
+        """Publish to the scrape registry LAST (subclasses call this
+        after their own state exists — a concurrent scrape must never
+        see a half-constructed metric). Re-registration with the same
+        name+kind adopts the existing series instead of discarding it."""
+        with _user_lock:
+            prev = _user_metrics.get(self.name)
+            if prev is not None and prev.kind == self.kind \
+                    and type(prev) is type(self):
+                self._adopt(prev)
+            _user_metrics[self.name] = self
+
+    def _adopt(self, prev: "_Metric") -> None:
+        self._values = prev._values
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        return tuple(sorted((tags or {}).items()))
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = list(self._values.items())
+        for key, v in items:
+            label = ",".join(f'{k}="{_escape_label(val)}"'
+                             for k, val in key)
+            out.append(f"{self.name}{{{label}}} {v}" if label
+                       else f"{self.name} {v}")
+        return out
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, "counter")
+        self._register()
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, "gauge")
+        self._register()
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(_Metric):
+    """Prometheus-style cumulative histogram."""
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, "histogram")
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1, 10, 100])
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._register()
+
+    def _adopt(self, prev: "_Metric") -> None:
+        if getattr(prev, "boundaries", None) == self.boundaries:
+            self._counts = prev._counts
+            self._sums = prev._sums
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = list(self._counts.items())
+            sums = dict(self._sums)
+        for key, counts in items:
+            base = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+            cum = 0
+            for b, c in zip(self.boundaries, counts):
+                cum += c
+                lab = f'{base},le="{b}"' if base else f'le="{b}"'
+                out.append(f"{self.name}_bucket{{{lab}}} {cum}")
+            cum += counts[-1]
+            lab = f'{base},le="+Inf"' if base else 'le="+Inf"'
+            out.append(f"{self.name}_bucket{{{lab}}} {cum}")
+            suffix = f"{{{base}}}" if base else ""
+            out.append(f"{self.name}_sum{suffix} {sums.get(key, 0.0)}")
+            out.append(f"{self.name}_count{suffix} {cum}")
+        return out
+
+
+# -- the endpoint -------------------------------------------------------
+
+def _render_core(worker) -> List[str]:
+    """Core runtime metrics (reference: metric_defs.cc's task/object/
+    scheduler families)."""
+    stats = worker.scheduler.stats()
+    lines = []
+
+    def emit(name, kind, desc, value):
+        lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {value}")
+
+    emit("ray_tpu_tasks_submitted_total", "counter",
+         "tasks submitted to the scheduler", stats.get("submitted", 0))
+    emit("ray_tpu_tasks_dispatched_total", "counter",
+         "tasks dispatched to workers", stats.get("dispatched", 0))
+    emit("ray_tpu_tasks_finished_total", "counter",
+         "tasks finished", stats.get("finished", 0))
+    emit("ray_tpu_scheduler_ready_queue", "gauge",
+         "tasks ready for assignment", stats.get("ready_queue", 0))
+    emit("ray_tpu_scheduler_waiting_deps", "gauge",
+         "tasks blocked on dependencies", stats.get("waiting_deps", 0))
+    emit("ray_tpu_scheduler_ticks_total", "counter",
+         "scheduler ticks", stats.get("ticks", 0))
+    emit("ray_tpu_objects_in_store", "gauge",
+         "objects in the owner memory store", worker.memory_store.size())
+    emit("ray_tpu_actors_alive", "gauge", "registered live actors",
+         sum(1 for e in worker.gcs.actor_table()
+             if e.state == "ALIVE"))
+    emit("ray_tpu_nodes_alive", "gauge", "alive cluster nodes",
+         sum(1 for e in worker.gcs.node_table()
+             if e.state == "ALIVE"))
+    return lines
+
+
+def render_all(worker) -> str:
+    lines = _render_core(worker)
+    with _user_lock:
+        metrics = list(_user_metrics.values())
+    for m in metrics:
+        lines.extend(m.render())
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    def __init__(self, worker, port: int):
+        self.port = port
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = render_all(worker).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="ray_tpu_metrics")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass

@@ -161,6 +161,11 @@ class MemoryStore:
         with self._lock:
             return len(self._objects)
 
+    def entries(self) -> List[tuple]:
+        """Snapshot of (object_id, entry) pairs (state observability)."""
+        with self._lock:
+            return list(self._objects.items())
+
     def total_bytes(self) -> int:
         with self._lock:
             return sum(e.size for e in self._objects.values())

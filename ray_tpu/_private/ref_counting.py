@@ -60,6 +60,11 @@ class ReferenceCounter:
         with self._lock:
             self._refs.setdefault(object_id, _Ref()).local += 1
 
+    def num_local_references(self, object_id: ObjectID) -> int:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref.local if ref is not None else 0
+
     def remove_local_reference(self, object_id: ObjectID) -> None:
         self._maybe_delete(object_id, "local")
 

@@ -383,6 +383,8 @@ class ProcessWorkerPool:
             h.borrows.add(oid)
         with self._lock:
             self._by_task[spec.task_id] = h
+        self._worker.events.record(spec.task_id, spec.name, "started",
+                                   self.node_index)
         if payload["fn_id"] in h.sent_fns:
             payload = dict(payload, fn_blob=None)
         else:
@@ -487,6 +489,8 @@ class ProcessWorkerPool:
         from ray_tpu._private.worker import _top_level_deps
 
         spec = pending.spec
+        self._worker.events.record(exec_task_id, spec.name, "finished",
+                                   self.node_index)
         deps = _top_level_deps(spec.args, spec.kwargs)
         self._worker.reference_counter.remove_submitted_task_references(deps)
         self._worker.scheduler.notify_task_finished(

@@ -184,6 +184,34 @@ class EventScheduler(SchedulerBase):
             self._waiters.clear()
             self._dep_count.clear()
 
+    def task_table(self) -> List[Dict[str, Any]]:
+        """Live tasks (oracle-scheduler view; mirrors
+        TensorScheduler.task_table)."""
+        with self._lock:
+            rows = []
+            ready_ids = {t.spec.task_id for t in self._ready}
+            infeasible_ids = {t.spec.task_id for t in self._infeasible}
+            for tid, task in self._tasks.items():
+                if tid in self._dep_count:
+                    state = "PENDING_ARGS"
+                elif tid in infeasible_ids:
+                    state = "INFEASIBLE"
+                elif tid in ready_ids:
+                    state = "PENDING_NODE"
+                elif task.node_index >= 0:
+                    state = "RUNNING"
+                else:
+                    state = "PENDING_NODE"
+                rows.append({
+                    "task_id": tid.hex(),
+                    "name": task.spec.name,
+                    "state": state,
+                    "node_index": task.node_index,
+                    "attempt": task.spec.attempt_number,
+                    "scheduling_class": -1,
+                })
+            return rows
+
     def node_state(self, index: int) -> Optional[NodeState]:
         with self._lock:
             return self._nodes[index] if 0 <= index < len(self._nodes) \

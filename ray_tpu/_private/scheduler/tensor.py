@@ -186,6 +186,40 @@ class TensorScheduler(SchedulerBase):
             self._wake.notify()
         self._tick_thread.join(timeout=2.0)
 
+    def task_table(self) -> List[Dict[str, Any]]:
+        """Live tasks straight off the scheduler arrays (the survey's
+        'list tasks that reads back the scheduler tensors'): one row per
+        occupied arena slot, state decoded from the state vector."""
+        with self._lock:
+            rows = []
+            for slot, task in self._tasks.items():
+                st = int(self._state[slot])
+                state = {WAITING: ("PENDING_ARGS" if self._indeg[slot] > 0
+                                   else "PENDING_NODE"),
+                         RUNNING: "RUNNING",
+                         DONE: "FINISHED",
+                         FREE: "FREE"}.get(st, str(st))
+                spec = task.spec
+                rows.append({
+                    "task_id": self._tid_of.get(slot, spec.task_id).hex(),
+                    "name": spec.name,
+                    "state": state,
+                    "node_index": int(self._node_of[slot]),
+                    "attempt": spec.attempt_number,
+                    "scheduling_class": int(self._cls[slot]),
+                })
+            # queued-but-unadmitted submissions
+            for task in self._submit_q:
+                rows.append({
+                    "task_id": task.spec.task_id.hex(),
+                    "name": task.spec.name,
+                    "state": "QUEUED",
+                    "node_index": -1,
+                    "attempt": task.spec.attempt_number,
+                    "scheduling_class": -1,
+                })
+            return rows
+
     def node_state(self, index: int) -> Optional[NodeState]:
         with self._lock:
             return self._node_states[index] \

@@ -214,6 +214,15 @@ class Worker:
         from ray_tpu._private.object_recovery import ObjectRecoveryManager
         self.object_recovery = ObjectRecoveryManager(self)
 
+        # observability: task profile events + optional Prometheus port
+        from ray_tpu._private.events import EventBuffer
+        self.events = EventBuffer()
+        self.metrics_server = None
+        if GLOBAL_CONFIG.metrics_export_port:
+            from ray_tpu._private.metrics import MetricsServer
+            self.metrics_server = MetricsServer(
+                self, GLOBAL_CONFIG.metrics_export_port)
+
         # actors: ActorID -> _ActorRuntime (see actor.py)
         self.actors: Dict[ActorID, Any] = {}
         self.dead_actors: set = set()
@@ -338,6 +347,7 @@ class Worker:
         deps = _top_level_deps(spec.args, spec.kwargs)
         self.reference_counter.add_submitted_task_references(deps)
         self.task_manager.add_pending(spec, deps)
+        self.events.record(spec.task_id, spec.name, "submitted")
 
         # drop deps already available locally; a missing dep with no
         # pending producer was LOST and must reconstruct or the task
@@ -400,6 +410,8 @@ class Worker:
         return None
 
     def _dispatch(self, pending: PendingTask) -> None:
+        self.events.record(pending.spec.task_id, pending.spec.name,
+                           "dispatched", pending.node_index)
         boot = getattr(pending.spec, "_actor_boot", None)
         pool = self.pool_for_node(pending.node_index)
         if boot is not None:
@@ -491,6 +503,8 @@ class Worker:
         prev_put = self._context.put_counter
         self._context.task_id = exec_task_id
         self._context.put_counter = 0
+        self.events.record(exec_task_id, spec.name, "started",
+                           pending.node_index)
         retry_task: Optional[PendingTask] = None
         pg_token = None
         if spec.placement_group_id is not None \
@@ -530,6 +544,8 @@ class Worker:
             self._context.put_counter = prev_put
             with self._running_lock:
                 self._running_tasks.pop(exec_task_id, None)
+            self.events.record(exec_task_id, spec.name, "finished",
+                               pending.node_index)
             deps = _top_level_deps(spec.args, spec.kwargs)
             self.reference_counter.remove_submitted_task_references(deps)
             self.scheduler.notify_task_finished(
@@ -685,6 +701,12 @@ class Worker:
                 pass
         self.scheduler.shutdown()
         self.gcs.shutdown()
+        if self.metrics_server is not None:
+            self.metrics_server.shutdown()
+        # user metrics are session-scoped: a later init's endpoint must
+        # not render this session's values as live
+        from ray_tpu._private.metrics import clear_registry
+        clear_registry()
         for row, pool in list(self._node_pools.items()):
             if pool is not self.process_pool:
                 pool.shutdown()

@@ -229,22 +229,39 @@ class Trainer:
             latest_ckpt = restore
             reports_by_rank: Dict[int, List[Dict[str, Any]]] = {}
             pending = list(run_refs)
+            last_poll = 0.0
             while pending:
                 done, pending = ray_tpu.wait(pending, num_returns=1,
                                              timeout=0.25)
                 # track checkpoints as they appear so a later failure
-                # restores the freshest state
-                for w in workers:
-                    try:
-                        ck = ray_tpu.get(w.poll.remote(), timeout=10)
-                    except Exception:
-                        continue
-                    if ck:
-                        latest_ckpt = ck
+                # restores the freshest state — polled at a coarse
+                # interval (per-tick polling would cost ~4*N round trips
+                # per second for the whole run and a hung worker could
+                # stall the loop)
+                if time.monotonic() - last_poll >= 2.0:
+                    last_poll = time.monotonic()
+                    for w in workers:
+                        try:
+                            ck = ray_tpu.get(w.poll.remote(), timeout=10)
+                        except Exception:
+                            continue
+                        if ck:
+                            latest_ckpt = ck
                 for ref in done:
                     try:
                         reports = ray_tpu.get(ref)
                     except Exception as e:
+                        # final sweep: a checkpoint reported since the
+                        # last coarse poll must not be lost to the
+                        # restart
+                        for w in workers:
+                            try:
+                                ck = ray_tpu.get(w.poll.remote(),
+                                                 timeout=5)
+                            except Exception:
+                                continue
+                            if ck:
+                                latest_ckpt = ck
                         raise _GroupFailure(latest_ckpt, e) from e
                     reports_by_rank[rank_of[ref.object_id()]] = reports
             # rank-0 reports drive the Result (reference behavior) —

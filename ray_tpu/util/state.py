@@ -1,0 +1,72 @@
+"""State observability API.
+
+Reference: ray.util.state (ray: python/ray/util/state/ — list_tasks /
+list_actors / list_objects / list_nodes, summarize). The task verbs
+read straight off the scheduler's live tables — for the tensor
+scheduler that IS the device-array state (the survey's "a `list tasks`
+that reads back the scheduler tensors").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu._private import worker as worker_mod
+
+
+def list_tasks() -> List[Dict[str, Any]]:
+    """Live (queued/pending/running) tasks from the scheduler arrays."""
+    w = worker_mod.get_worker()
+    return w.scheduler.task_table()
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    """All actors from the GCS actor table (the registry of record)."""
+    w = worker_mod.get_worker()
+    return [
+        {"actor_id": e.actor_id.hex(), "name": e.name,
+         "namespace": e.namespace, "class_name": e.class_name,
+         "state": e.state, "node_index": e.node_index}
+        for e in w.gcs.actor_table()
+    ]
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Objects in the owner's store (+ shm residency and pin counts)."""
+    w = worker_mod.get_worker()
+    rows = []
+    for oid, entry in w.memory_store.entries():
+        rows.append({
+            "object_id": oid.hex(),
+            "is_exception": entry.is_exception,
+            "size": entry.size,
+            "in_shm": (w.shm_store is not None
+                       and w.shm_store.locate(oid) is not None),
+            "local_refs": w.reference_counter.num_local_references(oid),
+        })
+    return rows
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    w = worker_mod.get_worker()
+    return [
+        {"node_id": e.node_id.hex(), "index": e.index, "state": e.state,
+         "kind": e.kind, "resources": dict(e.resources)}
+        for e in w.gcs.node_table()
+    ]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    w = worker_mod.get_worker()
+    return [dict(info, pg_id=pg_id)
+            for pg_id, info in w.placement_groups.table().items()]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """Counts by state (reference: ray summary tasks)."""
+    out: Dict[str, int] = {}
+    for row in list_tasks():
+        out[row["state"]] = out.get(row["state"], 0) + 1
+    stats = worker_mod.get_worker().scheduler.stats()
+    out["FINISHED_TOTAL"] = stats.get("finished", 0)
+    return out
