@@ -25,17 +25,22 @@ def _escape_label(v: str) -> str:
 
 
 def clear_registry() -> None:
-    """Drop all user metrics (called at worker shutdown so a new
-    session's endpoint doesn't render the previous session's values)."""
+    """Drop all user metrics (test helper). User metrics are
+    PROCESS-scoped like the reference's (ray.util.metrics): they are NOT
+    cleared at worker shutdown — clearing would orphan metric objects
+    users still hold, which would keep accepting updates while silently
+    vanishing from scrapes."""
     with _user_lock:
         _user_metrics.clear()
 
 
 class _Metric:
-    def __init__(self, name: str, description: str, kind: str):
+    def __init__(self, name: str, description: str, kind: str,
+                 tag_keys: Tuple[str, ...] = ()):
         self.name = name
         self.description = description
         self.kind = kind
+        self.tag_keys = tuple(tag_keys)
         self._lock = threading.Lock()
         self._values: Dict[Tuple, float] = {}
         # NOTE: subclasses call _register() at the END of their own
@@ -57,6 +62,13 @@ class _Metric:
         self._values = prev._values
 
     def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        if tags and self.tag_keys:
+            undeclared = set(tags) - set(self.tag_keys)
+            if undeclared:
+                raise ValueError(
+                    f"metric {self.name!r} got undeclared tag keys "
+                    f"{sorted(undeclared)}; declared: "
+                    f"{list(self.tag_keys)}")
         return tuple(sorted((tags or {}).items()))
 
     def render(self) -> List[str]:
@@ -75,7 +87,7 @@ class _Metric:
 class Counter(_Metric):
     def __init__(self, name: str, description: str = "",
                  tag_keys: Tuple[str, ...] = ()):
-        super().__init__(name, description, "counter")
+        super().__init__(name, description, "counter", tag_keys)
         self._register()
 
     def inc(self, value: float = 1.0,
@@ -88,7 +100,7 @@ class Counter(_Metric):
 class Gauge(_Metric):
     def __init__(self, name: str, description: str = "",
                  tag_keys: Tuple[str, ...] = ()):
-        super().__init__(name, description, "gauge")
+        super().__init__(name, description, "gauge", tag_keys)
         self._register()
 
     def set(self, value: float,
@@ -103,7 +115,7 @@ class Histogram(_Metric):
     def __init__(self, name: str, description: str = "",
                  boundaries: Optional[List[float]] = None,
                  tag_keys: Tuple[str, ...] = ()):
-        super().__init__(name, description, "histogram")
+        super().__init__(name, description, "histogram", tag_keys)
         self.boundaries = sorted(boundaries or
                                  [0.001, 0.01, 0.1, 1, 10, 100])
         self._counts: Dict[Tuple, List[int]] = {}
@@ -133,7 +145,9 @@ class Histogram(_Metric):
         out = [f"# HELP {self.name} {self.description}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
-            items = list(self._counts.items())
+            # copy the INNER bucket lists too: observe() mutates them in
+            # place and a scrape must be internally consistent
+            items = [(k, list(v)) for k, v in self._counts.items()]
             sums = dict(self._sums)
         for key, counts in items:
             base = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)

@@ -220,8 +220,15 @@ class Worker:
         self.metrics_server = None
         if GLOBAL_CONFIG.metrics_export_port:
             from ray_tpu._private.metrics import MetricsServer
-            self.metrics_server = MetricsServer(
-                self, GLOBAL_CONFIG.metrics_export_port)
+            try:
+                self.metrics_server = MetricsServer(
+                    self, GLOBAL_CONFIG.metrics_export_port)
+            except OSError as e:
+                # a port conflict degrades to metrics-disabled; it must
+                # not fail init and leak the already-started runtime
+                logger.warning("metrics endpoint disabled: cannot bind "
+                               "port %d (%s)",
+                               GLOBAL_CONFIG.metrics_export_port, e)
 
         # actors: ActorID -> _ActorRuntime (see actor.py)
         self.actors: Dict[ActorID, Any] = {}
@@ -703,10 +710,6 @@ class Worker:
         self.gcs.shutdown()
         if self.metrics_server is not None:
             self.metrics_server.shutdown()
-        # user metrics are session-scoped: a later init's endpoint must
-        # not render this session's values as live
-        from ray_tpu._private.metrics import clear_registry
-        clear_registry()
         for row, pool in list(self._node_pools.items()):
             if pool is not self.process_pool:
                 pool.shutdown()

@@ -1,0 +1,20 @@
+"""ray_tpu.serve — online serving over actors.
+
+Reference surface: Ray Serve (ray: python/ray/serve/ —
+@serve.deployment classes, ServeController managing replica actors,
+Router with power-of-two-choices replica scheduling, model composition
+via DeploymentHandle, HTTP ingress). Minimum-viable parity: deployments
+with N replica actors, least-of-two-queues routing, handle composition
+through bind(), replica crash recovery, redeploy/scaling, and a small
+JSON HTTP ingress.
+"""
+
+from ray_tpu.serve.core import (Application, Deployment,  # noqa: F401
+                                DeploymentHandle, deployment,
+                                get_app_handle, run, shutdown, start_http,
+                                status)
+
+__all__ = [
+    "deployment", "run", "shutdown", "status", "get_app_handle",
+    "Deployment", "DeploymentHandle", "Application", "start_http",
+]

@@ -1,0 +1,348 @@
+"""Serve controller, replicas, router, handles, HTTP ingress.
+
+Reference: ray: python/ray/serve/ — _private/deployment_state.py
+(replica lifecycle), _private/router.py (power-of-two-choices),
+handle.py (DeploymentHandle), _private/http_proxy.py (ingress).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import exceptions as rex
+
+_lock = threading.Lock()
+_controller: Optional["_Controller"] = None
+
+
+# ----------------------------------------------------------------------
+# public decorator / graph building
+# ----------------------------------------------------------------------
+
+class Deployment:
+    def __init__(self, cls, name: str, num_replicas: int,
+                 max_ongoing_requests: int):
+        self._cls = cls
+        self.name = name
+        self.num_replicas = num_replicas
+        self.max_ongoing_requests = max_ongoing_requests
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None) -> "Deployment":
+        return Deployment(
+            self._cls, name or self.name,
+            num_replicas if num_replicas is not None else
+            self.num_replicas,
+            max_ongoing_requests if max_ongoing_requests is not None
+            else self.max_ongoing_requests)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        """Build the composition graph node (reference: deployment DAG);
+        bound args may themselves be Applications — they resolve to
+        handles of the child deployments at run()."""
+        return Application(self, args, kwargs)
+
+    def __repr__(self) -> str:
+        return f"Deployment({self.name}, replicas={self.num_replicas})"
+
+
+class Application:
+    def __init__(self, deployment: Deployment, args, kwargs):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+def deployment(cls=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 100):
+    """@serve.deployment decorator."""
+    def wrap(c):
+        return Deployment(c, name or c.__name__, num_replicas,
+                          max_ongoing_requests)
+
+    return wrap(cls) if cls is not None else wrap
+
+
+# ----------------------------------------------------------------------
+# replicas + router
+# ----------------------------------------------------------------------
+
+@ray_tpu.remote
+class _Replica:
+    def __init__(self, cls_blob, init_args, init_kwargs):
+        import cloudpickle
+
+        cls = cloudpickle.loads(cls_blob)
+        self.instance = cls(*init_args, **init_kwargs)
+
+    def handle_request(self, method: str, args, kwargs):
+        target = (self.instance if method == "__call__"
+                  else getattr(self.instance, method))
+        if method == "__call__" and not callable(target):
+            raise TypeError("deployment is not callable; use "
+                            "handle.<method>.remote()")
+        fn = target if method != "__call__" else self.instance.__call__
+        return fn(*args, **kwargs)
+
+
+class _ReplicaState:
+    __slots__ = ("actor", "ongoing")
+
+    def __init__(self, actor):
+        self.actor = actor
+        self.ongoing = 0
+
+
+class _DeploymentState:
+    """Replica set + router for one deployment (reference:
+    DeploymentState + Router)."""
+
+    def __init__(self, controller, dep: Deployment, init_args,
+                 init_kwargs):
+        import cloudpickle
+
+        self._controller = controller
+        self.dep = dep
+        self._cls_blob = cloudpickle.dumps(dep._cls)
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs
+        self._lock = threading.Lock()
+        self._replicas: List[_ReplicaState] = []
+        self._scale_to(dep.num_replicas)
+
+    def _spawn(self) -> _ReplicaState:
+        actor = _Replica.options(max_concurrency=8).remote(
+            self._cls_blob, self._init_args, self._init_kwargs)
+        return _ReplicaState(actor)
+
+    def _scale_to(self, n: int) -> None:
+        with self._lock:
+            while len(self._replicas) < n:
+                self._replicas.append(self._spawn())
+            while len(self._replicas) > n:
+                state = self._replicas.pop()
+                try:
+                    ray_tpu.kill(state.actor)
+                except Exception:
+                    pass
+
+    def _pick(self) -> _ReplicaState:
+        """Power-of-two-choices on tracked ongoing requests."""
+        with self._lock:
+            if not self._replicas:
+                raise rex.RayTpuError(
+                    f"deployment {self.dep.name} has no replicas")
+            if len(self._replicas) == 1:
+                return self._replicas[0]
+            a, b = random.sample(self._replicas, 2)
+            return a if a.ongoing <= b.ongoing else b
+
+    def submit(self, method: str, args, kwargs, _retry: bool = True):
+        state = self._pick()
+        with self._lock:
+            state.ongoing += 1
+        try:
+            ref = state.actor.handle_request.remote(method, args, kwargs)
+        except rex.ActorError:
+            # replica died: replace it and retry once on another
+            self._replace(state)
+            if _retry:
+                return self.submit(method, args, kwargs, _retry=False)
+            raise
+        finally:
+            # queue-length bookkeeping decays when the result resolves
+            def _dec():
+                with self._lock:
+                    state.ongoing = max(0, state.ongoing - 1)
+
+            try:
+                from ray_tpu._private import worker as worker_mod
+
+                worker_mod.get_worker().run_callback_when_ready(
+                    ref.object_id(), _dec)
+            except Exception:
+                _dec()
+        return ref
+
+    def _replace(self, dead: _ReplicaState) -> None:
+        with self._lock:
+            try:
+                self._replicas.remove(dead)
+            except ValueError:
+                return  # already replaced
+            self._replicas.append(self._spawn())
+
+    def shutdown(self) -> None:
+        self._scale_to(0)
+
+
+class DeploymentHandle:
+    """Calls route through the controller's router (reference:
+    serve.handle.DeploymentHandle). handle.remote(...) calls __call__;
+    handle.method.remote(...) calls a method. Results are ObjectRefs —
+    ray_tpu.get() them (the reference returns DeploymentResponse;
+    .result() ≙ get)."""
+
+    def __init__(self, name: str):
+        self.deployment_name = name
+
+    def _state(self) -> _DeploymentState:
+        c = _controller
+        if c is None or name_missing(c, self.deployment_name):
+            raise rex.RayTpuError(
+                f"deployment {self.deployment_name!r} is not running")
+        return c.deployments[self.deployment_name]
+
+    def remote(self, *args, **kwargs):
+        return self._state().submit("__call__", args, kwargs)
+
+    def result_of(self, *args, timeout: Optional[float] = 30.0, **kwargs):
+        return ray_tpu.get(self.remote(*args, **kwargs), timeout=timeout)
+
+    def __getattr__(self, method: str) -> "_MethodCaller":
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return _MethodCaller(self, method)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))
+
+
+def name_missing(c: "_Controller", name: str) -> bool:
+    return name not in c.deployments
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle._state().submit(self._method, args, kwargs)
+
+
+# ----------------------------------------------------------------------
+# controller
+# ----------------------------------------------------------------------
+
+class _Controller:
+    def __init__(self):
+        self.deployments: Dict[str, _DeploymentState] = {}
+        self.ingress_name: Optional[str] = None
+        self.http_server = None
+
+    def deploy_app(self, app: Application) -> DeploymentHandle:
+        handle = self._deploy_node(app)
+        self.ingress_name = app.deployment.name
+        return handle
+
+    def _deploy_node(self, app: Application) -> DeploymentHandle:
+        # depth-first: children bind first, their handles become args
+        args = tuple(self._deploy_node(a) if isinstance(a, Application)
+                     else a for a in app.args)
+        kwargs = {k: (self._deploy_node(v) if isinstance(v, Application)
+                      else v) for k, v in app.kwargs.items()}
+        name = app.deployment.name
+        existing = self.deployments.get(name)
+        if existing is not None:
+            # redeploy: replace replicas (rolling update semantics at
+            # minimum scale — new set up, old torn down)
+            existing.shutdown()
+        self.deployments[name] = _DeploymentState(self, app.deployment,
+                                                  args, kwargs)
+        return DeploymentHandle(name)
+
+    def shutdown(self) -> None:
+        for state in self.deployments.values():
+            state.shutdown()
+        self.deployments.clear()
+        if self.http_server is not None:
+            self.http_server.shutdown()
+            self.http_server = None
+
+
+# ----------------------------------------------------------------------
+# public API
+# ----------------------------------------------------------------------
+
+def run(app: Application) -> DeploymentHandle:
+    """Deploy the application graph; returns the ingress handle."""
+    global _controller
+    with _lock:
+        if _controller is None:
+            _controller = _Controller()
+        return _controller.deploy_app(app)
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    if _controller is None or name not in _controller.deployments:
+        raise rex.RayTpuError(f"no deployment named {name!r}")
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Dict[str, Any]]:
+    if _controller is None:
+        return {}
+    out = {}
+    for name, st in _controller.deployments.items():
+        with st._lock:
+            out[name] = {"replicas": len(st._replicas),
+                         "ongoing": sum(r.ongoing for r in st._replicas)}
+    return out
+
+
+def shutdown() -> None:
+    global _controller
+    with _lock:
+        if _controller is not None:
+            _controller.shutdown()
+            _controller = None
+
+
+# ----------------------------------------------------------------------
+# HTTP ingress (reference: HTTPProxy; minimal JSON POST)
+# ----------------------------------------------------------------------
+
+def start_http(port: int = 0) -> int:
+    """POST /{deployment} with a JSON body calls the deployment's
+    __call__ with the decoded payload; responds JSON. Returns the bound
+    port."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802
+            name = self.path.strip("/")
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b"null"
+            try:
+                payload = json.loads(body)
+                handle = get_app_handle(name)
+                result = ray_tpu.get(handle.remote(payload), timeout=30)
+                data = json.dumps({"result": result}).encode()
+                code = 200
+            except Exception as e:  # noqa: BLE001
+                data = json.dumps({"error": str(e)}).encode()
+                code = 500
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True,
+                     name="ray_tpu_serve_http").start()
+    with _lock:
+        global _controller
+        if _controller is None:
+            _controller = _Controller()
+        _controller.http_server = httpd
+    return httpd.server_port

@@ -264,6 +264,15 @@ class Trainer:
                                 latest_ckpt = ck
                         raise _GroupFailure(latest_ckpt, e) from e
                     reports_by_rank[rank_of[ref.object_id()]] = reports
+            # success-path final sweep: a checkpoint reported inside the
+            # last coarse-poll window must reach the Result too
+            for w in workers:
+                try:
+                    ck = ray_tpu.get(w.poll.remote(), timeout=5)
+                except Exception:
+                    continue
+                if ck:
+                    latest_ckpt = ck
             # rank-0 reports drive the Result (reference behavior) —
             # keyed by rank, NOT completion order
             history = reports_by_rank.get(0, [])

@@ -1,0 +1,18 @@
+"""ray_tpu.tune — hyperparameter optimization.
+
+Reference surface: Ray Tune (ray: python/ray/tune/ — Tuner.fit() runs N
+trials as actors under a TuneController; search spaces
+tune.grid_search/uniform/loguniform/choice; schedulers like ASHA stop
+unpromising trials early; results come back as a ResultGrid with
+get_best_result). Semantics kept at minimum-viable scale; trials run as
+framework actors, reporting through the same train.report session API.
+"""
+
+from ray_tpu.tune.tuner import (ASHAScheduler, ResultGrid,  # noqa: F401
+                                TrialResult, TuneConfig, Tuner, choice,
+                                grid_search, loguniform, report, uniform)
+
+__all__ = [
+    "Tuner", "TuneConfig", "ASHAScheduler", "ResultGrid", "TrialResult",
+    "grid_search", "choice", "uniform", "loguniform", "report",
+]

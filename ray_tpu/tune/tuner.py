@@ -1,0 +1,303 @@
+"""Tuner — trials as actors, random/grid search, ASHA early stopping.
+
+Reference: ray: python/ray/tune/ — TuneController (trial FSM +
+scheduling), search space API (tune/search/sample.py),
+ASHAScheduler (tune/schedulers/async_hyperband.py: promote the top
+1/reduction_factor of each rung, stop the rest).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import random as _random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+# ----------------------------------------------------------------------
+# search-space markers (reference: tune.grid_search / tune.uniform ...)
+# ----------------------------------------------------------------------
+
+
+class _Domain:
+    pass
+
+
+@dataclasses.dataclass
+class grid_search(_Domain):  # noqa: N801 (reference API name)
+    values: List[Any]
+
+
+@dataclasses.dataclass
+class choice(_Domain):  # noqa: N801
+    values: List[Any]
+
+    def sample(self, rng) -> Any:
+        return rng.choice(self.values)
+
+
+@dataclasses.dataclass
+class uniform(_Domain):  # noqa: N801
+    low: float
+    high: float
+
+    def sample(self, rng) -> float:
+        return rng.uniform(self.low, self.high)
+
+
+@dataclasses.dataclass
+class loguniform(_Domain):  # noqa: N801
+    low: float
+    high: float
+
+    def sample(self, rng) -> float:
+        return float(math.exp(rng.uniform(math.log(self.low),
+                                          math.log(self.high))))
+
+
+def _expand_grid(space: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cartesian product over grid_search axes (sampled axes stay)."""
+    grid_keys = [k for k, v in space.items() if isinstance(v, grid_search)]
+    if not grid_keys:
+        return [dict(space)]
+    combos = itertools.product(*[space[k].values for k in grid_keys])
+    out = []
+    for combo in combos:
+        cfg = dict(space)
+        for k, v in zip(grid_keys, combo):
+            cfg[k] = v
+        out.append(cfg)
+    return out
+
+
+def _sample(space: Dict[str, Any], rng) -> Dict[str, Any]:
+    out = {}
+    for k, v in space.items():
+        out[k] = v.sample(rng) if isinstance(v, _Domain) else v
+    return out
+
+
+# ----------------------------------------------------------------------
+# session: reuse the train report machinery (same semantics)
+# ----------------------------------------------------------------------
+
+from ray_tpu.train.api import _Session  # noqa: E402
+
+
+_sessions: Dict[int, _Session] = {}
+
+
+def report(metrics: Dict[str, Any]) -> None:
+    """Called from inside the trainable."""
+    session = _sessions.get(threading.get_ident())
+    if session is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    with session.lock:
+        session.reports.append(dict(metrics))
+
+
+@ray_tpu.remote
+class _TrialActor:
+    def __init__(self, index: int):
+        self.index = index
+        self._session: Optional[_Session] = None
+        self._stop = threading.Event()
+
+    def run(self, fn, config):
+        session = _Session(0, 1, None)
+        self._session = session
+        _sessions[threading.get_ident()] = session
+        try:
+            fn(config)
+        finally:
+            _sessions.pop(threading.get_ident(), None)
+        with session.lock:
+            return list(session.reports)
+
+    def poll(self, since: int):
+        """New reports after index `since` (incremental: polling the
+        whole history every tick would be O(steps^2))."""
+        s = self._session
+        if s is None:
+            return []
+        with s.lock:
+            return list(s.reports[since:])
+
+
+# ----------------------------------------------------------------------
+# ASHA (reference: AsyncHyperBandScheduler)
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ASHAScheduler:
+    metric: Optional[str] = None
+    mode: str = "max"
+    max_t: int = 100
+    grace_period: int = 1
+    reduction_factor: int = 3
+
+    def __post_init__(self):
+        self._rungs: Dict[int, List[float]] = {}
+        r = self.grace_period
+        self._milestones = []
+        while r < self.max_t:
+            self._milestones.append(r)
+            r *= self.reduction_factor
+
+    def on_result(self, trial_id: int, iteration: int,
+                  value: float) -> str:
+        """'continue' or 'stop' (reference: rung quantile cut)."""
+        sign = 1.0 if self.mode == "max" else -1.0
+        for m in self._milestones:
+            if iteration == m:
+                rung = self._rungs.setdefault(m, [])
+                rung.append(sign * value)
+                rung.sort(reverse=True)
+                k = max(1, len(rung) // self.reduction_factor)
+                cutoff = rung[k - 1]
+                if sign * value < cutoff:
+                    return "stop"
+        return "continue"
+
+
+# ----------------------------------------------------------------------
+# tuner / controller
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Optional[ASHAScheduler] = None
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: int
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    terminated_early: bool
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult]):
+        self._results = results
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i: int) -> TrialResult:
+        return self._results[i]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: str = "max") -> TrialResult:
+        def key(r: TrialResult):
+            v = r.metrics.get(metric, float("-inf") if mode == "max"
+                              else float("inf"))
+            return v
+
+        return (max if mode == "max" else min)(self._results, key=key)
+
+    def get_dataframe(self) -> List[Dict[str, Any]]:
+        """Rows of config+final metrics (no pandas dependency)."""
+        return [dict(r.config, **r.metrics, trial_id=r.trial_id)
+                for r in self._results]
+
+
+class Tuner:
+    def __init__(self, trainable: Callable[[dict], None], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None):
+        self._fn = trainable
+        self._space = dict(param_space or {})
+        self._cfg = tune_config or TuneConfig()
+
+    def _make_configs(self) -> List[Dict[str, Any]]:
+        rng = _random.Random(self._cfg.seed)
+        grids = _expand_grid(self._space)
+        configs = []
+        for _ in range(self._cfg.num_samples):
+            for g in grids:
+                configs.append(_sample(g, rng))
+        return configs
+
+    def fit(self) -> ResultGrid:
+        cfg = self._cfg
+        configs = self._make_configs()
+        sched = cfg.scheduler
+        metric = cfg.metric or (sched.metric if sched else None)
+        mode = cfg.mode
+
+        queue = list(enumerate(configs))
+        running: Dict[int, Dict[str, Any]] = {}  # trial_id -> state
+        results: List[Optional[TrialResult]] = [None] * len(configs)
+
+        def launch(tid: int, conf: Dict[str, Any]) -> None:
+            actor = _TrialActor.remote(tid)
+            ref = actor.run.remote(self._fn, conf)
+            running[tid] = {"actor": actor, "ref": ref, "config": conf,
+                            "seen": 0, "history": [], "stopped": False}
+
+        while queue or running:
+            while queue and len(running) < cfg.max_concurrent_trials:
+                tid, conf = queue.pop(0)
+                launch(tid, conf)
+
+            refs = [st["ref"] for st in running.values()]
+            done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.1)
+            done_ids = {r.object_id() for r in done}
+
+            for tid in list(running):
+                st = running[tid]
+                # incremental report polling drives the scheduler
+                try:
+                    new = ray_tpu.get(
+                        st["actor"].poll.remote(st["seen"]), timeout=10)
+                except Exception:
+                    new = []
+                for rep in new:
+                    st["seen"] += 1
+                    st["history"].append(rep)
+                    if sched is not None and metric is not None \
+                            and metric in rep and not st["stopped"]:
+                        verdict = sched.on_result(tid, st["seen"],
+                                                  float(rep[metric]))
+                        if verdict == "stop":
+                            st["stopped"] = True
+                            ray_tpu.kill(st["actor"])
+                            final = st["history"][-1] if st["history"] \
+                                else {}
+                            results[tid] = TrialResult(
+                                tid, st["config"], dict(final),
+                                list(st["history"]), True)
+                            running.pop(tid)
+                            break
+                if tid not in running:
+                    continue
+                if st["ref"].object_id() in done_ids:
+                    try:
+                        history = ray_tpu.get(st["ref"])
+                    except Exception:
+                        history = st["history"]  # killed or crashed
+                    final = history[-1] if history else {}
+                    results[tid] = TrialResult(
+                        tid, st["config"], dict(final), list(history),
+                        False)
+                    try:
+                        ray_tpu.kill(st["actor"])
+                    except Exception:
+                        pass
+                    running.pop(tid)
+
+        return ResultGrid([r for r in results if r is not None])

@@ -117,11 +117,12 @@ class TestTimeline:
 class TestMetricsEndpoint:
     def test_prometheus_endpoint_serves_counters(self):
         ray_tpu.shutdown()
-        # port 0 would disable; pick an ephemeral-ish fixed port via 0 ->
-        # MetricsServer binds the requested port; use a high random one
-        import random
+        # config 0 means disabled, so reserve a free port first
+        import socket
 
-        port = random.randint(30000, 50000)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
         ray_tpu.init(num_workers=2, scheduler="tensor",
                      _system_config={"metrics_export_port": port})
         try:

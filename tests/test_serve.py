@@ -1,0 +1,123 @@
+"""Serve: deployments, routing, composition, crash recovery, redeploy,
+HTTP ingress (reference behaviors from ray: python/ray/serve/tests)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=8, scheduler="tensor")
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+class TestServe:
+    def test_basic_deployment(self, rt):
+        @serve.deployment(num_replicas=2)
+        class Doubler:
+            def __call__(self, x):
+                return x * 2
+
+        handle = serve.run(Doubler.bind())
+        out = ray_tpu.get([handle.remote(i) for i in range(10)],
+                          timeout=30)
+        assert out == [i * 2 for i in range(10)]
+        assert serve.status()["Doubler"]["replicas"] == 2
+
+    def test_method_calls_and_state(self, rt):
+        @serve.deployment
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        handle = serve.run(Counter.bind(10))
+        assert ray_tpu.get(handle.incr.remote(), timeout=30) == 11
+        assert ray_tpu.get(handle.incr.remote(), timeout=30) == 12
+
+    def test_composition(self, rt):
+        @serve.deployment
+        class Embed:
+            def __call__(self, x):
+                return x + 1
+
+        @serve.deployment
+        class Pipeline:
+            def __init__(self, embed):
+                self.embed = embed
+
+            def __call__(self, x):
+                inner = ray_tpu.get(self.embed.remote(x), timeout=30)
+                return inner * 100
+
+        handle = serve.run(Pipeline.bind(Embed.bind()))
+        assert ray_tpu.get(handle.remote(4), timeout=30) == 500
+
+    def test_replica_crash_recovery(self, rt):
+        @serve.deployment(num_replicas=2)
+        class Svc:
+            def __call__(self, x):
+                return x
+
+        handle = serve.run(Svc.bind())
+        assert ray_tpu.get(handle.remote(1), timeout=30) == 1
+        # kill ONE replica behind the router's back
+        state = serve.core._controller.deployments["Svc"]
+        ray_tpu.kill(state._replicas[0].actor)
+        # requests keep succeeding (retry + replacement)
+        out = ray_tpu.get([handle.remote(i) for i in range(20)],
+                          timeout=30)
+        assert out == list(range(20))
+        assert serve.status()["Svc"]["replicas"] == 2
+
+    def test_redeploy_updates(self, rt):
+        @serve.deployment
+        class V:
+            def __call__(self, x):
+                return "v1"
+
+        handle = serve.run(V.bind())
+        assert ray_tpu.get(handle.remote(0), timeout=30) == "v1"
+
+        @serve.deployment(name="V")
+        class V2:
+            def __call__(self, x):
+                return "v2"
+
+        handle = serve.run(V2.bind())
+        assert ray_tpu.get(handle.remote(0), timeout=30) == "v2"
+
+    def test_options_scaling(self, rt):
+        @serve.deployment
+        class S:
+            def __call__(self, x):
+                return x
+
+        serve.run(S.options(num_replicas=3).bind())
+        assert serve.status()["S"]["replicas"] == 3
+
+    def test_http_ingress(self, rt):
+        @serve.deployment
+        class Api:
+            def __call__(self, payload):
+                return {"sum": payload["a"] + payload["b"]}
+
+        serve.run(Api.bind())
+        port = serve.start_http(0)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/Api",
+            data=json.dumps({"a": 2, "b": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        body = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert body == {"result": {"sum": 5}}

@@ -1,0 +1,100 @@
+"""Tune: search spaces, trial execution, ASHA early stopping
+(reference behaviors from ray: python/ray/tune/tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=8, scheduler="tensor",
+                 ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestSearchSpace:
+    def test_grid_search_expands(self, rt):
+        def trainable(config):
+            tune.report({"score": config["a"] * 10 + config["b"]})
+
+        grid = tune.Tuner(
+            trainable,
+            param_space={"a": tune.grid_search([1, 2, 3]),
+                         "b": tune.grid_search([0, 1])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+        ).fit()
+        assert len(grid) == 6
+        best = grid.get_best_result("score", "max")
+        assert best.config == {"a": 3, "b": 1}
+        assert best.metrics["score"] == 31
+
+    def test_random_sampling(self, rt):
+        def trainable(config):
+            tune.report({"loss": (config["lr"] - 0.1) ** 2})
+
+        grid = tune.Tuner(
+            trainable,
+            param_space={"lr": tune.loguniform(1e-4, 1.0),
+                         "units": tune.choice([16, 32])},
+            tune_config=tune.TuneConfig(num_samples=8, metric="loss",
+                                        mode="min"),
+        ).fit()
+        assert len(grid) == 8
+        assert all(r.config["units"] in (16, 32) for r in grid)
+        best = grid.get_best_result("loss", "min")
+        assert best.metrics["loss"] == min(r.metrics["loss"] for r in grid)
+
+    def test_multiple_reports_history(self, rt):
+        def trainable(config):
+            for i in range(5):
+                tune.report({"iter": i, "acc": i * config["m"]})
+
+        grid = tune.Tuner(
+            trainable, param_space={"m": tune.grid_search([1, 2])},
+            tune_config=tune.TuneConfig(metric="acc", mode="max"),
+        ).fit()
+        best = grid.get_best_result("acc", "max")
+        assert best.metrics["acc"] == 8
+        assert len(best.metrics_history) == 5
+
+
+class TestASHA:
+    def test_asha_stops_bad_trials(self, rt):
+        """Bad trials (low plateau) stop at early rungs; good ones run
+        to completion."""
+        import time
+
+        iters_run = {}
+
+        def trainable(config):
+            for i in range(1, 13):
+                tune.report({"score": config["quality"] * i,
+                             "i": i})
+                time.sleep(0.03)
+            iters_run[config["quality"]] = 12
+
+        sched = tune.ASHAScheduler(metric="score", mode="max", max_t=12,
+                                   grace_period=2, reduction_factor=2)
+        # good trials first (bounded concurrency): by the time the bad
+        # ones reach a rung, the cutoff is established — ASHA is
+        # asynchronous, so first-arrivals at an empty rung always pass
+        grid = tune.Tuner(
+            trainable,
+            param_space={"quality": tune.grid_search(
+                [10, 10, 10, 1, 1, 1])},
+            tune_config=tune.TuneConfig(metric="score", mode="max",
+                                        scheduler=sched,
+                                        max_concurrent_trials=3),
+        ).fit()
+        assert len(grid) == 6
+        stopped = [r for r in grid if r.terminated_early]
+        finished = [r for r in grid if not r.terminated_early]
+        # at least one bad trial was cut early, and the best finished
+        assert any(r.config["quality"] == 1 for r in stopped)
+        assert any(r.config["quality"] == 10 for r in finished)
+        best = grid.get_best_result("score", "max")
+        assert best.config["quality"] == 10
