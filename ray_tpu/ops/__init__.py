@@ -1,8 +1,12 @@
 """ray_tpu.ops — TPU kernels (Pallas) + their XLA reference paths."""
 
+from ray_tpu.ops.moe import (moe_ffn_reference, moe_ffn_sharded,  # noqa: F401
+                             top1_dispatch)
+from ray_tpu.ops.pipeline import pipeline_forward  # noqa: F401
 from ray_tpu.ops.ring_attention import (  # noqa: F401
     attention_reference, block_attention, ring_attention,
     ring_attention_sharded)
 
 __all__ = ["ring_attention", "ring_attention_sharded", "block_attention",
-           "attention_reference"]
+           "attention_reference", "moe_ffn_sharded", "moe_ffn_reference",
+           "top1_dispatch", "pipeline_forward"]

@@ -1,0 +1,131 @@
+"""Mixture-of-Experts — expert parallelism over the `expert` mesh axis.
+
+Absent from the reference core (SURVEY.md §2.3: integration-only), so
+built TPU-first: top-1 capacity-factor routing (the Switch-Transformer
+formulation) producing a dense dispatch tensor, tokens exchanged to
+their experts with jax.lax.all_to_all over the ICI inside shard_map,
+per-device expert FFMs as one batched einsum on the MXU, and the
+reverse all_to_all + weighted combine.
+
+Layout inside shard_map over ("expert",):
+  tokens   [T_local, D]      (token axis sharded over `expert`)
+  experts  [E_local, ...]    (expert weights sharded over `expert`)
+  dispatch [E_total, C, D]   per device -> all_to_all -> each device
+           holds its E_local experts' slices from every peer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_dispatch(logits: jnp.ndarray, capacity: int
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The routing kernel: token -> (expert, slot) under capacity.
+
+    logits [T, E]. Returns (dispatch [T, E, C] one-hot f32,
+    combine [T, E, C] prob-weighted, aux_loss scalar — the
+    load-balancing loss of Shazeer et al.). Tokens beyond an expert's
+    capacity are DROPPED (standard switch routing; the residual path
+    carries them)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                      # [T]
+    prob = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)    # [T, E]
+    # position of each token within its expert's queue
+    position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # [T, E]
+    keep = (position < capacity) & (onehot > 0)
+    slot = jnp.where(keep, position, 0).astype(jnp.int32)
+    dispatch = (keep[..., None]
+                * jax.nn.one_hot(slot, capacity, dtype=jnp.float32))
+    combine = dispatch * prob[:, None, None]
+    # load balancing: fraction routed * mean prob, per expert
+    frac = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_ffn_local(tokens, w_router, w_in, w_out, capacity_factor: float,
+                  axis_name: str = "expert"):
+    """The shard_map body: tokens [T,D] (this device's shard), w_router
+    [D,E_total], w_in [E_local,D,F], w_out [E_local,F,D]. Returns
+    ([T,D] expert outputs combined per token, aux loss)."""
+    n = jax.lax.psum(1, axis_name)
+    T, D = tokens.shape
+    e_local = w_in.shape[0]
+    E = e_local * n
+    capacity = max(1, int(T * capacity_factor / E))
+
+    logits = tokens @ w_router                       # [T, E]
+    dispatch, combine, aux = top1_dispatch(logits, capacity)
+
+    # gather tokens into expert slots: [E, C, D]
+    slots = jnp.einsum("tec,td->ecd", dispatch, tokens)
+    # exchange over the ring: split the expert axis across devices and
+    # concat the peer shards -> [E_local, n*C, D] on each device
+    slots = slots.reshape(n, e_local, capacity, D)
+    slots = jax.lax.all_to_all(slots, axis_name, split_axis=0,
+                               concat_axis=0, tiled=False)
+    slots = jnp.moveaxis(slots, 0, 1).reshape(e_local, n * capacity, D)
+
+    # expert FFN (batched over local experts -> one MXU einsum chain)
+    h = jnp.einsum("ecd,edf->ecf", slots, w_in)
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("ecf,efd->ecd", h, w_out)
+
+    # reverse exchange: send each peer its tokens' results back
+    out = out.reshape(e_local, n, capacity, D)
+    out = jnp.moveaxis(out, 1, 0)
+    out = jax.lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)
+    out = out.reshape(E, capacity, D)
+
+    # combine back per token, weighted by the router prob
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    aux = jax.lax.pmean(aux, axis_name)
+    return y, aux
+
+
+def moe_ffn_reference(tokens, w_router, w_in_full, w_out_full,
+                      capacity_factor: float):
+    """Single-device oracle with identical routing/capacity semantics.
+    tokens [T,D], w_in_full [E,D,F], w_out_full [E,F,D]."""
+    T, D = tokens.shape
+    E = w_in_full.shape[0]
+    capacity = max(1, int(T * capacity_factor / E))
+    logits = tokens @ w_router
+    dispatch, combine, aux = top1_dispatch(logits, capacity)
+    slots = jnp.einsum("tec,td->ecd", dispatch, tokens)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, w_in_full))
+    out = jnp.einsum("ecf,efd->ecd", h, w_out_full)
+    return jnp.einsum("tec,ecd->td", combine, out), aux
+
+
+def moe_ffn_sharded(tokens, w_router, w_in, w_out, mesh,
+                    capacity_factor: float = 1.25,
+                    axis_name: str = "expert"):
+    """Global entry: tokens [T, D] sharded over the expert axis (token
+    rows), w_in/w_out [E, ...] sharded over experts, router replicated.
+    NOTE: per-device routing — each device routes ITS tokens against all
+    experts with per-shard capacity (the standard data-local
+    formulation)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.collectives import shard_map_norep
+
+    fn = functools.partial(moe_ffn_local,
+                           capacity_factor=capacity_factor,
+                           axis_name=axis_name)
+    sm = shard_map_norep()
+    return sm(fn, mesh=mesh,
+              in_specs=(P(axis_name, None), P(None, None),
+                        P(axis_name, None, None),
+                        P(axis_name, None, None)),
+              out_specs=(P(axis_name, None), P()))(
+                  tokens, w_router, w_in, w_out)

@@ -228,24 +228,11 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "seq",
                   ("batch", "act_seq", "kv_heads", "head_dim")))
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal, impl=impl, interpret=interpret)
-    sm = _shard_map_fn()
+    from ray_tpu.parallel.collectives import shard_map_norep
+
+    sm = shard_map_norep()
     return sm(fn, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
               out_specs=q_spec)(q, k, v)
-
-
-@functools.lru_cache(maxsize=1)
-def _shard_map_fn():
-    """shard_map with replication checking off, across jax versions."""
-    import inspect
-
-    if hasattr(jax, "shard_map"):
-        params = inspect.signature(jax.shard_map).parameters
-        if "check_vma" in params:
-            return functools.partial(jax.shard_map, check_vma=False)
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map
-
-    return functools.partial(shard_map, check_rep=False)
 
 
 def attention_reference(q, k, v, causal: bool = True):

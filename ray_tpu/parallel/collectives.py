@@ -96,3 +96,22 @@ def send_recv(x, group: "CollectiveGroup | str", shift: int = 1):
     n = lax.psum(1, name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, name, perm)
+
+
+def shard_map_norep():
+    """shard_map with replication checking disabled, across jax
+    versions (the manual-collective ops — ring attention, MoE dispatch,
+    pipelining — all need it)."""
+    import functools
+    import inspect
+
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        params = inspect.signature(jax.shard_map).parameters
+        if "check_vma" in params:
+            return functools.partial(jax.shard_map, check_vma=False)
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+
+    return functools.partial(shard_map, check_rep=False)

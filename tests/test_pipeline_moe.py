@@ -1,0 +1,138 @@
+"""Pipeline (pipe axis) + MoE (expert axis) parallelism ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.moe import moe_ffn_reference, moe_ffn_sharded
+from ray_tpu.ops.pipeline import pipeline_forward
+from ray_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope="module")
+def pipe_mesh():
+    cfg = mesh_lib.MeshConfig(pipe=4, tensor=2)
+    return mesh_lib.make_mesh(cfg, jax.devices()[:8])
+
+
+@pytest.fixture(scope="module")
+def expert_mesh():
+    cfg = mesh_lib.MeshConfig(expert=4, tensor=2)
+    return mesh_lib.make_mesh(cfg, jax.devices()[:8])
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stacked_params(n_stages, d, key):
+    ks = jax.random.split(key, 2)
+    return {
+        "w": jax.random.normal(ks[0], (n_stages, d, d)) * 0.3,
+        "b": jax.random.normal(ks[1], (n_stages, d)) * 0.1,
+    }
+
+
+class TestPipeline:
+    def test_matches_sequential(self, pipe_mesh):
+        d, M, mb = 16, 6, 4
+        params = _stacked_params(4, d, jax.random.PRNGKey(0))
+        xs = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d))
+
+        with pipe_mesh:
+            out = jax.jit(lambda p, x: pipeline_forward(
+                _stage_fn, p, x, pipe_mesh))(params, xs)
+
+        ref = xs
+        for i in range(4):
+            stage = {"w": params["w"][i], "b": params["b"][i]}
+            ref = jax.vmap(lambda m, _s=stage: _stage_fn(_s, m))(ref)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_pipeline_differentiates(self, pipe_mesh):
+        """One jitted step takes grads THROUGH the ppermute chain — the
+        whole pipeline is a single program, the TPU-first replacement
+        for the reference's compiled actor DAGs."""
+        d, M, mb = 8, 4, 2
+        params = _stacked_params(4, d, jax.random.PRNGKey(2))
+        xs = jax.random.normal(jax.random.PRNGKey(3), (M, mb, d))
+
+        def loss_pipe(p):
+            return jnp.sum(pipeline_forward(_stage_fn, p, xs, pipe_mesh)
+                           ** 2)
+
+        def loss_seq(p):
+            y = xs
+            for i in range(4):
+                stage = {"w": p["w"][i], "b": p["b"][i]}
+                y = jax.vmap(lambda m, _s=stage: _stage_fn(_s, m))(y)
+            return jnp.sum(y ** 2)
+
+        with pipe_mesh:
+            g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+        g_seq = jax.grad(loss_seq)(params)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                       np.asarray(g_seq[k]),
+                                       atol=1e-4, rtol=1e-4)
+
+
+class TestMoE:
+    def _weights(self, E, D, F, key):
+        ks = jax.random.split(key, 3)
+        return (jax.random.normal(ks[0], (D, E)) * 0.3,       # router
+                jax.random.normal(ks[1], (E, D, F)) * 0.3,    # w_in
+                jax.random.normal(ks[2], (E, F, D)) * 0.3)    # w_out
+
+    def test_matches_per_shard_reference(self, expert_mesh):
+        """Sharded all_to_all MoE == per-shard dense reference (same
+        data-local routing + capacity semantics)."""
+        n, E, D, F, T = 4, 8, 16, 32, 64
+        router, w_in, w_out = self._weights(E, D, F,
+                                            jax.random.PRNGKey(0))
+        tokens = jax.random.normal(jax.random.PRNGKey(1), (T, D))
+
+        with expert_mesh:
+            out, aux = jax.jit(lambda t, r, wi, wo: moe_ffn_sharded(
+                t, r, wi, wo, expert_mesh, capacity_factor=2.0))(
+                    tokens, router, w_in, w_out)
+
+        refs, auxes = [], []
+        for shard in tokens.reshape(n, T // n, D):
+            o, a = moe_ffn_reference(shard, router, w_in, w_out,
+                                     capacity_factor=2.0)
+            refs.append(o)
+            auxes.append(a)
+        ref = jnp.concatenate(refs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(float(aux), float(np.mean(auxes)),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_moe_differentiates(self, expert_mesh):
+        E, D, F, T = 8, 8, 16, 32
+        router, w_in, w_out = self._weights(E, D, F,
+                                            jax.random.PRNGKey(2))
+        tokens = jax.random.normal(jax.random.PRNGKey(3), (T, D))
+
+        def loss(wi):
+            out, aux = moe_ffn_sharded(tokens, router, wi, w_out,
+                                       expert_mesh, capacity_factor=2.0)
+            return jnp.sum(out ** 2) + 0.01 * aux
+
+        with expert_mesh:
+            g = jax.jit(jax.grad(loss))(w_in)
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).max()) > 0
+
+    def test_capacity_drops_overflow(self):
+        """Routing kernel: tokens beyond capacity get zero dispatch."""
+        from ray_tpu.ops.moe import top1_dispatch
+
+        # all tokens prefer expert 0; capacity 2 keeps only the first 2
+        logits = jnp.tile(jnp.asarray([[10.0, 0.0]]), (5, 1))
+        dispatch, combine, _aux = top1_dispatch(logits, capacity=2)
+        routed = np.asarray(dispatch.sum(axis=(1, 2)))
+        np.testing.assert_allclose(routed, [1, 1, 0, 0, 0])
