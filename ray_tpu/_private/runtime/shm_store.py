@@ -27,8 +27,86 @@ from ray_tpu._private.serialization import SerializedObject
 _ALIGN = 64  # cache-line align allocations
 
 
+class PyFreeList:
+    """Pure-Python first-fit free list (the fallback when the native
+    C++ allocator in ray_tpu/_native/allocator.cc can't build/load;
+    identical first-fit-by-offset semantics, parity-tested)."""
+
+    def __init__(self, size: int, align: int = _ALIGN):
+        self._align = align
+        self._free: List[Tuple[int, int]] = [(0, size)]
+
+    def _round(self, nbytes: int) -> int:
+        a = self._align
+        return max(a, (nbytes + a - 1) & ~(a - 1))
+
+    def allocate(self, nbytes: int) -> int:
+        nbytes = self._round(nbytes)
+        for i, (off, sz) in enumerate(self._free):
+            if sz >= nbytes:
+                if sz == nbytes:
+                    self._free.pop(i)
+                else:
+                    self._free[i] = (off + nbytes, sz - nbytes)
+                return off
+        return -1
+
+    def free(self, offset: int, nbytes: int) -> None:
+        nbytes = self._round(nbytes)
+        free = self._free
+        lo, hi = 0, len(free)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if free[mid][0] < offset:
+                lo = mid + 1
+            else:
+                hi = mid
+        # overlap detection mirrors the native allocator: a double free
+        # must raise, not silently corrupt the free list
+        if lo < len(free) and offset + nbytes > free[lo][0]:
+            raise ValueError(
+                f"invalid free: [{offset}, {offset + nbytes}) overlaps "
+                "an existing hole (double free?)")
+        if lo > 0:
+            po, ps = free[lo - 1]
+            if po + ps > offset:
+                raise ValueError(
+                    f"invalid free: [{offset}, {offset + nbytes}) "
+                    "overlaps an existing hole (double free?)")
+        free.insert(lo, (offset, nbytes))
+        if lo + 1 < len(free):
+            o, s = free[lo]
+            o2, s2 = free[lo + 1]
+            if o + s == o2:
+                free[lo] = (o, s + s2)
+                free.pop(lo + 1)
+        if lo > 0:
+            o, s = free[lo - 1]
+            o2, s2 = free[lo]
+            if o + s == o2:
+                free[lo - 1] = (o, s + s2)
+                free.pop(lo)
+
+    def free_bytes(self) -> int:
+        return sum(s for _, s in self._free)
+
+    def num_holes(self) -> int:
+        return len(self._free)
+
+
+def make_free_list(size: int, align: int = _ALIGN):
+    """Native C++ allocator when buildable, Python fallback otherwise."""
+    try:
+        from ray_tpu._native import NativeFreeList
+
+        return NativeFreeList(size, align)
+    except ImportError:
+        return PyFreeList(size, align)
+
+
 class ShmArena:
-    """A named shared-memory segment + first-fit free-list allocator.
+    """A named shared-memory segment + free-list allocator (native C++
+    core via ray_tpu/_native, Python fallback).
 
     The allocator lives ONLY in the owner process; attached clients
     (worker processes) are handed (offset, size) pairs and use views.
@@ -52,8 +130,7 @@ class ShmArena:
         self.name = self._shm.name
         self.size = self._shm.size
         self._owner = create
-        # free list: sorted list of (offset, size), coalesced on free
-        self._free: List[Tuple[int, int]] = [(0, self.size)] if create else []
+        self._alloc = make_free_list(self.size) if create else None
         self._lock = threading.Lock()
 
     @classmethod
@@ -62,48 +139,31 @@ class ShmArena:
 
     # -- allocator (owner side only) ---------------------------------------
     def allocate(self, nbytes: int) -> int:
-        nbytes = max(_ALIGN, (nbytes + _ALIGN - 1) & ~(_ALIGN - 1))
+        if self._alloc is None:
+            raise RuntimeError("allocate() on an ATTACHED arena: only "
+                               "the owner process allocates; clients "
+                               "request offsets over the create RPC")
         with self._lock:
-            for i, (off, sz) in enumerate(self._free):
-                if sz >= nbytes:
-                    if sz == nbytes:
-                        self._free.pop(i)
-                    else:
-                        self._free[i] = (off + nbytes, sz - nbytes)
-                    return off
-        raise ObjectStoreFullError(
-            f"shm arena full: requested {nbytes} bytes, "
-            f"{self.free_bytes()} free (fragmented across "
-            f"{len(self._free)} holes)")
+            off = self._alloc.allocate(nbytes)
+            if off >= 0:
+                return off
+            raise ObjectStoreFullError(
+                f"shm arena full: requested {nbytes} bytes, "
+                f"{self._alloc.free_bytes()} free (fragmented across "
+                f"{self._alloc.num_holes()} holes)")
 
     def free(self, offset: int, nbytes: int) -> None:
-        nbytes = max(_ALIGN, (nbytes + _ALIGN - 1) & ~(_ALIGN - 1))
+        if self._alloc is None:
+            raise RuntimeError("free() on an ATTACHED arena: only the "
+                               "owner process manages allocations")
         with self._lock:
-            # insert sorted + coalesce with neighbors
-            free = self._free
-            lo, hi = 0, len(free)
-            while lo < hi:
-                mid = (lo + hi) // 2
-                if free[mid][0] < offset:
-                    lo = mid + 1
-                else:
-                    hi = mid
-            free.insert(lo, (offset, nbytes))
-            if lo + 1 < len(free):
-                o, s = free[lo]
-                o2, s2 = free[lo + 1]
-                if o + s == o2:
-                    free[lo] = (o, s + s2)
-                    free.pop(lo + 1)
-            if lo > 0:
-                o, s = free[lo - 1]
-                o2, s2 = free[lo]
-                if o + s == o2:
-                    free[lo - 1] = (o, s + s2)
-                    free.pop(lo)
+            self._alloc.free(offset, nbytes)
 
     def free_bytes(self) -> int:
-        return sum(s for _, s in self._free)
+        if self._alloc is None:
+            return 0  # attached client: no allocator view
+        with self._lock:
+            return self._alloc.free_bytes()
 
     # -- data access (any process) -----------------------------------------
     def view(self, offset: int, nbytes: int) -> memoryview:

@@ -98,6 +98,13 @@ def send_recv(x, group: "CollectiveGroup | str", shift: int = 1):
     return lax.ppermute(x, name, perm)
 
 
+def _cached_once(fn):
+    import functools
+
+    return functools.lru_cache(maxsize=1)(fn)
+
+
+@_cached_once
 def shard_map_norep():
     """shard_map with replication checking disabled, across jax
     versions (the manual-collective ops — ring attention, MoE dispatch,

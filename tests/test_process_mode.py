@@ -53,7 +53,7 @@ class TestShmArena:
             a.free(o1, 1000)
             a.free(o3, 3000)
             # all three holes coalesce back into one full-size block
-            assert len(a._free) == 1
+            assert a._alloc.num_holes() == 1
             assert a.free_bytes() == a.size
             assert a.free_bytes() > free0
         finally:
