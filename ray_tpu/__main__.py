@@ -1,0 +1,141 @@
+"""Command-line interface: python -m ray_tpu <command>.
+
+Reference surface: the ray CLI (ray: python/ray/scripts/scripts.py —
+status / microbenchmark / job submit / timeline). The runtime here is
+in-process (no daemons), so inspection commands either start an
+ephemeral session (status, microbenchmark, bench) or scrape a running
+driver's Prometheus endpoint (status --metrics-port).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_status(args) -> int:
+    if args.metrics_port:
+        import urllib.request
+
+        url = f"http://127.0.0.1:{args.metrics_port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        print(body)
+        return 0
+    import os
+
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    print("node resources:")
+    for k, v in ray_tpu.cluster_resources().items():
+        if v and v < 1e17:
+            print(f"  {k}: {v}")
+    print(f"  worker_mode: "
+          f"{ray_tpu._config.worker_mode}")
+    print(f"  cpus detected: {os.cpu_count()}")
+    try:
+        import jax
+
+        print(f"  jax devices: "
+              f"{[d.device_kind for d in jax.devices()]}")
+    except Exception as e:  # noqa: BLE001
+        print(f"  jax unavailable: {e}")
+    ray_tpu.shutdown()
+    return 0
+
+
+def _cmd_microbenchmark(args) -> int:
+    from ray_tpu._private import perf
+
+    for mode in ("thread", "process"):
+        r = perf.e2e_task_throughput(n_tasks=args.num_tasks, mode=mode)
+        print(f"{mode}: {r['tasks_per_sec']:.0f} tasks/s "
+              f"({r['n_tasks']} tasks in {r['seconds']:.2f}s)")
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    import subprocess
+
+    cmd = [sys.executable, "bench.py"] + (["--smoke"] if args.smoke
+                                          else [])
+    return subprocess.call(cmd)
+
+
+def _cmd_job(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    entry = list(args.entrypoint)
+    if entry and entry[0] == "--":  # argparse REMAINDER keeps the --
+        entry = entry[1:]
+    if not entry:
+        print("usage: python -m ray_tpu job -- <command ...>",
+              file=sys.stderr)
+        return 2
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=" ".join(entry))
+    print(f"submitted {job_id}")
+    if args.no_wait:
+        print(f"logs: {client._job(job_id).log_path}")
+        return 0
+    status = client.wait_until_finish(job_id, timeout=args.timeout)
+    print(client.get_job_logs(job_id), end="")
+    print(f"status: {status}")
+    return 0 if status == "SUCCEEDED" else 1
+
+
+def _cmd_summary(args) -> int:
+    """Summarize a timeline JSON produced by ray_tpu.timeline()."""
+    with open(args.trace) as f:
+        events = json.load(f)
+    spans = [e for e in events if e.get("ph") == "X"]
+    by_name: dict = {}
+    for e in spans:
+        st = by_name.setdefault(e["name"], [0, 0.0])
+        st[0] += 1
+        st[1] += e.get("dur", 0.0) / 1e6
+    print(f"{'task':40} {'count':>8} {'total_s':>10}")
+    for name, (count, total) in sorted(by_name.items(),
+                                       key=lambda kv: -kv[1][1]):
+        print(f"{name[:40]:40} {count:>8} {total:>10.3f}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu",
+        description="ray_tpu command line interface")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("status", help="show node/cluster resources")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="scrape a running driver's metrics endpoint")
+    p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser("microbenchmark",
+                       help="task throughput micro-benchmark")
+    p.add_argument("--num-tasks", type=int, default=2000)
+    p.set_defaults(fn=_cmd_microbenchmark)
+
+    p = sub.add_parser("bench", help="run the headline bench.py")
+    p.add_argument("--smoke", action="store_true")
+    p.set_defaults(fn=_cmd_bench)
+
+    p = sub.add_parser("job", help="submit a driver script as a job")
+    p.add_argument("--no-wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="command to run (everything after 'job')")
+    p.set_defaults(fn=_cmd_job)
+
+    p = sub.add_parser("summary", help="summarize a timeline trace")
+    p.add_argument("trace", help="JSON from ray_tpu.timeline(file)")
+    p.set_defaults(fn=_cmd_summary)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,14 +36,23 @@ def _build() -> bool:
         # per-pid temp: concurrent builders (two drivers, parallel
         # pytest) must not install each other's half-written output
         tmp = f"{_SO}.{os.getpid()}.tmp"
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
-               "-o", tmp]
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
-        return True
+        try:
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+                   "-o", tmp]
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, _SO)
+            return True
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     except (OSError, subprocess.SubprocessError) as e:
-        logger.warning("native allocator build failed (%s); using the "
-                       "Python fallback", e)
+        detail = ""
+        stderr = getattr(e, "stderr", None)
+        if stderr:
+            detail = ": " + stderr.decode(errors="replace").strip()[:500]
+        logger.warning("native allocator build failed (%s%s); using the "
+                       "Python fallback", e, detail)
         return False
 
 

@@ -1,0 +1,145 @@
+"""Autoscaler — demand-driven node provisioning.
+
+Reference surface: the autoscaler monitor loop
+(ray: python/ray/autoscaler/_private/ — StandardAutoscaler reads
+pending demand from the GCS, bin-packs over node types, asks a
+NodeProvider to launch/terminate; the fake_multi_node provider is the
+test harness). Here: the monitor reads the scheduler's live tables
+(ready backlog + infeasible tasks), asks the provider for nodes when
+demand persists, and releases idle ones after a timeout. The provider
+protocol is two callables — the virtual-cluster provider backs them
+with Worker.add_cluster_node/on_node_failure, a cloud provider would
+back them with instance APIs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    min_nodes: int = 0
+    max_nodes: int = 4
+    # demand must persist this many consecutive polls before scaling up
+    upscale_ticks: int = 2
+    idle_timeout_s: float = 10.0
+    poll_interval_s: float = 0.25
+
+
+class VirtualNodeProvider:
+    """The fake-multi-node provider: launches REAL per-node runtimes on
+    this host (reference: autoscaler/_private/fake_multi_node)."""
+
+    def __init__(self, worker, num_cpus: float = 4.0,
+                 num_workers: int = 2):
+        self._worker = worker
+        self._num_cpus = num_cpus
+        self._num_workers = num_workers
+
+    def create_node(self):
+        return self._worker.add_cluster_node(
+            num_cpus=self._num_cpus, num_workers=self._num_workers)
+
+    def terminate_node(self, entry) -> None:
+        self._worker.on_node_failure(entry.node_id,
+                                     reason="autoscaler scale-down")
+
+
+class Autoscaler:
+    """Monitor loop over the scheduler's live state."""
+
+    def __init__(self, worker, provider,
+                 config: Optional[AutoscalerConfig] = None):
+        self._worker = worker
+        self._provider = provider
+        self._config = config or AutoscalerConfig()
+        self._nodes: List[Any] = []       # provider-launched entries
+        self._pressure_ticks = 0
+        self._idle_since: Dict[int, float] = {}  # node index -> t
+        self._shutdown = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.num_upscales = 0
+        self.num_downscales = 0
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        for _ in range(self._config.min_nodes):
+            self._nodes.append(self._provider.create_node())
+            self.num_upscales += 1
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ray_tpu_autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # -- the monitor loop ----------------------------------------------
+    def _loop(self) -> None:
+        cfg = self._config
+        while not self._shutdown.wait(cfg.poll_interval_s):
+            try:
+                self._tick()
+            except Exception:
+                logger.exception("autoscaler tick failed")
+
+    def _pending_demand(self) -> int:
+        stats = self._worker.scheduler.stats()
+        return int(stats.get("ready_queue", 0)
+                   + stats.get("infeasible", 0))
+
+    def _tick(self) -> None:
+        cfg = self._config
+        demand = self._pending_demand()
+        if demand > 0:
+            self._pressure_ticks += 1
+        else:
+            self._pressure_ticks = 0
+
+        if self._pressure_ticks >= cfg.upscale_ticks \
+                and len(self._nodes) < cfg.max_nodes:
+            logger.info("autoscaler: %d pending for %d ticks -> +1 node",
+                        demand, self._pressure_ticks)
+            self._nodes.append(self._provider.create_node())
+            self.num_upscales += 1
+            self._pressure_ticks = 0
+            return
+
+        # scale down: a provider node with nothing running on it for
+        # idle_timeout_s goes back (never below min_nodes)
+        if len(self._nodes) <= cfg.min_nodes or demand > 0:
+            self._idle_since.clear()
+            return
+        busy_nodes = {row["node_index"]
+                      for row in self._worker.scheduler.task_table()
+                      if row["state"] == "RUNNING"}
+        now = time.monotonic()
+        for entry in list(self._nodes):
+            if entry.index in busy_nodes or entry.state != "ALIVE":
+                self._idle_since.pop(entry.index, None)
+                continue
+            first = self._idle_since.setdefault(entry.index, now)
+            if now - first >= cfg.idle_timeout_s:
+                logger.info("autoscaler: node %d idle %.1fs -> -1 node",
+                            entry.index, now - first)
+                self._provider.terminate_node(entry)
+                self._nodes.remove(entry)
+                self._idle_since.pop(entry.index, None)
+                self.num_downscales += 1
+                return
+
+    def stats(self) -> Dict[str, Any]:
+        return {"provider_nodes": len(self._nodes),
+                "upscales": self.num_upscales,
+                "downscales": self.num_downscales}

@@ -98,13 +98,10 @@ def send_recv(x, group: "CollectiveGroup | str", shift: int = 1):
     return lax.ppermute(x, name, perm)
 
 
-def _cached_once(fn):
-    import functools
-
-    return functools.lru_cache(maxsize=1)(fn)
+import functools as _functools
 
 
-@_cached_once
+@_functools.lru_cache(maxsize=1)
 def shard_map_norep():
     """shard_map with replication checking disabled, across jax
     versions (the manual-collective ops — ring attention, MoE dispatch,

@@ -1,0 +1,146 @@
+"""ray_tpu.workflow — durable workflows with journaled steps.
+
+Reference surface: Ray Workflow (ray: python/ray/workflow/ — a DAG of
+steps whose results are journaled to storage per step; re-running a
+workflow id resumes from the journal, re-executing only what never
+completed). API kept in the classic step shape:
+
+    @workflow.step
+    def add(a, b): return a + b
+
+    out = add.step(add.step(1, 2), 4).run(workflow_id="w1")
+
+Steps execute as framework tasks; every step result is pickled to
+<storage>/<workflow_id>/<step_key>. Step keys are deterministic
+positions in the DAG (function name + path), so resume matches steps
+structurally.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+_storage_lock = threading.Lock()
+_storage_root: Optional[str] = None
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the journal root (default: a temp dir per process)."""
+    global _storage_root
+    with _storage_lock:
+        _storage_root = storage or tempfile.mkdtemp(
+            prefix="ray_tpu_workflow_")
+        os.makedirs(_storage_root, exist_ok=True)
+
+
+def storage_root() -> str:
+    with _storage_lock:
+        if _storage_root is None:
+            init()
+        return _storage_root  # type: ignore[return-value]
+
+
+class _StepNode:
+    """One node of the workflow DAG (unexecuted)."""
+
+    def __init__(self, fn: Callable, args, kwargs):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+
+    # -- execution ----------------------------------------------------
+    def run(self, workflow_id: str,
+            storage: Optional[str] = None) -> Any:
+        """Execute (or resume) the workflow rooted at this step."""
+        root = storage or storage_root()
+        wf_dir = os.path.join(root, workflow_id)
+        os.makedirs(wf_dir, exist_ok=True)
+        executed: Dict[str, int] = {"fresh": 0, "cached": 0}
+        result = self._execute(wf_dir, "root", executed)
+        _journal_write(wf_dir, "__status__",
+                       {"status": "SUCCEEDED",
+                        "fresh_steps": executed["fresh"],
+                        "cached_steps": executed["cached"]})
+        return result
+
+    def _execute(self, wf_dir: str, path: str, executed) -> Any:
+        key = f"{path}.{self.fn.__name__}"
+        cached = _journal_read(wf_dir, key)
+        if cached is not None:
+            executed["cached"] += 1
+            return cached["result"]
+        # resolve child steps first (post-order DAG walk)
+        args = [a._execute(wf_dir, f"{path}.{i}", executed)
+                if isinstance(a, _StepNode) else a
+                for i, a in enumerate(self.args)]
+        kwargs = {k: (v._execute(wf_dir, f"{path}.{k}", executed)
+                      if isinstance(v, _StepNode) else v)
+                  for k, v in self.kwargs.items()}
+        remote_fn = ray_tpu.remote(self.fn)
+        result = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+        _journal_write(wf_dir, key, {"result": result})
+        executed["fresh"] += 1
+        return result
+
+
+class _Step:
+    """@workflow.step wrapper: .step(...) builds a DAG node."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def step(self, *args, **kwargs) -> _StepNode:
+        return _StepNode(self.fn, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+
+def step(fn: Callable) -> _Step:
+    return _Step(fn)
+
+
+def resume(workflow_id: str, node: _StepNode,
+           storage: Optional[str] = None) -> Any:
+    """Explicit resume (same as run: the journal makes it idempotent)."""
+    return node.run(workflow_id, storage)
+
+
+def get_status(workflow_id: str,
+               storage: Optional[str] = None) -> Optional[dict]:
+    wf_dir = os.path.join(storage or storage_root(), workflow_id)
+    return _journal_read(wf_dir, "__status__")
+
+
+def list_steps(workflow_id: str,
+               storage: Optional[str] = None) -> List[str]:
+    wf_dir = os.path.join(storage or storage_root(), workflow_id)
+    if not os.path.isdir(wf_dir):
+        return []
+    return sorted(f[:-len(".step")] for f in os.listdir(wf_dir)
+                  if f.endswith(".step"))
+
+
+# -- journal ------------------------------------------------------------
+
+def _journal_write(wf_dir: str, key: str, value: dict) -> None:
+    path = os.path.join(wf_dir, f"{key}.step")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(value, f)
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn journal
+
+
+def _journal_read(wf_dir: str, key: str) -> Optional[dict]:
+    path = os.path.join(wf_dir, f"{key}.step")
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+        return None

@@ -20,7 +20,16 @@ class TestGraphBuilders:
     def test_builds_and_completes(self, build, expected_depth):
         g = build()
         assert (np.sort(g.dst) == g.dst).all() or len(g.dst) <= 1
-        r = B.run_graph(g, repeats=1)
+        # repeats=1 gives ONE timing pair; run_graph rightly refuses to
+        # report when transport noise inverts it, so retry a few times
+        # on a loaded machine instead of flaking
+        for attempt in range(3):
+            try:
+                r = B.run_graph(g, repeats=2)
+                break
+            except RuntimeError:
+                if attempt == 2:
+                    raise
         assert r["ticks"] == expected_depth
         assert r["scheduling_ms"] >= 0
 

@@ -1,0 +1,167 @@
+"""Ops-layer components: job submission, autoscaler, workflow, CLI."""
+
+import os
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.autoscaler import (Autoscaler, AutoscalerConfig,
+                                VirtualNodeProvider)
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+class TestJobSubmission:
+    def test_submit_and_succeed(self, tmp_path):
+        client = JobSubmissionClient(str(tmp_path))
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+        status = client.wait_until_finish(job_id, timeout=60)
+        assert status == JobStatus.SUCCEEDED
+        assert "hello from job" in client.get_job_logs(job_id)
+        jobs = client.list_jobs()
+        assert jobs[0]["submission_id"] == job_id
+
+    def test_failed_job(self, tmp_path):
+        client = JobSubmissionClient(str(tmp_path))
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+        assert client.wait_until_finish(job_id, 60) == JobStatus.FAILED
+
+    def test_stop_job(self, tmp_path):
+        client = JobSubmissionClient(str(tmp_path))
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+        time.sleep(0.3)
+        assert client.stop_job(job_id) is True
+        assert client.wait_until_finish(job_id, 30) == JobStatus.STOPPED
+
+    def test_env_vars_and_job_id(self, tmp_path):
+        client = JobSubmissionClient(str(tmp_path))
+        job_id = client.submit_job(
+            entrypoint=(f"{sys.executable} -c \"import os; "
+                        f"print(os.environ['RAY_TPU_JOB_ID'], "
+                        f"os.environ['MY_FLAG'])\""),
+            env_vars={"MY_FLAG": "on"})
+        client.wait_until_finish(job_id, 60)
+        logs = client.get_job_logs(job_id)
+        assert job_id in logs and "on" in logs
+
+
+class TestAutoscaler:
+    def test_scales_up_under_pressure_and_down_when_idle(self):
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=1, num_workers=2, scheduler="tensor")
+        try:
+            w = ray_tpu._worker.get_worker()
+            provider = VirtualNodeProvider(w, num_cpus=4, num_workers=2)
+            scaler = Autoscaler(w, provider, AutoscalerConfig(
+                min_nodes=0, max_nodes=2, upscale_ticks=2,
+                idle_timeout_s=0.6, poll_interval_s=0.1))
+            scaler.start()
+
+            @ray_tpu.remote
+            def slow(i):
+                time.sleep(0.4)
+                return i
+
+            # 12 tasks against 1 CPU: backlog forces an upscale
+            refs = [slow.remote(i) for i in range(12)]
+            out = ray_tpu.get(refs, timeout=90)
+            assert out == list(range(12))
+            assert scaler.num_upscales >= 1
+            # demand gone: idle nodes return to the provider
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline \
+                    and scaler.num_downscales == 0:
+                time.sleep(0.1)
+            assert scaler.num_downscales >= 1
+            scaler.stop()
+        finally:
+            ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=4, scheduler="tensor")
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestWorkflow:
+    def test_dag_runs(self, rt, tmp_path):
+        @workflow.step
+        def add(a, b):
+            return a + b
+
+        @workflow.step
+        def mul(a, b):
+            return a * b
+
+        out = mul.step(add.step(1, 2), 4).run("wf1", str(tmp_path))
+        assert out == 12
+        status = workflow.get_status("wf1", str(tmp_path))
+        assert status["status"] == "SUCCEEDED"
+        assert status["fresh_steps"] == 2
+
+    def test_resume_skips_journaled_steps(self, rt, tmp_path):
+        calls = {"n": 0}
+
+        @workflow.step
+        def flaky(x):
+            calls["n"] += 1
+            if calls["n"] == 2 and not os.path.exists(
+                    str(tmp_path / "ok")):
+                open(str(tmp_path / "ok"), "w").close()
+                raise RuntimeError("crash mid-workflow")
+            return x * 2
+
+        @workflow.step
+        def combine(a, b):
+            return a + b
+
+        dag = combine.step(flaky.step(1), flaky.step(10))
+        with pytest.raises(Exception):
+            dag.run("wf2", str(tmp_path))
+        # resume: the journaled first step must NOT re-execute
+        calls_before = calls["n"]
+        out = workflow.resume("wf2", dag, str(tmp_path))
+        assert out == 22
+        status = workflow.get_status("wf2", str(tmp_path))
+        assert status["cached_steps"] >= 1
+        # only the crashed step re-executes; the journaled one does not
+        assert calls["n"] == calls_before + 1
+
+    def test_steps_listed(self, rt, tmp_path):
+        @workflow.step
+        def one():
+            return 1
+
+        one.step().run("wf3", str(tmp_path))
+        steps = workflow.list_steps("wf3", str(tmp_path))
+        assert any("one" in s for s in steps)
+
+
+class TestCLI:
+    def test_status_and_summary(self, tmp_path, capsys):
+        from ray_tpu.__main__ import main
+
+        # summary over a generated timeline
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor")
+
+        @ray_tpu.remote
+        def work():
+            return 1
+
+        ray_tpu.get([work.remote() for _ in range(3)], timeout=30)
+        trace = str(tmp_path / "t.json")
+        ray_tpu.timeline(trace)
+        ray_tpu.shutdown()
+        assert main(["summary", trace]) == 0
+        out = capsys.readouterr().out
+        # qualnames truncate at 40 chars; match the row, not the suffix
+        assert "test_status_and_summary" in out and " 3 " in out
