@@ -35,8 +35,26 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "cancel", "kill", "get_actor", "ObjectRef", "ActorHandle", "method",
     "available_resources", "cluster_resources", "nodes", "timeline",
+    "snapshot_cluster", "restore_cluster",
     "get_runtime_context", "__version__",
 ]
+
+
+def snapshot_cluster(path: str) -> dict:
+    """Checkpoint control-plane tables + scheduler state (incl. the
+    tensor scheduler's resident arrays) to a file. Reference role: GCS
+    persistence/restart; see _private/snapshot.py."""
+    from ray_tpu._private.snapshot import save_cluster_state
+
+    return save_cluster_state(_worker.get_worker(), path)
+
+
+def restore_cluster(path: str) -> dict:
+    """Restore a snapshot into this session: KV re-populates and
+    pending tasks resubmit under their original return ids."""
+    from ray_tpu._private.snapshot import load_cluster_state
+
+    return load_cluster_state(_worker.get_worker(), path)
 
 
 def timeline(filename: Optional[str] = None):

@@ -184,6 +184,28 @@ class EventScheduler(SchedulerBase):
             self._waiters.clear()
             self._dep_count.clear()
 
+    def pending_entries(self):
+        """(spec, unresolved deps) for every not-yet-dispatched task."""
+        with self._lock:
+            seen = set()
+            out = []
+            # cancelled tasks linger in _waiters/_infeasible (cancel()
+            # pops the other indexes); a snapshot must not resurrect them
+            for bucket in (self._ready, self._infeasible):
+                for t in bucket:
+                    if not t.cancelled and t.spec.task_id not in seen:
+                        seen.add(t.spec.task_id)
+                        out.append((t.spec, list(t.deps)))
+            for waiters in self._waiters.values():
+                for t in waiters:
+                    if not t.cancelled and t.spec.task_id not in seen:
+                        seen.add(t.spec.task_id)
+                        out.append((t.spec, list(t.deps)))
+            return out
+
+    def device_state_snapshot(self):
+        return {}  # the oracle keeps no array state
+
     def task_table(self) -> List[Dict[str, Any]]:
         """Live tasks (oracle-scheduler view; mirrors
         TensorScheduler.task_table)."""

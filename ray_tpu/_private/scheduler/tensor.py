@@ -186,6 +186,38 @@ class TensorScheduler(SchedulerBase):
             self._wake.notify()
         self._tick_thread.join(timeout=2.0)
 
+    def pending_entries(self) -> List[Tuple[Any, List[ObjectID]]]:
+        """(spec, unresolved deps) for every not-yet-dispatched task —
+        the resubmittable half of a control-plane snapshot."""
+        with self._lock:
+            out = []
+            for slot, task in self._tasks.items():
+                if self._state[slot] == WAITING:
+                    out.append((task.spec, list(task.deps)))
+            out.extend((t.spec, list(t.deps)) for t in self._submit_q)
+            return out
+
+    def device_state_snapshot(self) -> Dict[str, Any]:
+        """Copies of the scheduler's resident arrays, trimmed to the
+        occupied slot prefix (SURVEY §5: the checkpoint includes the
+        device tensors, not just host tables). FORENSIC data: restore
+        resubmits from the task SPECS and re-admission rebuilds these
+        arrays — raw slots are meaningless in a new session without the
+        old slot maps, so they are recorded for inspection/debugging of
+        the snapshot moment, not replayed."""
+        with self._lock:
+            hi = int(np.flatnonzero(self._state != FREE).max(initial=-1)
+                     ) + 1
+            return {
+                "state": self._state[:hi].copy(),
+                "indeg": self._indeg[:hi].copy(),
+                "cls": self._cls[:hi].copy(),
+                "node_of": self._node_of[:hi].copy(),
+                "demands": self._demands.copy(),
+                "avail": self._avail.copy(),
+                "cap": self._cap.copy(),
+            }
+
     def task_table(self) -> List[Dict[str, Any]]:
         """Live tasks straight off the scheduler arrays (the survey's
         'list tasks that reads back the scheduler tensors'): one row per

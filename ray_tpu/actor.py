@@ -702,6 +702,12 @@ class ActorMethod:
         return ActorMethod(self._handle, self._method_name,
                            num_returns or self._num_returns)
 
+    def bind(self, *args, **kwargs):
+        """DAG-building (reference: ray.dag actor-method nodes)."""
+        from ray_tpu.dag import ActorMethodNode
+
+        return ActorMethodNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(self._method_name, args, kwargs,
                                            self._num_returns)

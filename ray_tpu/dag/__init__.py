@@ -1,0 +1,205 @@
+"""ray_tpu.dag — compiled graphs (the aDAG analog).
+
+Reference surface: Ray compiled graphs (ray: python/ray/dag/ —
+``recv.bind(inp)`` DAG nodes, ``experimental_compile()`` replacing
+per-call RPC/serialization with pre-allocated channels;
+python/ray/experimental/channel/ for the NCCL channels).
+
+TPU-first stance (SURVEY.md §7.0: "Ray's compiled-graphs subsystem is
+jax.jit itself"): a compiled graph here executes the node chain with
+VALUES passed directly between stages — no per-call scheduling, no
+object-store round trips — and, when every node is a pure function, the
+whole chain is fused into ONE jax.jit program, which is the actual
+channel-free fast path on TPU (activations stay in HBM between
+stages). Actor-method nodes run on their actor's direct call path with
+results forwarded by value.
+
+    with InputNode() as inp:
+        dag = postprocess.bind(model.forward.bind(preprocess.bind(inp)))
+    compiled = dag.experimental_compile()
+    out = compiled.execute(x)      # one fused invocation
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DAGNode:
+    def __init__(self):
+        self._args: tuple = ()
+        self._kwargs: dict = {}
+
+    # -- interpreted execution (refs through the normal task path) ----
+    def execute(self, *input_values) -> Any:
+        """Run the graph through the NORMAL task/actor path (one
+        .remote per node; refs flow between nodes)."""
+        ref = self._execute_remote(_bind_input(self, input_values))
+        return ray_tpu.get(ref)
+
+    def experimental_compile(self, fuse_jit: str = "auto"
+                             ) -> "CompiledDAG":
+        """Build the fast path. fuse_jit: 'auto' tries jax.jit over the
+        composed pure-function chain (falls back on trace failure),
+        'always' requires it, 'never' skips fusion."""
+        return CompiledDAG(self, fuse_jit)
+
+    # internals ---------------------------------------------------------
+    def _execute_remote(self, bindings) -> Any:
+        raise NotImplementedError
+
+    def _call_direct(self, bindings) -> Any:
+        raise NotImplementedError
+
+    def _resolve_args(self, bindings, via: str):
+        args = []
+        for a in self._args:
+            if isinstance(a, DAGNode):
+                args.append(a._execute_remote(bindings) if via == "remote"
+                            else a._call_direct(bindings))
+            else:
+                args.append(a)
+        return args
+
+
+class InputNode(DAGNode):
+    """Placeholder for the graph input (context-manager form mirrors
+    the reference API; plain construction works too)."""
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def _execute_remote(self, bindings):
+        return bindings[id(self)]
+
+    def _call_direct(self, bindings):
+        return bindings[id(self)]
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__()
+        self._remote_fn = remote_fn
+        self._args = args
+        self._kwargs = kwargs
+
+    @property
+    def func(self):
+        return self._remote_fn._function
+
+    def _execute_remote(self, bindings):
+        args = self._resolve_args(bindings, "remote")
+        return self._remote_fn.remote(*args, **self._kwargs)
+
+    def _call_direct(self, bindings):
+        args = self._resolve_args(bindings, "direct")
+        return self.func(*args, **self._kwargs)
+
+
+class ActorMethodNode(DAGNode):
+    def __init__(self, actor_method, args, kwargs):
+        super().__init__()
+        self._method = actor_method
+        self._args = args
+        self._kwargs = kwargs
+
+    def _execute_remote(self, bindings):
+        args = self._resolve_args(bindings, "remote")
+        return self._method.remote(*args, **self._kwargs)
+
+    def _call_direct(self, bindings):
+        # direct path: resolve args by value, ONE actor call, get by
+        # value (the channel analog — no intermediate store entries)
+        args = self._resolve_args(bindings, "direct")
+        return ray_tpu.get(self._method.remote(*args, **self._kwargs))
+
+
+def _bind_input(root: DAGNode, input_values) -> Dict[int, Any]:
+    inputs: List[InputNode] = []
+
+    def walk(node: DAGNode):
+        if isinstance(node, InputNode) and node not in inputs:
+            inputs.append(node)
+        for a in node._args:
+            if isinstance(a, DAGNode):
+                walk(a)
+
+    walk(root)
+    if len(inputs) != len(input_values):
+        raise ValueError(f"graph has {len(inputs)} InputNode(s), got "
+                         f"{len(input_values)} values")
+    return {id(n): v for n, v in zip(inputs, input_values)}
+
+
+class CompiledDAG:
+    """The fast path: values flow directly between nodes; an all-pure-
+    function chain fuses into one jax.jit program."""
+
+    def __init__(self, root: DAGNode, fuse_jit: str):
+        self._root = root
+        self._lock = threading.Lock()
+        self._jitted = None
+        self._pure = self._all_functions(root)
+        if fuse_jit == "never":
+            self._try_jit = False
+        elif fuse_jit == "always":
+            if not self._pure:
+                raise ValueError(
+                    "fuse_jit='always' needs an all-function graph "
+                    "(actor methods cannot fuse into one program)")
+            self._try_jit = True
+        else:
+            self._try_jit = self._pure
+
+    @staticmethod
+    def _all_functions(root: DAGNode) -> bool:
+        ok = True
+
+        def walk(node: DAGNode):
+            nonlocal ok
+            if isinstance(node, ActorMethodNode):
+                ok = False
+            for a in node._args:
+                if isinstance(a, DAGNode):
+                    walk(a)
+
+        walk(root)
+        return ok
+
+    def execute(self, *input_values) -> Any:
+        if self._try_jit:
+            try:
+                return self._get_jitted()(*input_values)
+            except Exception:
+                # tracing failed (non-jax code in a node): fall back to
+                # the direct path — which re-raises any REAL user error,
+                # so nothing is masked
+                self._try_jit = False
+        return self._root._call_direct(_bind_input(self._root,
+                                                   input_values))
+
+    def _get_jitted(self):
+        with self._lock:
+            if self._jitted is None:
+                import jax
+
+                def composed(*vals):
+                    return self._root._call_direct(
+                        _bind_input(self._root, vals))
+
+                self._jitted = jax.jit(composed)
+            return self._jitted
+
+
+def bind_function(remote_fn, *args, **kwargs) -> FunctionNode:
+    return FunctionNode(remote_fn, args, kwargs)
+
+
+def bind_method(actor_method, *args, **kwargs) -> ActorMethodNode:
+    return ActorMethodNode(actor_method, args, kwargs)

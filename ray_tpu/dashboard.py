@@ -1,0 +1,132 @@
+"""Dashboard — HTTP JSON state API + a minimal HTML overview.
+
+Reference surface: the dashboard head + state API endpoints
+(ray: python/ray/dashboard/ — aiohttp modules serving cluster state to
+the UI; python/ray/util/state/ backs the same verbs). Here: a threaded
+HTTP server over ray_tpu.util.state and the metrics renderer — the
+machine-readable surface an external UI or poller needs.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+from typing import Optional
+
+_INDEX = """<!doctype html>
+<title>ray_tpu dashboard</title>
+<h1>ray_tpu</h1>
+<p>endpoints:</p>
+<ul>
+<li><a href="/api/summary">/api/summary</a></li>
+<li><a href="/api/tasks">/api/tasks</a></li>
+<li><a href="/api/actors">/api/actors</a></li>
+<li><a href="/api/objects">/api/objects</a></li>
+<li><a href="/api/nodes">/api/nodes</a></li>
+<li><a href="/api/placement_groups">/api/placement_groups</a></li>
+<li><a href="/api/jobs">/api/jobs</a></li>
+<li><a href="/metrics">/metrics</a></li>
+</ul>
+"""
+
+
+class Dashboard:
+    def __init__(self, worker, port: int = 0):
+        from ray_tpu.util import state
+
+        def api(fn):
+            def call():
+                return fn()
+
+            return call
+
+        routes = {
+            "/api/tasks": lambda: state.list_tasks(),
+            "/api/actors": lambda: state.list_actors(),
+            "/api/objects": lambda: state.list_objects(),
+            "/api/nodes": lambda: state.list_nodes(),
+            "/api/placement_groups":
+                lambda: state.list_placement_groups(),
+            "/api/jobs": lambda: {
+                j.hex(): meta
+                for j, meta in worker.gcs.job_table().items()},
+            "/api/summary": lambda: {
+                "tasks": state.summarize_tasks(),
+                "scheduler": worker.scheduler.stats(),
+                "nodes": state.list_nodes(),
+                "actors_alive": sum(
+                    1 for a in state.list_actors()
+                    if a["state"] == "ALIVE"),
+                "time": time.time(),
+            },
+        }
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path == "/" or self.path == "/index.html":
+                    self._send(200, _INDEX.encode(), "text/html")
+                    return
+                if self.path == "/metrics":
+                    from ray_tpu._private.metrics import render_all
+
+                    self._send(200, render_all(worker).encode(),
+                               "text/plain; version=0.0.4")
+                    return
+                fn = routes.get(self.path)
+                if fn is None:
+                    self._send(404, b'{"error": "not found"}')
+                    return
+                try:
+                    body = json.dumps(fn()).encode()
+                    self._send(200, body)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500,
+                               json.dumps({"error": str(e)}).encode())
+
+            def _send(self, code, body,
+                      ctype="application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="ray_tpu_dashboard")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(port: int = 0) -> int:
+    """Start (or return) the dashboard; returns the bound port."""
+    global _dashboard
+    from ray_tpu._private import worker as worker_mod
+
+    if _dashboard is None:
+        _dashboard = Dashboard(worker_mod.get_worker(), port)
+    return _dashboard.port
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.shutdown()
+        _dashboard = None

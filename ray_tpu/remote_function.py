@@ -53,6 +53,13 @@ class RemoteFunction:
         self._is_generator = inspect.isgeneratorfunction(func)
         functools.update_wrapper(self, func)
 
+    def bind(self, *args, **kwargs):
+        """DAG-building (reference: ray.dag): returns a node; compose
+        with other .bind() results and experimental_compile()."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function {self._name} cannot be called directly; use "
