@@ -104,6 +104,10 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None,
          fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    """fetch_local note: the object plane is a single owner store + one
+    node-shared arena, so readiness and local availability coincide —
+    both fetch_local settings behave identically BY DESIGN (in the
+    reference they differ only when objects live on remote nodes)."""
     if isinstance(refs, ObjectRef):
         raise TypeError("ray_tpu.wait() takes a list of ObjectRefs")
     if num_returns > len(refs):
@@ -114,6 +118,11 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """LIMITATION: recursive cancellation of a task's descendants is not
+    implemented (child-task lineage is tracked for reconstruction, not
+    submission trees); recursive=True cancels only the task itself.
+    force=True kills process-mode workers mid-task; thread mode is
+    cooperative-only."""
     _worker.get_worker().cancel_task(ref, force=force)
 
 

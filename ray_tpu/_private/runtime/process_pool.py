@@ -345,6 +345,9 @@ class ProcessWorkerPool:
             # .remote() calls inherit the group (thread mode uses a
             # contextvar in Worker._execute_task)
             payload["pg"] = spec.placement_group_id.binary()
+        env_vars = (spec.runtime_env or {}).get("env_vars") or {}
+        if env_vars:
+            payload["env_vars"] = dict(env_vars)
         payload["_contained"] = [r.object_id() for r in contained]
         return payload, contained
 

@@ -245,6 +245,13 @@ class _WorkerRunner:
 
     def actor_create(self, payload: dict) -> None:
         def run(args, kwargs):
+            # per-actor runtime_env: this process is DEDICATED to the
+            # actor, so env_vars apply for its lifetime (no restore)
+            actor_env = payload.get("actor_env_vars")
+            if actor_env:
+                import os as _os
+
+                _os.environ.update(actor_env)
             cls = cloudpickle.loads(payload["cls_blob"])
             self.actor_instance = cls(*args, **kwargs)
             return "ALIVE"
@@ -275,6 +282,13 @@ class _WorkerRunner:
             from ray_tpu.util.placement_group import _current_pg
 
             pg_token = _current_pg.set(PlacementGroupID(payload["pg"]))
+        env_saved = None
+        env_vars = payload.get("env_vars") or {}
+        if env_vars:
+            import os as _os
+
+            env_saved = {k: _os.environ.get(k) for k in env_vars}
+            _os.environ.update(env_vars)
         try:
             args, kwargs = cloudpickle.loads(payload["args_blob"])
             args = tuple(self._resolve(a) for a in args)
@@ -310,6 +324,14 @@ class _WorkerRunner:
                     RuntimeError(f"[unpicklable {type(e).__name__}] {e}"))
             self.conn.send(("err", payload["task_id"], blob, tb))
         finally:
+            if env_saved is not None:
+                import os as _os
+
+                for k, old in env_saved.items():
+                    if old is None:
+                        _os.environ.pop(k, None)
+                    else:
+                        _os.environ[k] = old
             if pg_token is not None:
                 from ray_tpu.util.placement_group import _current_pg
 

@@ -518,6 +518,15 @@ class Worker:
                 and spec.placement_group_capture_child_tasks:
             from ray_tpu.util.placement_group import _current_pg
             pg_token = _current_pg.set(spec.placement_group_id)
+        # runtime_env env_vars: set for the task's duration. NOTE thread
+        # mode shares one process environment — concurrent tasks with
+        # conflicting env_vars can observe each other (process workers
+        # are the isolated path, as in the reference).
+        env_saved: Optional[Dict[str, Optional[str]]] = None
+        env_vars = (spec.runtime_env or {}).get("env_vars") or {}
+        if env_vars:
+            env_saved = {k: os.environ.get(k) for k in env_vars}
+            os.environ.update(env_vars)
         try:
             args, kwargs, dep_error, requeue_deps = self._resolve_args(spec)
             if requeue_deps:
@@ -544,6 +553,12 @@ class Worker:
                 return
             self._store_returns(spec, return_ids, result)
         finally:
+            if env_saved is not None:
+                for k, old in env_saved.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
             if pg_token is not None:
                 from ray_tpu.util.placement_group import _current_pg
                 _current_pg.reset(pg_token)

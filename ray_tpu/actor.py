@@ -110,6 +110,32 @@ class _ActorRuntime:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stopped = threading.Event()
 
+    # -- runtime_env (thread-mode actors share the driver process: env
+    # vars save/restore around init and each call, same documented
+    # caveat as thread-mode tasks; process actors apply them for their
+    # dedicated process's lifetime) --------------------------------------
+    def _env_apply(self):
+        env_vars = (self._creation_spec.runtime_env or {}).get("env_vars")
+        if not env_vars:
+            return None
+        import os
+
+        saved = {k: os.environ.get(k) for k in env_vars}
+        os.environ.update(env_vars)
+        return saved
+
+    @staticmethod
+    def _env_restore(saved) -> None:
+        if saved is None:
+            return
+        import os
+
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         if self._is_async:
@@ -126,6 +152,7 @@ class _ActorRuntime:
                 self._threads.append(t)
 
     def _run_init(self) -> bool:
+        env_saved = self._env_apply()
         try:
             self.instance = self.cls(*self.init_args, **self.init_kwargs)
             self.state = ActorState.ALIVE
@@ -141,6 +168,7 @@ class _ActorRuntime:
                 _creation_object_id(self.actor_id), err, is_exception=True)
             return False
         finally:
+            self._env_restore(env_saved)
             self.init_done.set()
             # default actors release their creation CPU once alive
             if not self._explicit_resources:
@@ -222,6 +250,7 @@ class _ActorRuntime:
     def _execute_call(self, call: _Call):
         method = getattr(self.instance, call.method_name)
         pg_token = self._capture_pg_token()
+        env_saved = self._env_apply()
         try:
             args, kwargs, dep_err = self._resolve(call.args, call.kwargs)
             if dep_err is not None:
@@ -233,12 +262,14 @@ class _ActorRuntime:
         except BaseException as e:  # noqa: BLE001
             self._store_error(call, e)
         finally:
+            self._env_restore(env_saved)
             self._reset_pg_token(pg_token)
             self.num_executed += 1
 
     async def _execute_call_async(self, call: _Call):
         method = getattr(self.instance, call.method_name)
         pg_token = self._capture_pg_token()
+        env_saved = self._env_apply()
         try:
             args, kwargs, dep_err = self._resolve(call.args, call.kwargs)
             if dep_err is not None:
@@ -250,6 +281,7 @@ class _ActorRuntime:
         except BaseException as e:  # noqa: BLE001
             self._store_error(call, e)
         finally:
+            self._env_restore(env_saved)
             self._reset_pg_token(pg_token)
             self.num_executed += 1
 
@@ -516,11 +548,19 @@ class _ProcessActorRuntime(_ActorRuntime):
             _time.sleep(0.005)
         creation_oid = _creation_object_id(self.actor_id)
         h = self._h
+        extra = dict(cls_blob=cloudpickle.dumps(self.cls))
+        env_vars = (self._creation_spec.runtime_env or {}).get("env_vars")
+        if env_vars:
+            # the actor OWNS its worker process: env_vars apply for its
+            # whole lifetime (reference: per-actor runtime_env).
+            # "actor_env_vars", NOT "env_vars": the generic task key is
+            # save/restored per payload, which would undo them after
+            # __init__
+            extra["actor_env_vars"] = dict(env_vars)
         try:
             payload, borrows = self._build_payload(
                 h, self._creation_spec.task_id, [creation_oid],
-                self.init_args, self.init_kwargs,
-                dict(cls_blob=cloudpickle.dumps(self.cls)))
+                self.init_args, self.init_kwargs, extra)
         except Exception as e:
             return e
         res = self._remote_round("actor_create", payload)
@@ -697,10 +737,15 @@ class ActorMethod:
         self._method_name = method_name
         self._num_returns = num_returns
 
-    def options(self, *, num_returns: Optional[int] = None, name=None,
-                **_ignored) -> "ActorMethod":
-        return ActorMethod(self._handle, self._method_name,
-                           num_returns or self._num_returns)
+    def options(self, *, num_returns: Optional[int] = None,
+                **unknown) -> "ActorMethod":
+        if unknown:
+            raise TypeError(
+                f"ActorMethod.options() got unsupported options "
+                f"{sorted(unknown)} (supported: num_returns)")
+        return ActorMethod(
+            self._handle, self._method_name,
+            self._num_returns if num_returns is None else num_returns)
 
     def bind(self, *args, **kwargs):
         """DAG-building (reference: ray.dag actor-method nodes)."""
@@ -839,7 +884,10 @@ class ActorClass:
             actor_id=actor_id,
             scheduling_strategy=opts.get("scheduling_strategy"),
             placement_group_id=None,
+            runtime_env=opts.get("runtime_env"),
         )
+        from ray_tpu.remote_function import _validate_runtime_env
+        _validate_runtime_env(spec.runtime_env)
         pg = opts.get("placement_group")
         strategy = opts.get("scheduling_strategy")
         if strategy is not None and hasattr(strategy, "placement_group"):

@@ -115,6 +115,7 @@ class RemoteFunction:
             pg_id = pg.id if hasattr(pg, "id") else pg
             _validate_bundle_fit(worker, pg_id, bundle_index,
                                  _build_resources(opts))
+        _validate_runtime_env(opts["runtime_env"])
 
         func = self._function
         if generator:
@@ -141,6 +142,27 @@ class RemoteFunction:
         )
         refs = worker.submit_task(spec)
         return refs[0] if spec.num_returns == 1 else refs
+
+
+def _validate_runtime_env(runtime_env) -> None:
+    """Supported: env_vars (applied around task execution in BOTH worker
+    modes). Unsupported keys raise instead of being silently dropped
+    (reference: pip/conda/working_dir need a per-node env agent,
+    ray: python/ray/_private/runtime_env/ — not built here)."""
+    if not runtime_env:
+        return
+    supported = {"env_vars"}
+    extra = set(runtime_env) - supported
+    if extra:
+        raise NotImplementedError(
+            f"runtime_env keys {sorted(extra)} are not supported "
+            f"(supported: {sorted(supported)})")
+    env_vars = runtime_env.get("env_vars") or {}
+    if not isinstance(env_vars, dict) or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in env_vars.items()):
+        raise TypeError("runtime_env['env_vars'] must be a "
+                        "str -> str dict")
 
 
 def _validate_bundle_fit(worker, pg_id, bundle_index, resources) -> None:

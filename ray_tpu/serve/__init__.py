@@ -9,12 +9,13 @@ through bind(), replica crash recovery, redeploy/scaling, and a small
 JSON HTTP ingress.
 """
 
-from ray_tpu.serve.core import (Application, Deployment,  # noqa: F401
-                                DeploymentHandle, deployment,
+from ray_tpu.serve.core import (Application, AutoscalingConfig,  # noqa: F401
+                                Deployment, DeploymentHandle, deployment,
                                 get_app_handle, run, shutdown, start_http,
                                 status)
 
 __all__ = [
     "deployment", "run", "shutdown", "status", "get_app_handle",
     "Deployment", "DeploymentHandle", "Application", "start_http",
+    "AutoscalingConfig",
 ]

@@ -23,23 +23,45 @@ _controller: Optional["_Controller"] = None
 # public decorator / graph building
 # ----------------------------------------------------------------------
 
+class AutoscalingConfig:
+    """Queue-driven replica autoscaling (reference: serve autoscaling
+    from ongoing-request metrics)."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 target_ongoing_requests: float = 2.0,
+                 interval_s: float = 0.2):
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.target_ongoing_requests = target_ongoing_requests
+        self.interval_s = interval_s
+
+
 class Deployment:
     def __init__(self, cls, name: str, num_replicas: int,
-                 max_ongoing_requests: int):
+                 max_ongoing_requests: int,
+                 autoscaling_config: Optional[AutoscalingConfig] = None):
         self._cls = cls
         self.name = name
         self.num_replicas = num_replicas
         self.max_ongoing_requests = max_ongoing_requests
+        self.autoscaling_config = autoscaling_config
+
+    _UNSET = object()
 
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
-                max_ongoing_requests: Optional[int] = None) -> "Deployment":
+                max_ongoing_requests: Optional[int] = None,
+                autoscaling_config: Any = _UNSET) -> "Deployment":
+        """autoscaling_config=None explicitly DISABLES autoscaling;
+        leaving it unset inherits."""
         return Deployment(
             self._cls, name or self.name,
             num_replicas if num_replicas is not None else
             self.num_replicas,
             max_ongoing_requests if max_ongoing_requests is not None
-            else self.max_ongoing_requests)
+            else self.max_ongoing_requests,
+            self.autoscaling_config if autoscaling_config is
+            Deployment._UNSET else autoscaling_config)
 
     def bind(self, *args, **kwargs) -> "Application":
         """Build the composition graph node (reference: deployment DAG);
@@ -59,11 +81,12 @@ class Application:
 
 
 def deployment(cls=None, *, name: Optional[str] = None,
-               num_replicas: int = 1, max_ongoing_requests: int = 100):
+               num_replicas: int = 1, max_ongoing_requests: int = 100,
+               autoscaling_config: Optional[AutoscalingConfig] = None):
     """@serve.deployment decorator."""
     def wrap(c):
         return Deployment(c, name or c.__name__, num_replicas,
-                          max_ongoing_requests)
+                          max_ongoing_requests, autoscaling_config)
 
     return wrap(cls) if cls is not None else wrap
 
@@ -113,23 +136,61 @@ class _DeploymentState:
         self._init_kwargs = init_kwargs
         self._lock = threading.Lock()
         self._replicas: List[_ReplicaState] = []
-        self._scale_to(dep.num_replicas)
+        self._stop = threading.Event()
+        auto = dep.autoscaling_config
+        self._scale_to(auto.min_replicas if auto else dep.num_replicas)
+        if auto is not None:
+            threading.Thread(target=self._autoscale_loop, daemon=True,
+                             name=f"ray_tpu_serve_scale_{dep.name}"
+                             ).start()
+
+    def _autoscale_loop(self) -> None:
+        """Queue-driven scaling (reference: serve autoscaling reads
+        ongoing-request metrics per replica)."""
+        import math
+
+        cfg = self.dep.autoscaling_config
+        while not self._stop.wait(cfg.interval_s):
+            with self._lock:
+                ongoing = sum(r.ongoing for r in self._replicas)
+                n = len(self._replicas)
+            desired = max(
+                cfg.min_replicas,
+                min(cfg.max_replicas,
+                    math.ceil(ongoing / cfg.target_ongoing_requests)))
+            if desired != n:
+                self._scale_to(desired)
 
     def _spawn(self) -> _ReplicaState:
         actor = _Replica.options(max_concurrency=8).remote(
             self._cls_blob, self._init_args, self._init_kwargs)
         return _ReplicaState(actor)
 
-    def _scale_to(self, n: int) -> None:
+    def _scale_to(self, n: int, force: bool = False) -> None:
+        """force=False (autoscaler): never grow after shutdown, and only
+        retire IDLE replicas — killing one mid-request would fail its
+        callers' pending refs. force=True (shutdown/redeploy) tears down
+        unconditionally."""
         with self._lock:
+            if self._stop.is_set() and not force:
+                return  # shutdown won the race; do not respawn
             while len(self._replicas) < n:
                 self._replicas.append(self._spawn())
-            while len(self._replicas) > n:
-                state = self._replicas.pop()
-                try:
-                    ray_tpu.kill(state.actor)
-                except Exception:
-                    pass
+            victims = []
+            if force:
+                while len(self._replicas) > n:
+                    victims.append(self._replicas.pop())
+            else:
+                idle = [r for r in self._replicas if r.ongoing == 0]
+                while len(self._replicas) > n and idle:
+                    victim = idle.pop()
+                    self._replicas.remove(victim)
+                    victims.append(victim)
+        for state in victims:
+            try:
+                ray_tpu.kill(state.actor)
+            except Exception:
+                pass
 
     def _pick(self) -> _ReplicaState:
         """Power-of-two-choices on tracked ongoing requests."""
@@ -178,7 +239,8 @@ class _DeploymentState:
             self._replicas.append(self._spawn())
 
     def shutdown(self) -> None:
-        self._scale_to(0)
+        self._stop.set()
+        self._scale_to(0, force=True)
 
 
 class DeploymentHandle:

@@ -365,3 +365,124 @@ def test_event_scheduler_infeasible_rescan_on_add_node():
         assert ray_tpu.get(ref, timeout=5) == "ran"
     finally:
         ray_tpu.shutdown()
+
+
+class TestRuntimeEnv:
+    def test_env_vars_thread_mode(self):
+        import os
+
+        import ray_tpu
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     ignore_reinit_error=True)
+        try:
+            @ray_tpu.remote
+            def read_env():
+                return os.environ.get("MY_TASK_FLAG")
+
+            ref = read_env.options(
+                runtime_env={"env_vars": {"MY_TASK_FLAG": "42"}}).remote()
+            assert ray_tpu.get(ref, timeout=20) == "42"
+            # restored after the task
+            assert os.environ.get("MY_TASK_FLAG") is None
+            # and absent without the env
+            assert ray_tpu.get(read_env.remote(), timeout=20) is None
+        finally:
+            ray_tpu.shutdown()
+
+    def test_env_vars_process_mode(self):
+        import ray_tpu
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process"})
+        try:
+            @ray_tpu.remote
+            def read_env():
+                import os as _os
+
+                return _os.environ.get("MY_TASK_FLAG")
+
+            ref = read_env.options(
+                runtime_env={"env_vars": {"MY_TASK_FLAG": "proc"}}).remote()
+            assert ray_tpu.get(ref, timeout=30) == "proc"
+            assert ray_tpu.get(read_env.remote(), timeout=30) is None
+        finally:
+            ray_tpu.shutdown()
+
+    def test_unsupported_keys_raise(self):
+        import pytest as _pytest
+
+        import ray_tpu
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, ignore_reinit_error=True)
+        try:
+            @ray_tpu.remote
+            def f():
+                return 1
+
+            with _pytest.raises(NotImplementedError):
+                f.options(runtime_env={"pip": ["torch"]}).remote()
+        finally:
+            ray_tpu.shutdown()
+
+    def test_actor_env_vars_thread_mode(self):
+        import os
+
+        import ray_tpu
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     ignore_reinit_error=True)
+        try:
+            @ray_tpu.remote
+            class EnvActor:
+                def __init__(self):
+                    self.at_init = os.environ.get("ACTOR_FLAG")
+
+                def read(self):
+                    return (self.at_init, os.environ.get("ACTOR_FLAG"))
+
+            a = EnvActor.options(
+                runtime_env={"env_vars": {"ACTOR_FLAG": "A1"}}).remote()
+            assert ray_tpu.get(a.read.remote(), timeout=20) == ("A1", "A1")
+            assert os.environ.get("ACTOR_FLAG") is None
+            ray_tpu.kill(a)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_actor_env_vars_process_mode(self):
+        import ray_tpu
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process"})
+        try:
+            @ray_tpu.remote
+            class EnvActor:
+                def read(self):
+                    import os as _os
+
+                    return _os.environ.get("ACTOR_FLAG")
+
+            a = EnvActor.options(
+                runtime_env={"env_vars": {"ACTOR_FLAG": "P1"}}).remote()
+            # lifetime scope: visible on calls AFTER __init__ too
+            assert ray_tpu.get(a.read.remote(), timeout=30) == "P1"
+            assert ray_tpu.get(a.read.remote(), timeout=30) == "P1"
+            ray_tpu.kill(a)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_actor_unsupported_runtime_env_raises(self):
+        import pytest as _pytest
+
+        import ray_tpu
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, ignore_reinit_error=True)
+        try:
+            @ray_tpu.remote
+            class A:
+                pass
+
+            with _pytest.raises(NotImplementedError):
+                A.options(runtime_env={"pip": ["x"]}).remote()
+        finally:
+            ray_tpu.shutdown()

@@ -121,3 +121,35 @@ class TestServe:
             headers={"Content-Type": "application/json"})
         body = json.loads(urllib.request.urlopen(req, timeout=10).read())
         assert body == {"result": {"sum": 5}}
+
+
+class TestAutoscaling:
+    def test_scales_up_under_load_and_down_when_idle(self, rt):
+        import time
+
+        @serve.deployment(autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3,
+            target_ongoing_requests=1.0, interval_s=0.05))
+        class Slow:
+            def __call__(self, x):
+                time.sleep(0.25)
+                return x
+
+        handle = serve.run(Slow.bind())
+        assert serve.status()["Slow"]["replicas"] == 1
+        refs = [handle.remote(i) for i in range(12)]
+        deadline = time.monotonic() + 10
+        peak = 1
+        while time.monotonic() < deadline:
+            peak = max(peak, serve.status()["Slow"]["replicas"])
+            if peak >= 2:
+                break
+            time.sleep(0.05)
+        assert peak >= 2, "never scaled up under queued load"
+        assert ray_tpu.get(refs, timeout=60) == list(range(12))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if serve.status()["Slow"]["replicas"] == 1:
+                break
+            time.sleep(0.05)
+        assert serve.status()["Slow"]["replicas"] == 1
