@@ -520,13 +520,12 @@ class Worker:
             pg_token = _current_pg.set(spec.placement_group_id)
         # runtime_env env_vars: set for the task's duration. NOTE thread
         # mode shares one process environment — concurrent tasks with
-        # conflicting env_vars can observe each other (process workers
-        # are the isolated path, as in the reference).
-        env_saved: Optional[Dict[str, Optional[str]]] = None
+        # conflicting env_vars can observe each other mid-flight
+        # (process workers are the isolated path, as in the reference);
+        # depth-counted push/pop guarantees the final restore is correct
         env_vars = (spec.runtime_env or {}).get("env_vars") or {}
         if env_vars:
-            env_saved = {k: os.environ.get(k) for k in env_vars}
-            os.environ.update(env_vars)
+            env_vars_push(env_vars)
         try:
             args, kwargs, dep_error, requeue_deps = self._resolve_args(spec)
             if requeue_deps:
@@ -553,12 +552,8 @@ class Worker:
                 return
             self._store_returns(spec, return_ids, result)
         finally:
-            if env_saved is not None:
-                for k, old in env_saved.items():
-                    if old is None:
-                        os.environ.pop(k, None)
-                    else:
-                        os.environ[k] = old
+            if env_vars:
+                env_vars_pop(env_vars)
             if pg_token is not None:
                 from ray_tpu.util.placement_group import _current_pg
                 _current_pg.reset(pg_token)
@@ -767,6 +762,38 @@ def _detect_tpu_count() -> float:
                           if d.platform not in ("cpu",)]))
     except Exception:
         return 0.0
+
+
+# runtime_env env_vars in THREAD mode share one process environment.
+# Depth-counted apply/restore: concurrent env-bearing tasks may observe
+# each other mid-flight (documented caveat), but completion always
+# restores the TRUE pre-task value — naive save/restore interleaving
+# would leak a task's value into the process forever.
+_env_state_lock = threading.Lock()
+_env_depth: Dict[str, Tuple[int, Optional[str]]] = {}
+
+
+def env_vars_push(env_vars: Dict[str, str]) -> None:
+    with _env_state_lock:
+        for k, v in env_vars.items():
+            depth, orig = _env_depth.get(k, (0, os.environ.get(k)))
+            _env_depth[k] = (depth + 1, orig)
+            os.environ[k] = v
+
+
+def env_vars_pop(env_vars: Dict[str, str]) -> None:
+    with _env_state_lock:
+        for k in env_vars:
+            entry = _env_depth.pop(k, None)
+            if entry is None:
+                continue
+            depth, orig = entry
+            if depth > 1:
+                _env_depth[k] = (depth - 1, orig)
+            elif orig is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = orig
 
 
 def _async_raise_in_task(task_id: TaskID) -> None:

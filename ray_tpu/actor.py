@@ -118,23 +118,18 @@ class _ActorRuntime:
         env_vars = (self._creation_spec.runtime_env or {}).get("env_vars")
         if not env_vars:
             return None
-        import os
+        from ray_tpu._private.worker import env_vars_push
 
-        saved = {k: os.environ.get(k) for k in env_vars}
-        os.environ.update(env_vars)
-        return saved
+        env_vars_push(env_vars)
+        return env_vars
 
     @staticmethod
-    def _env_restore(saved) -> None:
-        if saved is None:
+    def _env_restore(env_vars) -> None:
+        if env_vars is None:
             return
-        import os
+        from ray_tpu._private.worker import env_vars_pop
 
-        for k, old in saved.items():
-            if old is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = old
+        env_vars_pop(env_vars)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):

@@ -51,6 +51,123 @@ def _map_task(fn, block):
     return fn(block)
 
 
+# ----------------------------------------------------------------------
+# all-to-all exchange (reference: AllToAllOperator — map tasks partition,
+# reduce tasks gather; sort samples boundaries first)
+# ----------------------------------------------------------------------
+
+@ray_tpu.remote
+def _sample_task(block, k):
+    import random as _r
+
+    if not block:
+        return []
+    return _r.Random(0).sample(block, min(k, len(block)))
+
+
+def _stable_hash(value) -> int:
+    """Deterministic across processes (builtin hash() is randomized per
+    interpreter, which would split one group across reducers when
+    partition tasks run in different worker processes)."""
+    import pickle
+    import zlib
+
+    return zlib.crc32(pickle.dumps(value, protocol=4))
+
+
+@ray_tpu.remote
+def _partition_task(kind, arg, num_out, block, block_idx):
+    """block -> num_out sub-blocks (returned as num_out VALUES via
+    num_returns, so each reducer fetches only its own piece)."""
+    parts: List[List[Any]] = [[] for _ in range(num_out)]
+    if kind == "repartition":
+        for i, row in enumerate(block):
+            parts[i % num_out].append(row)
+    elif kind == "shuffle":
+        import random as _r
+
+        # per-block seed component: equal-sized blocks must NOT reuse
+        # one random sequence (that correlates row destinations)
+        rng = _r.Random(arg * 1_000_003 + block_idx)
+        for row in block:
+            parts[rng.randrange(num_out)].append(row)
+    elif kind == "sort":
+        import bisect
+
+        key, _desc, boundaries = arg
+        keyf = key or (lambda x: x)
+        for row in block:
+            parts[bisect.bisect_right(boundaries, keyf(row))].append(row)
+    elif kind == "groupby":
+        key = arg
+        for row in block:
+            parts[_stable_hash(key(row)) % num_out].append(row)
+    else:
+        raise ValueError(kind)
+    return parts
+
+
+@ray_tpu.remote
+def _reduce_task(kind, arg, j, *pieces):
+    """pieces: this reducer's sub-block from every partition task."""
+    rows: List[Any] = []
+    for piece in pieces:
+        rows.extend(piece)
+    if kind == "sort":
+        key, desc, _b = arg
+        rows.sort(key=key, reverse=desc)
+    elif kind == "shuffle":
+        import random as _r
+
+        _r.Random(arg * 1_000_003 + j).shuffle(rows)
+    elif kind == "groupby":
+        key, fn = arg
+        groups: dict = {}
+        for row in rows:
+            groups.setdefault(key(row), []).append(row)
+        rows = [fn(k, v) for k, v in groups.items()]
+    return rows
+
+
+def all_to_all(refs: List[Any], op: _LogicalOp) -> List[Any]:
+    """Materialized exchange over block refs; returns output refs."""
+    kind, arg = op.fn
+    num_out = op.num_blocks or max(1, len(refs))
+    if kind == "sort":
+        key, desc = arg
+        keyf = key or (lambda x: x)
+        samples: List[Any] = []
+        for s in ray_tpu.get([_sample_task.remote(r, 20) for r in refs]):
+            samples.extend(keyf(x) for x in s)
+        samples.sort()
+        # num_out-1 boundary keys -> num_out range partitions
+        boundaries = [samples[int(len(samples) * (i + 1) / num_out)]
+                      for i in range(num_out - 1)] if samples else []
+        arg = (key, desc, boundaries)
+    part_arg: Any = arg
+    if kind == "groupby":
+        part_arg = arg[0]  # partitioning needs only the key fn
+    # num_returns=num_out: reducer j receives ONLY piece j of every
+    # partition (shipping each full partition list to every reducer
+    # would move the dataset num_out times)
+    parts = [_partition_task.options(num_returns=num_out).remote(
+        kind, part_arg, num_out, r, i) for i, r in enumerate(refs)]
+    if num_out == 1:
+        parts = [[p] for p in parts]
+    out = [_reduce_task.remote(kind, arg, j, *(p[j] for p in parts))
+           for j in range(num_out)]
+    if kind == "sort" and arg[1]:
+        # descending: range partitions are built ascending; emit them in
+        # reverse so the global order is descending too
+        out.reverse()
+    # BARRIER: block until every reducer lands. The downstream segment's
+    # source tasks call get() on these refs from INSIDE worker threads;
+    # dispatching them while reducers still queue can occupy the whole
+    # pool with waiters and starve the reducers (nested-get deadlock).
+    ray_tpu.wait(out, num_returns=len(out), timeout=None)
+    return out
+
+
 @ray_tpu.remote
 class _MapActor:
     """One worker of an ActorPoolStrategy stage."""

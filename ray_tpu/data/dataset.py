@@ -104,6 +104,33 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return Dataset(_LogicalOp("limit", limit=n, parent=self._op))
 
+    # -- all-to-all ops (reference: AllToAllOperator — shuffle/sort/
+    # groupby run map tasks that partition + reduce tasks that gather)
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return Dataset(_LogicalOp(
+            "all_to_all", name=f"repartition({num_blocks})",
+            num_blocks=num_blocks,
+            fn=("repartition", None), parent=self._op))
+
+    def sort(self, key: Optional[Callable[[Any], Any]] = None,
+             descending: bool = False,
+             num_blocks: int = 0) -> "Dataset":
+        """Distributed range-partitioned sort: sample -> partition by
+        boundary -> per-partition sort (reference: sort.py push-based
+        shuffle at minimum scale)."""
+        return Dataset(_LogicalOp(
+            "all_to_all", name="sort", num_blocks=num_blocks,
+            fn=("sort", (key, descending)), parent=self._op))
+
+    def groupby(self, key: Callable[[Any], Any]) -> "GroupedDataset":
+        return GroupedDataset(self, key)
+
+    def random_shuffle(self, seed: int = 0,
+                       num_blocks: int = 0) -> "Dataset":
+        return Dataset(_LogicalOp(
+            "all_to_all", name="random_shuffle", num_blocks=num_blocks,
+            fn=("shuffle", seed), parent=self._op))
+
     # -- consumption (triggers streaming execution) ---------------------
     def take(self, n: int = 20) -> List[Any]:
         out: List[Any] = []
@@ -138,21 +165,43 @@ class Dataset:
     def materialize(self) -> "MaterializedDataset":
         """Run the pipeline, keeping blocks in the object store as refs
         (the reference's ds.materialize())."""
-        from ray_tpu.data._streaming import StreamingExecutor
-
-        ex = StreamingExecutor(self._op.chain())
+        source, ex = self._final_executor(limit=None)
         refs = list(ex.run_refs())
         self._last_stats = ex.stats()
         return MaterializedDataset(refs)
 
     def stats(self):
-        """Per-operator stats of the LAST execution (None before any)."""
+        """Per-operator stats of the LAST execution segment (None
+        before any)."""
         return self._last_stats
 
-    def _execute(self, limit: Optional[int] = None) -> Iterator[List[Any]]:
-        from ray_tpu.data._streaming import StreamingExecutor
+    def _final_executor(self, limit: Optional[int]):
+        """Resolve all-to-all barriers: each exchange materializes its
+        upstream segment's blocks and re-enters as a ref source
+        (reference: AllToAllOperator is a materializing barrier in the
+        streaming plan)."""
+        from ray_tpu.data._streaming import StreamingExecutor, all_to_all
 
-        ex = StreamingExecutor(self._op.chain(), row_limit=limit)
+        ops = self._op.chain()
+        source = ops[0]
+        segments: List[List[_LogicalOp]] = [[]]
+        exchanges: List[_LogicalOp] = []
+        for op in ops[1:]:
+            if op.kind == "all_to_all":
+                exchanges.append(op)
+                segments.append([])
+            else:
+                segments[-1].append(op)
+        for seg, a2a in zip(segments[:-1], exchanges):
+            ex = StreamingExecutor([source] + seg)
+            refs = list(ex.run_refs())
+            out_refs = all_to_all(refs, a2a)
+            source = _refs_source(out_refs, a2a.name)
+        return source, StreamingExecutor([source] + segments[-1],
+                                         row_limit=limit)
+
+    def _execute(self, limit: Optional[int] = None) -> Iterator[List[Any]]:
+        _source, ex = self._final_executor(limit)
         try:
             yield from ex.run_blocks()
         finally:
@@ -161,6 +210,28 @@ class Dataset:
     def __repr__(self) -> str:
         names = " -> ".join(op.name for op in self._op.chain())
         return f"Dataset({names})"
+
+
+class GroupedDataset:
+    """ds.groupby(key).aggregate/count/map_groups (reference:
+    GroupedData). Executes as an all-to-all: rows hash-partition by key
+    to reducers, each reducer groups its partition."""
+
+    def __init__(self, ds: Dataset, key: Callable[[Any], Any]):
+        self._ds = ds
+        self._key = key
+
+    def map_groups(self, fn: Callable[[Any, List[Any]], Any]) -> Dataset:
+        """fn(key, rows) -> row; one output row per group."""
+        return Dataset(_LogicalOp(
+            "all_to_all", name="groupby.map_groups",
+            fn=("groupby", (self._key, fn)), parent=self._ds._op))
+
+    def count(self) -> Dataset:
+        return self.map_groups(lambda k, rows: (k, len(rows)))
+
+    def aggregate(self, agg: Callable[[List[Any]], Any]) -> Dataset:
+        return self.map_groups(lambda k, rows, _a=agg: (k, _a(rows)))
 
 
 class MaterializedDataset:
@@ -189,6 +260,18 @@ class MaterializedDataset:
 
         for ref in self._refs:
             yield from ray_tpu.get(ref)
+
+
+def _refs_source(refs, name: str) -> _LogicalOp:
+    """Source over already-materialized block refs (post-exchange)."""
+    import ray_tpu
+
+    def make_block(i: int, _refs=tuple(refs)):
+        return ray_tpu.get(_refs[i])
+
+    return _LogicalOp("read", name=f"{name}_out",
+                      num_blocks=max(1, len(refs)),
+                      make_block=make_block)
 
 
 # ----------------------------------------------------------------------

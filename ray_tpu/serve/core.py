@@ -193,20 +193,24 @@ class _DeploymentState:
                 pass
 
     def _pick(self) -> _ReplicaState:
-        """Power-of-two-choices on tracked ongoing requests."""
+        """Power-of-two-choices on tracked ongoing requests. RESERVES
+        the chosen replica (ongoing += 1) under the same lock hold —
+        otherwise the autoscaler could classify it idle and kill it in
+        the window before the caller's increment."""
         with self._lock:
             if not self._replicas:
                 raise rex.RayTpuError(
                     f"deployment {self.dep.name} has no replicas")
             if len(self._replicas) == 1:
-                return self._replicas[0]
-            a, b = random.sample(self._replicas, 2)
-            return a if a.ongoing <= b.ongoing else b
+                chosen = self._replicas[0]
+            else:
+                a, b = random.sample(self._replicas, 2)
+                chosen = a if a.ongoing <= b.ongoing else b
+            chosen.ongoing += 1
+            return chosen
 
     def submit(self, method: str, args, kwargs, _retry: bool = True):
         state = self._pick()
-        with self._lock:
-            state.ongoing += 1
         try:
             ref = state.actor.handle_request.remote(method, args, kwargs)
         except rex.ActorError:

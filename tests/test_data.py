@@ -155,3 +155,61 @@ class TestBackpressure:
         # the backpressure budget bounds live blocks; indirect check:
         # executor never buffers more than data_buffer_blocks outputs
         assert GLOBAL_CONFIG.data_buffer_blocks < 500
+
+
+class TestAllToAll:
+    def test_repartition(self, rt):
+        ds = data.range(100, parallelism=10).repartition(4)
+        mds = ds.materialize()
+        assert mds.num_blocks() == 4
+        assert sorted(mds.take_all()) == list(range(100))
+
+    def test_sort(self, rt):
+        ds = data.from_items([5, 3, 9, 1, 7, 2, 8, 0, 6, 4] * 10,
+                             parallelism=5).sort()
+        out = ds.take_all()
+        assert out == sorted(out)
+        assert len(out) == 100
+
+    def test_sort_key_descending(self, rt):
+        ds = data.from_items([(i % 7, i) for i in range(50)],
+                             parallelism=4).sort(
+            key=lambda t: t[0], descending=True)
+        keys = [t[0] for t in ds.take_all()]
+        assert keys == sorted(keys, reverse=True)
+
+    def test_random_shuffle_preserves_multiset(self, rt):
+        ds = data.range(200, parallelism=8).random_shuffle(seed=1)
+        out = ds.take_all()
+        assert sorted(out) == list(range(200))
+        assert out != list(range(200))  # actually shuffled
+
+    def test_groupby_count_and_aggregate(self, rt):
+        ds = data.range(100, parallelism=10)
+        counts = dict(ds.groupby(lambda x: x % 3).count().take_all())
+        assert counts == {0: 34, 1: 33, 2: 33}
+        sums = dict(data.range(10).groupby(lambda x: x % 2)
+                    .aggregate(sum).take_all())
+        assert sums == {0: 0 + 2 + 4 + 6 + 8, 1: 1 + 3 + 5 + 7 + 9}
+
+    def test_exchange_then_streaming_continues(self, rt):
+        out = (data.range(100, parallelism=10)
+               .sort(descending=True)
+               .map(lambda x: x * 2)
+               .take(3))
+        assert out == [198, 196, 194]
+
+    def test_groupby_string_keys_process_mode(self):
+        """Stable hashing: builtin hash() is per-process randomized, so
+        string keys must still group correctly when partition tasks run
+        in separate worker processes."""
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process"})
+        try:
+            names = ["alpha", "beta", "gamma"] * 20
+            counts = dict(data.from_items(names, parallelism=6)
+                          .groupby(lambda s: s).count().take_all())
+            assert counts == {"alpha": 20, "beta": 20, "gamma": 20}
+        finally:
+            ray_tpu.shutdown()
