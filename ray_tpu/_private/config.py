@@ -147,6 +147,10 @@ _d("sched_jax_min_batch", int, 512,
 _d("task_max_retries", int, 3, "default retries for tasks on worker failure")
 _d("actor_max_restarts", int, 0, "default actor restarts")
 _d("max_lineage_bytes", int, 64 * 1024 * 1024, "owner lineage cap")
+_d("memory_usage_threshold", float, 0.95,
+   "host memory fraction above which the monitor kills the newest "
+   "running task with a retriable OutOfMemoryError; 0 disables")
+_d("memory_monitor_interval_s", float, 0.25, "memory monitor poll period")
 _d("data_op_inflight", int, 8,
    "ray_tpu.data: max in-flight tasks per streaming operator")
 _d("data_buffer_blocks", int, 32,

@@ -23,6 +23,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 from multiprocessing.connection import Listener
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
@@ -76,7 +77,7 @@ class _Handle:
     __slots__ = ("worker_num", "proc", "conn", "ctrl", "worker_id", "pid",
                  "busy", "exec_task_id", "return_ids", "borrows",
                  "sent_fns", "dead", "force_cancelled", "send_lock",
-                 "ready", "actor_rt")
+                 "ready", "actor_rt", "oom_kill", "_started_at")
 
     def __init__(self, worker_num: int):
         self.actor_rt = None  # set for dedicated actor workers
@@ -88,6 +89,8 @@ class _Handle:
         self.pid: Optional[int] = None
         self.busy: Optional[PendingTask] = None
         self.exec_task_id: Optional[TaskID] = None
+        self.oom_kill = False         # memory monitor killed this worker
+        self._started_at = 0.0        # current task's start time
         self.return_ids: List[ObjectID] = []
         self.borrows: Set[ObjectID] = set()
         self.sent_fns: Set[bytes] = set()
@@ -379,6 +382,8 @@ class ProcessWorkerPool:
         h.exec_task_id = spec.task_id
         h.return_ids = [ObjectID(b) for b in payload["return_ids"]]
         h.force_cancelled = False
+        h.oom_kill = False   # stale flag must not mislabel later deaths
+        h._started_at = time.monotonic()
         # register borrows for refs crossing into the worker BEFORE the
         # task can observe them
         for oid in contained:
@@ -525,6 +530,10 @@ class ProcessWorkerPool:
             spec = pending.spec
             if h.force_cancelled:
                 exc: BaseException = rex.TaskCancelledError(h.exec_task_id)
+            elif h.oom_kill:
+                exc = rex.OutOfMemoryError(
+                    f"worker killed by the memory monitor while running "
+                    f"{spec.name} (host memory pressure)")
             elif self._node_dead:
                 exc = rex.NodeDiedError(
                     f"node died while running {spec.name}")

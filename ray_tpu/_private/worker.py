@@ -247,6 +247,12 @@ class Worker:
             target=self._unref_loop, daemon=True, name="ray_tpu_unref")
         self._unref_thread.start()
 
+        # memory monitor LAST: its thread scans worker state
+        # (_running_tasks, _node_pools) that must exist before the first
+        # tick can fire
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        self.memory_monitor = MemoryMonitor(self)
+
     # ------------------------------------------------------------------
     # Context helpers
     # ------------------------------------------------------------------
@@ -718,6 +724,7 @@ class Worker:
                 pass
         self.scheduler.shutdown()
         self.gcs.shutdown()
+        self.memory_monitor.shutdown()
         if self.metrics_server is not None:
             self.metrics_server.shutdown()
         for row, pool in list(self._node_pools.items()):

@@ -85,8 +85,20 @@ def make_train_step(model: Transformer,
 
     def loss_fn(params, tokens):
         with nn_partitioning.axis_rules(rules):
-            logits = model.apply({"params": params}, tokens[:, :-1])
-        return cross_entropy_loss(logits, tokens[:, 1:])
+            logits, mods = model.apply({"params": params},
+                                       tokens[:, :-1],
+                                       mutable=["intermediates"])
+        loss = cross_entropy_loss(logits, tokens[:, 1:])
+        # MoE load balancing: consume every sown moe_aux term (a sown-
+        # but-unconsumed aux would let the router collapse all tokens
+        # onto one expert). Zero-cost for dense models (no leaves).
+        aux_leaves = [
+            a for a in jax.tree_util.tree_leaves(
+                mods.get("intermediates", {}))
+        ]
+        if aux_leaves:
+            loss = loss + 0.01 * sum(jnp.mean(a) for a in aux_leaves)
+        return loss
 
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch["tokens"])

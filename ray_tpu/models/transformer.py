@@ -49,6 +49,12 @@ class TransformerConfig:
     # axis (ray_tpu/ops/ring_attention.py). Takes effect when the model
     # runs under parallel.mesh.use_mesh(mesh) with seq > 1.
     ring_attention: bool = False
+    # mixture-of-experts: replace the dense MLP with a switch-routed
+    # expert layer (ray_tpu/ops/moe.py); all_to_all dispatch engages
+    # under a mesh whose `expert` axis > 1
+    moe: bool = False
+    moe_num_experts: int = 8
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -175,6 +181,57 @@ class MLP(nn.Module):
         return h @ w_down.astype(cfg.dtype)
 
 
+class MoEMLP(nn.Module):
+    """Switch-routed expert MLP (ops/moe.py): top-1 capacity routing,
+    all_to_all token dispatch when the active mesh has expert > 1, the
+    single-device reference path otherwise. The load-balancing aux loss
+    is sown under ("intermediates", "moe_aux")."""
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        E = cfg.moe_num_experts
+        d, f = cfg.d_model, cfg.d_ff
+        w_router = param_with_axes(
+            "router", nn.initializers.lecun_normal(), (d, E),
+            cfg.param_dtype, axes=("embed", "experts"))
+        w_in = param_with_axes(
+            "w_in", nn.initializers.lecun_normal(), (E, d, f),
+            cfg.param_dtype, axes=("experts", "embed", "mlp"))
+        w_out = param_with_axes(
+            "w_out", nn.initializers.lecun_normal(), (E, f, d),
+            cfg.param_dtype, axes=("experts", "mlp", "embed"))
+
+        from ray_tpu.ops.moe import moe_ffn_reference, moe_ffn_sharded
+        from ray_tpu.parallel import mesh as mesh_lib
+
+        b, s, _ = x.shape
+        tokens = x.reshape(b * s, d).astype(cfg.dtype)
+        wr = w_router.astype(cfg.dtype)
+        wi = w_in.astype(cfg.dtype)
+        wo = w_out.astype(cfg.dtype)
+        m = mesh_lib.current_mesh()
+        if m is not None and m.shape.get(mesh_lib.AXIS_EXPERT, 1) > 1:
+            n_exp = m.shape[mesh_lib.AXIS_EXPERT]
+            t = tokens.shape[0]
+            pad = (-t) % n_exp
+            if pad:
+                # token rows shard over the expert axis: pad to a
+                # multiple (padding rows route and get sliced off)
+                tokens = jnp.concatenate(
+                    [tokens, jnp.zeros((pad, d), tokens.dtype)])
+            y, aux = moe_ffn_sharded(tokens, wr, wi, wo, m,
+                                     cfg.moe_capacity_factor)
+            if pad:
+                y = y[:t]
+        else:
+            y, aux = moe_ffn_reference(tokens, wr, wi, wo,
+                                       cfg.moe_capacity_factor)
+        self.sow("intermediates", "moe_aux", aux)
+        return y.reshape(b, s, d).astype(cfg.dtype)
+
+
 class Block(nn.Module):
     config: TransformerConfig
 
@@ -182,7 +239,8 @@ class Block(nn.Module):
     def __call__(self, x, positions, mask):
         cfg = self.config
         x = x + Attention(cfg)(RMSNorm(cfg.norm_eps, cfg.param_dtype)(x), positions, mask)
-        x = x + MLP(cfg)(RMSNorm(cfg.norm_eps, cfg.param_dtype)(x))
+        mlp = MoEMLP(cfg) if cfg.moe else MLP(cfg)
+        x = x + mlp(RMSNorm(cfg.norm_eps, cfg.param_dtype)(x))
         return with_sharding_constraint(x, ("batch", "act_seq", "act_embed"))
 
 

@@ -156,6 +156,7 @@ def default_logical_rules() -> List[Tuple[str, object]]:
         ("heads", AXIS_TENSOR),
         ("kv_heads", AXIS_TENSOR),
         ("mlp", AXIS_TENSOR),
+        ("experts", AXIS_EXPERT),
         ("layers", None),
         ("batch", (AXIS_DATA, AXIS_FSDP)),
         ("act_seq", AXIS_SEQ),

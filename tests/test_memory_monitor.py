@@ -1,0 +1,102 @@
+"""Memory monitor: threshold detection + kill-with-retriable-OOM
+(reference: the memory monitor killing the newest retriable task)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import MemoryMonitor, host_memory
+
+
+class TestHostMemory:
+    def test_reads_meminfo(self):
+        used, total = host_memory()
+        assert 0 < used < total
+
+
+class TestMonitor:
+    def test_disabled_at_zero_threshold(self):
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2,
+                     _system_config={"memory_usage_threshold": 0.0})
+        try:
+            w = ray_tpu._worker.get_worker()
+            assert w.memory_monitor._thread is None
+        finally:
+            ray_tpu.shutdown()
+
+    def test_oom_kill_retries_process_task(self):
+        """Force a tiny threshold so the monitor fires; the running
+        process task dies with a retriable OutOfMemoryError and its
+        retry completes once the monitor stops."""
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process",
+                                     # effectively always-over
+                                     "memory_usage_threshold": 0.001,
+                                     "memory_monitor_interval_s": 0.1})
+        try:
+            w = ray_tpu._worker.get_worker()
+
+            @ray_tpu.remote(max_retries=4)
+            def slowish(x):
+                import time as _t
+
+                _t.sleep(0.4)
+                return x * 2
+
+            ref = slowish.remote(21)
+            # wait until at least one kill happened, then disarm so the
+            # retry can finish
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline \
+                    and w.memory_monitor.num_kills == 0:
+                time.sleep(0.05)
+            assert w.memory_monitor.num_kills >= 1
+            w.memory_monitor.shutdown()
+            assert ray_tpu.get(ref, timeout=60) == 42
+        finally:
+            ray_tpu.shutdown()
+
+    def test_victim_is_most_recent(self):
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process",
+                                     "memory_usage_threshold": 0.0})
+        try:
+            w = ray_tpu._worker.get_worker()
+            mon = w.memory_monitor
+
+            @ray_tpu.remote
+            def hold(tag):
+                import time as _t
+
+                _t.sleep(3.0)
+                return tag
+
+            r1 = hold.remote("old")
+            time.sleep(0.3)
+            r2 = hold.remote("new")
+            # worker processes take a moment to boot; wait until both
+            # tasks are actually ASSIGNED to handles
+            pool = w.process_pool
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                with pool._lock:
+                    busy = sum(1 for h in pool._handles
+                               if h.busy is not None)
+                if busy >= 2:
+                    break
+                time.sleep(0.05)
+            victim = mon._pick_victim()
+            assert victim is not None
+            # the newest running task is chosen (last-in-first-killed)
+            with pool._lock:
+                newest = max((h for h in pool._handles
+                              if h.busy is not None),
+                             key=lambda h: h._started_at)
+            assert victim[0] == newest.exec_task_id
+            ray_tpu.get([r1, r2], timeout=30)
+        finally:
+            ray_tpu.shutdown()
