@@ -14,14 +14,16 @@ checkpoints are Orbax-style sharded pytrees (save_jax_checkpoint /
 load_jax_checkpoint).
 """
 
-from ray_tpu.train.api import (Checkpoint, FailureConfig,  # noqa: F401
-                               Result, RunConfig, ScalingConfig, Trainer,
-                               get_checkpoint, get_context,
+from ray_tpu.train.api import (Checkpoint, DataIterator,  # noqa: F401
+                               FailureConfig, Result, RunConfig,
+                               ScalingConfig, Trainer, get_checkpoint,
+                               get_context, get_dataset_shard,
                                load_jax_checkpoint, report,
                                save_jax_checkpoint)
 
 __all__ = [
     "Trainer", "ScalingConfig", "RunConfig", "FailureConfig",
     "Checkpoint", "Result", "report", "get_checkpoint", "get_context",
+    "get_dataset_shard", "DataIterator",
     "save_jax_checkpoint", "load_jax_checkpoint",
 ]

@@ -74,13 +74,56 @@ class Result:
 
 class _Session:
     def __init__(self, rank: int, world_size: int,
-                 checkpoint: Optional[Checkpoint]):
+                 checkpoint: Optional[Checkpoint],
+                 dataset_shards: Optional[Dict[str, list]] = None):
         self.rank = rank
         self.world_size = world_size
         self.restore_checkpoint = checkpoint
         self.lock = threading.Lock()
         self.reports: List[Dict[str, Any]] = []
         self.latest_checkpoint: Optional[str] = None
+        self.dataset_shards = dataset_shards or {}
+
+
+class DataIterator:
+    """This worker's shard of a Trainer dataset (reference:
+    ray.train.get_dataset_shard -> DataIterator)."""
+
+    def __init__(self, block_refs: list):
+        self._refs = list(block_refs)
+        self._count: Optional[int] = None
+
+    def iter_batches(self):
+        n = 0
+        for ref in self._refs:
+            block = ray_tpu.get(ref)
+            n += len(block)
+            yield block
+        self._count = n
+
+    def iter_rows(self):
+        for block in self.iter_batches():
+            yield from block
+
+    def count(self) -> int:
+        # cached after any full pass: counting must not re-fetch and
+        # re-deserialize the entire shard on every call
+        if self._count is None:
+            self._count = sum(len(b) for b in self.iter_batches())
+        return self._count
+
+
+def get_dataset_shard(name: str = "train") -> DataIterator:
+    """Inside train_loop_per_worker: this worker's split of the dataset
+    passed to Trainer(datasets={...}) — blocks round-robined by rank."""
+    session = _current_session()
+    if session is None:
+        raise RuntimeError("get_dataset_shard() called outside a train "
+                           "worker")
+    if name not in session.dataset_shards:
+        raise KeyError(f"no dataset named {name!r} was passed to the "
+                       f"Trainer (have: {list(session.dataset_shards)})")
+    return DataIterator(session.dataset_shards[name])
 
 
 # session registry keyed by executing THREAD: thread-mode actors share
@@ -147,10 +190,12 @@ class _TrainWorker:
         self.rank = rank
         self.world_size = world_size
 
-    def run(self, fn, config, checkpoint_path: Optional[str]):
+    def run(self, fn, config, checkpoint_path: Optional[str],
+            dataset_shards: Optional[Dict[str, list]] = None):
         session = _Session(
             self.rank, self.world_size,
-            Checkpoint(checkpoint_path) if checkpoint_path else None)
+            Checkpoint(checkpoint_path) if checkpoint_path else None,
+            dataset_shards)
         self._session = session
         _sessions[threading.get_ident()] = session
         try:
@@ -185,11 +230,13 @@ class Trainer:
     def __init__(self, train_loop_per_worker: Callable[[dict], None],
                  *, train_loop_config: Optional[dict] = None,
                  scaling_config: Optional[ScalingConfig] = None,
-                 run_config: Optional[RunConfig] = None):
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
         self._fn = train_loop_per_worker
         self._config = dict(train_loop_config or {})
         self._scaling = scaling_config or ScalingConfig()
         self._run = run_config or RunConfig()
+        self._datasets = dict(datasets or {})
         if not self._run.storage_path:
             self._run.storage_path = tempfile.mkdtemp(
                 prefix=f"ray_tpu_train_{self._run.name or 'run'}_")
@@ -198,9 +245,16 @@ class Trainer:
         max_failures = self._run.failure_config.max_failures
         failures = 0
         restore: Optional[str] = None
+        # dataset ingest: materialize ONCE, outside the retry loop — a
+        # failure-restart must not re-run the whole Data pipeline (and a
+        # non-deterministic one, e.g. random_shuffle, must not hand the
+        # restarted attempt different data than the checkpointed one).
+        # The refs survive restarts; lineage recovers lost blocks.
+        dataset_refs = {name: ds.materialize().block_refs
+                        for name, ds in self._datasets.items()}
         while True:
             try:
-                return self._run_attempt(restore)
+                return self._run_attempt(restore, dataset_refs)
             except _GroupFailure as gf:
                 failures += 1
                 if max_failures != -1 and failures > max_failures:
@@ -211,8 +265,16 @@ class Trainer:
                 # surviving actors are torn down; a fresh group restarts
                 # from the last checkpoint (reference FailurePolicy)
 
-    def _run_attempt(self, restore: Optional[str]) -> Result:
+    def _run_attempt(self, restore: Optional[str],
+                     dataset_refs: Dict[str, list]) -> Result:
         n = self._scaling.num_workers
+        # round-robin each dataset's block refs across ranks (reference:
+        # Train+Data ingest via get_dataset_shard)
+        shards_by_rank: List[Dict[str, list]] = [dict() for _ in
+                                                 range(n)]
+        for name, refs in dataset_refs.items():
+            for rank in range(n):
+                shards_by_rank[rank][name] = refs[rank::n]
         workers = [
             _TrainWorker.options(
                 max_concurrency=2,
@@ -222,8 +284,9 @@ class Trainer:
             for rank in range(n)
         ]
         try:
-            run_refs = [w.run.remote(self._fn, self._config, restore)
-                        for w in workers]
+            run_refs = [w.run.remote(self._fn, self._config, restore,
+                                     shards_by_rank[rank])
+                        for rank, w in enumerate(workers)]
             rank_of = {ref.object_id(): rank
                        for rank, ref in enumerate(run_refs)}
             latest_ckpt = restore

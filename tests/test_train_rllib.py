@@ -165,3 +165,39 @@ class TestPPO:
             assert out["training_iteration"] == 2
         finally:
             algo.stop()
+
+
+class TestDataIngest:
+    def test_get_dataset_shard_splits_blocks(self, rt):
+        from ray_tpu import data
+
+        def loop(config):
+            shard = train.get_dataset_shard("train")
+            total = sum(shard.iter_rows())
+            n = shard.count()
+            train.report({"sum": total, "rows": n,
+                          "rank": train.get_context().get_world_rank()})
+
+        ds = data.range(100, parallelism=10)
+        trainer = train.Trainer(
+            loop, scaling_config=train.ScalingConfig(num_workers=2),
+            datasets={"train": ds})
+        result = trainer.fit()
+        # rank 0 gets even-indexed blocks; both shards together cover
+        # everything exactly once
+        assert result.metrics["rank"] == 0
+        assert result.metrics["rows"] == 50
+        assert result.metrics["sum"] == sum(
+            x for b in range(0, 10, 2) for x in range(b * 10, b * 10 + 10))
+
+    def test_missing_dataset_raises(self, rt):
+        def loop(config):
+            try:
+                train.get_dataset_shard("nope")
+            except KeyError:
+                train.report({"ok": 1})
+
+        r = train.Trainer(
+            loop,
+            scaling_config=train.ScalingConfig(num_workers=1)).fit()
+        assert r.metrics["ok"] == 1
