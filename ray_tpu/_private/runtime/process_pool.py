@@ -373,6 +373,10 @@ class ProcessWorkerPool:
             raise _DepError(rex.ObjectLostError(oid.hex()))
         if entry.is_exception:
             raise _DepError(entry.value)
+        if isinstance(entry.value, ShmPlaceholder):
+            # not in the arena (locate failed) but placeholder-backed:
+            # the object was SPILLED to disk — restore and ship by value
+            return self._worker._entry_value(oid, entry)
         return entry.value
 
     def _assign(self, h: _Handle, pending: PendingTask, payload: dict) -> None:
@@ -605,6 +609,16 @@ class ProcessWorkerPool:
             loc = self._shm.locate(oid)
             if loc is not None:
                 out.append(("shm", loc[0], loc[1]))
+            elif isinstance(entry.value, ShmPlaceholder):
+                # spilled: the file bytes ARE a framed SerializedObject —
+                # ship them as-is instead of deserializing into driver
+                # heap (pinning the value) and re-serializing
+                sobj = self._shm.get_serialized(oid)
+                if sobj is None:
+                    out.append(("exc", cloudpickle.dumps(
+                        rex.ObjectLostError(oid.hex()))))
+                else:
+                    out.append(("inline", sobj.to_bytes()))
             else:
                 out.append(("inline", serialize(entry.value).to_bytes()))
         return out

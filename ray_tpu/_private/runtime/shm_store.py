@@ -16,6 +16,9 @@ is in scope — the same contract as plasma's read-only buffers).
 
 from __future__ import annotations
 
+import os
+import shutil
+import tempfile
 import threading
 from multiprocessing import shared_memory
 from typing import Dict, List, Optional, Tuple
@@ -186,31 +189,56 @@ class ShmArena:
 
 
 class _Alloc:
-    __slots__ = ("offset", "nbytes", "sealed")
+    __slots__ = ("offset", "nbytes", "sealed", "accessed", "spilling")
 
     def __init__(self, offset: int, nbytes: int):
         self.offset = offset
         self.nbytes = nbytes
         self.sealed = False
+        # a located/read object may be backing live zero-copy views in
+        # some process; evicting its arena region would reuse the bytes
+        # under those views. Never-accessed objects are safe to spill.
+        self.accessed = False
+        self.spilling = False  # selected for spill; write in progress
 
 
 class ShmObjectStore:
-    """Owner-side object table over a ShmArena: create/seal/locate/free.
+    """Owner-side object table over a ShmArena: create/seal/locate/free,
+    with a DISK SPILL tier under memory pressure.
 
-    Reference: plasma's ObjectLifecycleManager — an object is writable
-    between create and seal, immutable and readable after seal.
+    Reference: plasma's ObjectLifecycleManager (create->seal lifecycle)
+    + the raylet's LocalObjectManager (spill primary copies to external
+    storage when the store fills, restore on demand, delete spilled
+    files when refs die — ray: src/ray/raylet/local_object_manager.cc).
+    Eviction policy: FIFO over sealed objects that were never located/
+    read (zero-copy safety, see _Alloc.accessed); the incoming object
+    itself spills when eviction can't free enough.
     """
 
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int,
+                 spill_dir: Optional[str] = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
         self.arena = ShmArena(capacity_bytes)
         self._table: Dict[ObjectID, _Alloc] = {}
+        self._spilled: Dict[ObjectID, Tuple[str, int]] = {}
+        configured = getattr(GLOBAL_CONFIG, "object_spill_dir", "")
+        self._spill_dir = (spill_dir or configured
+                           or tempfile.mkdtemp(prefix="ray_tpu_spill_"))
+        os.makedirs(self._spill_dir, exist_ok=True)
+        self.num_spilled = 0
+        self.num_restored = 0
         self._lock = threading.Lock()
 
     # -- create/seal lifecycle --------------------------------------------
     def create(self, object_id: ObjectID, nbytes: int) -> int:
-        offset = self.arena.allocate(nbytes)
+        try:
+            offset = self.arena.allocate(nbytes)
+        except ObjectStoreFullError:
+            self._spill_for(nbytes)
+            offset = self.arena.allocate(nbytes)  # may raise again
         with self._lock:
-            if object_id in self._table:
+            if object_id in self._table or object_id in self._spilled:
                 self.arena.free(offset, nbytes)
                 raise ValueError(f"object {object_id.hex()} already created")
             self._table[object_id] = _Alloc(offset, nbytes)
@@ -221,43 +249,152 @@ class ShmObjectStore:
             self._table[object_id].sealed = True
 
     def locate(self, object_id: ObjectID) -> Optional[Tuple[int, int]]:
-        """(offset, nbytes) of a SEALED object, else None."""
+        """(offset, nbytes) of a SEALED arena-resident object, else None
+        (spilled objects read through get_serialized)."""
         with self._lock:
             alloc = self._table.get(object_id)
             if alloc is None or not alloc.sealed:
                 return None
+            alloc.accessed = True
             return alloc.offset, alloc.nbytes
 
+    # -- spilling ----------------------------------------------------------
+    def _spill_path(self, object_id: ObjectID) -> str:
+        return os.path.join(self._spill_dir, object_id.hex())
+
+    def _spill_for(self, nbytes: int) -> None:
+        """Evict sealed never-accessed objects (FIFO) to disk until
+        ~nbytes could fit. Best effort: stops when nothing is safely
+        evictable.
+
+        The victim STAYS resident (flagged ``spilling``) until its file
+        write commits, so concurrent readers never observe a window
+        where the object is in neither table; the commit re-checks that
+        the object wasn't freed or accessed while the write ran."""
+        while self.arena.free_bytes() < nbytes:
+            with self._lock:
+                victim = next(
+                    (oid for oid, a in self._table.items()
+                     if a.sealed and not a.accessed and not a.spilling),
+                    None)
+                if victim is None:
+                    return
+                alloc = self._table[victim]
+                alloc.spilling = True
+            path = self._spill_path(victim)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            try:
+                data = bytes(self.arena.view(alloc.offset, alloc.nbytes))
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            except OSError:
+                # disk failure: the object simply stays resident
+                with self._lock:
+                    alloc.spilling = False
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return
+            with self._lock:
+                current = self._table.get(victim)
+                if current is not alloc or alloc.accessed:
+                    # freed or read mid-write: abandon the spill (a
+                    # reader may hold zero-copy views of the region)
+                    if current is alloc:
+                        alloc.spilling = False
+                    committed = False
+                else:
+                    del self._table[victim]
+                    self._spilled[victim] = (path, alloc.nbytes)
+                    self.num_spilled += 1
+                    committed = True
+            if committed:
+                self.arena.free(alloc.offset, alloc.nbytes)
+            else:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+
     def contains(self, object_id: ObjectID) -> bool:
-        return self.locate(object_id) is not None
+        if self.locate(object_id) is not None:
+            return True
+        with self._lock:
+            return object_id in self._spilled
 
     # -- owner-process direct IO ------------------------------------------
     def put_serialized(self, object_id: ObjectID,
                        sobj: SerializedObject) -> Tuple[int, int]:
-        """create + write + seal in the owner process (driver puts)."""
+        """create + write + seal in the owner process (driver puts); an
+        arena that stays full even after eviction spills the NEW object
+        straight to disk."""
         nbytes = sobj.framed_nbytes()
-        offset = self.create(object_id, nbytes)
+        try:
+            offset = self.create(object_id, nbytes)
+        except ObjectStoreFullError:
+            path = self._spill_path(object_id)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            buf = bytearray(nbytes)
+            sobj.write_into(memoryview(buf))
+            with open(tmp, "wb") as f:
+                f.write(buf)
+            os.replace(tmp, path)
+            with self._lock:
+                self._spilled[object_id] = (path, nbytes)
+                self.num_spilled += 1
+            return (-1, nbytes)
         sobj.write_into(self.arena.view(offset, nbytes))
         self.seal(object_id)
         return offset, nbytes
 
     def get_serialized(self, object_id: ObjectID) -> Optional[SerializedObject]:
         loc = self.locate(object_id)
-        if loc is None:
+        if loc is not None:
+            offset, nbytes = loc
+            return SerializedObject.from_bytes(
+                self.arena.view(offset, nbytes))
+        with self._lock:
+            spilled = self._spilled.get(object_id)
+        if spilled is None:
             return None
-        offset, nbytes = loc
-        return SerializedObject.from_bytes(self.arena.view(offset, nbytes))
+        # restore from disk (reference: spilled-object restore path); a
+        # concurrent free may have unlinked the file -> treat as gone
+        try:
+            with open(spilled[0], "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return None
+        with self._lock:
+            self.num_restored += 1
+        return SerializedObject.from_bytes(data)
 
     def free_object(self, object_id: ObjectID) -> None:
         with self._lock:
             alloc = self._table.pop(object_id, None)
+            spilled = self._spilled.pop(object_id, None)
         if alloc is not None:
             self.arena.free(alloc.offset, alloc.nbytes)
+        if spilled is not None:
+            try:
+                os.unlink(spilled[0])  # spilled files die with the ref
+            except FileNotFoundError:
+                pass
 
     # -- stats / lifecycle -------------------------------------------------
     def num_objects(self) -> int:
         with self._lock:
-            return len(self._table)
+            return len(self._table) + len(self._spilled)
+
+    def num_spilled_objects(self) -> int:
+        with self._lock:
+            return len(self._spilled)
+
+    def spilled_bytes(self) -> int:
+        with self._lock:
+            return sum(n for _, n in self._spilled.values())
 
     def used_bytes(self) -> int:
         return self.arena.size - self.arena.free_bytes()
@@ -265,3 +402,6 @@ class ShmObjectStore:
     def shutdown(self) -> None:
         self.arena.close()
         self.arena.unlink()
+        with self._lock:
+            self._spilled.clear()
+        shutil.rmtree(self._spill_dir, ignore_errors=True)

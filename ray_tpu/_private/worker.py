@@ -287,16 +287,16 @@ class Worker:
             sobj = serialize(value)
             if sobj.framed_nbytes() > GLOBAL_CONFIG.inline_object_max_bytes:
                 try:
+                    # a full arena evicts/spills internally; only a DISK
+                    # failure can surface here
                     self.shm_store.put_serialized(object_id, sobj)
                     self.memory_store.put(object_id, _PLACEHOLDER)
                     return ObjectRef(object_id, self.worker_id)
-                except ObjectStoreFullError:
-                    # fall back to the host memory store (workers will
-                    # receive the bytes inline) rather than failing a put
-                    # that thread mode would have absorbed
+                except (ObjectStoreFullError, OSError) as e:
                     logger.warning(
-                        "shm arena full; storing %d-byte object in the "
-                        "host memory store", sobj.framed_nbytes())
+                        "shm store rejected %d-byte object (%s); storing "
+                        "in the host memory store",
+                        sobj.framed_nbytes(), e)
         self.memory_store.put(object_id, value)
         return ObjectRef(object_id, self.worker_id)
 
