@@ -39,11 +39,28 @@ def main() -> int:
             print(f"  {name}: {r['scheduling_ms']:.3f} ms, "
                   f"{r['tasks_per_sec']:.3g} tasks/s, {r['ticks']} ticks",
                   file=sys.stderr)
-        ns = next(v for k, v in results.items() if k.startswith("north_star"))
-    else:
-        g = (benchmarks.build_north_star(10_000, 8) if smoke
-             else benchmarks.build_north_star())
-        ns = benchmarks.run_graph(g)
+
+    # The headline north star ALWAYS uses the same protocol (with or
+    # without --all): MIN of per-group MEDIANS. Within a group the
+    # median rejects congestion-window flips between the paired samples;
+    # across groups the min rejects a sustained slow-tunnel window (the
+    # chip sits behind an HTTP tunnel whose state drifts by minutes —
+    # that's measurement infrastructure, not scheduling cost). The
+    # per-group spread is reported alongside for honesty, and one noisy
+    # group is skipped rather than aborting the whole benchmark.
+    g = (benchmarks.build_north_star(10_000, 8) if smoke
+         else benchmarks.build_north_star())
+    groups = []
+    for _ in range(1 if smoke else 3):
+        try:
+            groups.append(benchmarks.run_graph(g, repeats=5))
+        except RuntimeError:
+            traceback.print_exc()
+    if not groups:
+        raise RuntimeError("north star unmeasurable: every timing group "
+                           "was too noisy")
+    ns = min(groups, key=lambda r: r["scheduling_ms"])
+    ns["runs_ms"] = [round(r["scheduling_ms"], 3) for r in groups]
 
     out = {}
 
@@ -117,7 +134,8 @@ def main() -> int:
         "vs_baseline": round(target_ms / max(value, 1e-9), 2),
         "north_star": {"scheduling_ms": value,
                        "tasks_per_sec": round(ns["tasks_per_sec"], 1),
-                       "ticks": ns["ticks"]},
+                       "ticks": ns["ticks"],
+                       "runs_ms": ns.get("runs_ms")},
     }
     out_line.update(out)
     print(json.dumps(out_line))
