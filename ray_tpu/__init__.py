@@ -157,6 +157,10 @@ def cluster_resources() -> dict:
             continue
         for name, cap in zip(RESOURCE_NAMES, node["capacity"]):
             out[name] = out.get(name, 0.0) + cap
+        # named customs reported per-name (reference semantics); the
+        # aggregate stays under "custom"
+        for name, cap in node.get("custom", {}).items():
+            out[name] = out.get(name, 0.0) + cap
     return out
 
 

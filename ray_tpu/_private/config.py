@@ -124,6 +124,9 @@ _d("object_spill_dir", str, "", "directory for spilled objects; empty = session 
 _d("object_spill_threshold", float, 0.8,
    "fraction of object store usage that triggers spilling of primary copies")
 _d("max_direct_call_object_size", int, 100 * 1024, "alias of inline max")
+_d("object_transfer_timeout_s", float, 120.0,
+   "give up on a cross-node object fetch after this (guards a hung node "
+   "daemon; sized for multi-GB transfers, not as a liveness probe)")
 
 # -- scheduler (device-resident kernel parameters) -------------------------
 _d("sched_tick_interval_s", float, 0.0005, "min seconds between scheduler ticks")

@@ -18,7 +18,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private.ids import ActorID, JobID, NodeID
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID
 
 logger = logging.getLogger(__name__)
 
@@ -73,6 +73,11 @@ class GcsService:
         self._actor_names: Dict[Tuple[str, str], ActorID] = {}
         self._jobs: Dict[JobID, Dict[str, Any]] = {}
         self._kv: Dict[Tuple[str, bytes], bytes] = {}
+        # object directory: primary-copy location of objects resident in
+        # REMOTE node arenas (reference: the object directory the object
+        # manager consults before a Pull —
+        # src/ray/object_manager/ownership_object_directory.cc)
+        self._object_locations: Dict[ObjectID, int] = {}
         self._subs: Dict[str, Dict[int, Callable[[dict], None]]] = {}
         self._sub_seq = 0
         self._health_thread: Optional[threading.Thread] = None
@@ -119,7 +124,28 @@ class GcsService:
     def alive_process_nodes(self) -> List[NodeEntry]:
         with self._lock:
             return [e for e in self._nodes.values()
-                    if e.state == "ALIVE" and e.kind == "process"]
+                    if e.state == "ALIVE"
+                    and e.kind in ("process", "remote")]
+
+    # ------------------------------------------------------------------
+    # object directory (objects primary-resident on remote nodes)
+    # ------------------------------------------------------------------
+    def object_location_add(self, object_id: ObjectID, index: int) -> None:
+        with self._lock:
+            self._object_locations[object_id] = index
+
+    def object_location_get(self, object_id: ObjectID) -> Optional[int]:
+        with self._lock:
+            return self._object_locations.get(object_id)
+
+    def object_location_pop(self, object_id: ObjectID) -> Optional[int]:
+        with self._lock:
+            return self._object_locations.pop(object_id, None)
+
+    def objects_on_node(self, index: int) -> List[ObjectID]:
+        with self._lock:
+            return [oid for oid, i in self._object_locations.items()
+                    if i == index]
 
     # ------------------------------------------------------------------
     # actor table (reference: GcsActorManager — source of truth for
@@ -246,6 +272,11 @@ class GcsService:
         self._health_thread.start()
 
     def _health_loop(self, interval: float) -> None:
+        # consecutive-miss grace (reference: GcsHealthCheckManager's
+        # failure_threshold): one missed probe must not kill a node
+        # whose daemon is merely busy (e.g. serving a large fetch)
+        misses: Dict[Any, int] = {}
+        threshold = 3
         while not self._shutdown:
             time.sleep(interval)
             for e in self.alive_process_nodes():
@@ -254,13 +285,19 @@ class GcsService:
                     continue
                 procs = pool.live_process_count()
                 if procs == 0:
+                    n = misses.get(e.node_id, 0) + 1
+                    misses[e.node_id] = n
+                    if n < threshold:
+                        continue
                     logger.warning("health check: node %s has no live "
-                                   "workers; marking DEAD",
-                                   e.node_id.hex()[:16])
+                                   "workers (%d consecutive probes); "
+                                   "marking DEAD", e.node_id.hex()[:16], n)
                     self._worker.on_node_failure(
                         e.node_id, reason="health check: all worker "
                         "processes dead")
+                    misses.pop(e.node_id, None)
                 else:
+                    misses.pop(e.node_id, None)
                     self.heartbeat(e.node_id)
 
     def shutdown(self) -> None:

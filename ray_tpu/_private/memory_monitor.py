@@ -100,6 +100,8 @@ class MemoryMonitor:
             pools.append(w.process_pool)
         newest = None
         for pool in pools:
+            if getattr(pool, "is_remote", False):
+                continue  # remote workers don't consume HEAD host memory
             with pool._lock:
                 handles = list(pool._handles)
             for h in handles:

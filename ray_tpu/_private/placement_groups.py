@@ -26,7 +26,7 @@ import numpy as np
 
 from ray_tpu._private.ids import ObjectID, PlacementGroupID
 from ray_tpu._private.scheduler import kernels
-from ray_tpu._private.task_spec import resources_to_vector
+from ray_tpu._private.task_spec import custom_resources, resources_to_vector
 from ray_tpu.exceptions import PlacementGroupUnschedulableError
 
 logger = logging.getLogger(__name__)
@@ -36,7 +36,7 @@ VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
 class _Entry:
     __slots__ = ("pg_id", "bundles", "strategy", "name", "state", "rows",
-                 "ready_oid", "demands")
+                 "ready_oid", "demands", "customs")
 
     def __init__(self, pg_id, bundles, strategy, name):
         self.pg_id = pg_id
@@ -48,6 +48,8 @@ class _Entry:
         self.ready_oid = ObjectID.from_random()
         self.demands = np.asarray(
             [resources_to_vector(b) for b in bundles], dtype=np.float32)
+        # named demands per bundle: per-name node feasibility in the pack
+        self.customs = [custom_resources(b) for b in bundles]
 
 
 class PlacementGroupManager:
@@ -146,16 +148,27 @@ class PlacementGroupManager:
         self._retry_wake.set()
 
     # -- internals ----------------------------------------------------------
+    def _eligibility(self, entry: _Entry, rows: List[int]) -> np.ndarray:
+        """[B,N] per-name custom-resource feasibility of each bundle on
+        each candidate node."""
+        scheduler = self._worker.scheduler
+        nodes = [scheduler.node_state(r) for r in rows]
+        return np.asarray(
+            [[ns is not None and ns.has_custom(c) for ns in nodes]
+             for c in entry.customs], dtype=bool)
+
     def _try_place(self, entry: _Entry) -> bool:
         scheduler = self._worker.scheduler
         avail, cap, rows = scheduler.pack_snapshot()
         if avail.shape[0] == 0:
             return False
         sol = kernels.pack_bundles_np(entry.demands, avail, cap,
-                                      entry.strategy)
+                                      entry.strategy,
+                                      eligible=self._eligibility(entry, rows))
         if sol is None:
             return False
-        placements = [(rows[int(n)], tuple(entry.demands[i].tolist()))
+        placements = [(rows[int(n)], tuple(entry.demands[i].tolist()),
+                       entry.customs[i])
                       for i, n in enumerate(sol)]
         got = scheduler.add_bundle_nodes(entry.pg_id, placements)
         if got is None:
@@ -174,9 +187,10 @@ class PlacementGroupManager:
         """No placement under current availability. Infeasible under FULL
         capacity -> permanent error; otherwise park for retry."""
         scheduler = self._worker.scheduler
-        _avail, cap, _rows = scheduler.pack_snapshot()
+        _avail, cap, rows = scheduler.pack_snapshot()
         feasible = cap.shape[0] > 0 and kernels.pack_bundles_np(
-            entry.demands, cap, cap, entry.strategy) is not None
+            entry.demands, cap, cap, entry.strategy,
+            eligible=self._eligibility(entry, rows)) is not None
         if not feasible:
             with self._lock:
                 entry.state = "INFEASIBLE"

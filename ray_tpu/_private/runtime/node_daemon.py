@@ -1,0 +1,377 @@
+"""Node daemon — the raylet-process analog for remote (off-head) nodes.
+
+Reference surfaces: ray src/ray/raylet/ (the per-node raylet binary:
+owns the node's plasma store and worker pool, talks to the GCS/head over
+the network) and src/ray/object_manager/ (the node-local half of object
+transfer). The reference speaks gRPC over the DCN; here the head link is
+one authenticated (HMAC) framed-message TCP connection
+(multiprocessing.connection over AF_INET) — localhost stands in for the
+DCN in tests, and the protocol is transport-agnostic: every message is a
+small picklable tuple, object BYTES ride the same link only when they
+actually cross nodes.
+
+The daemon is a *multiplexer with a local object store*:
+
+  - it execs and monitors this node's worker processes (the same
+    worker_process.py used on the head's local nodes), each attached to
+    the DAEMON's own shm arena — per-node object planes, like one
+    plasma store per node;
+  - worker messages are forwarded to the head tagged with the worker
+    number, and head messages are routed to the right worker pipe, so
+    the head-side pool logic (leases, retries, borrows, actor protocol)
+    is identical for local and remote nodes;
+  - it INTERCEPTS the object-plane RPCs it can serve node-locally:
+    `create` allocates in the local arena, `get` is answered with
+    zero-copy arena locations when every requested object is already
+    sealed here, and sealed task returns are rewritten to compact
+    ``("remote_shm", nbytes)`` markers so result bytes never cross the
+    wire until someone actually needs them (locality: results stay
+    where they were produced, as in the reference's object manager);
+  - it serves the head's transfer ops: ``fetch`` (read object bytes out
+    of the arena/spill tier for a cross-node consumer) and ``free``.
+
+Head -> daemon messages:
+  ("spawn", num)              exec a worker process numbered `num`
+  ("to_w", num, msg)          deliver msg on worker num's task pipe
+  ("to_ctrl", num, msg)       deliver msg on worker num's control pipe
+  ("kill", num)               SIGKILL worker num (force-cancel path)
+  ("fetch", fid, oid_bin)     -> ("fetched", fid, ok, bytes)
+  ("free", [oid_bin, ...])    drop objects from the local store
+  ("ping", pid_)              -> ("pong", pid_, {num: pid})
+  ("exit",)                   kill workers and exit
+
+Daemon -> head messages:
+  ("w", num, msg)             message from worker num (maybe rewritten)
+  ("worker_died", num, code)  worker process exited
+  ("fetched", fid, ok, data)  fetch reply
+  ("pong", pid_, pids)        ping reply
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from multiprocessing.connection import Client, Listener
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class _WorkerSlot:
+    __slots__ = ("num", "proc", "conn", "ctrl", "pid", "returns", "gets")
+
+    def __init__(self, num: int):
+        self.num = num
+        self.proc: Optional[subprocess.Popen] = None
+        self.conn = None
+        self.ctrl = None
+        self.pid: Optional[int] = None
+        # task_id binary -> [return oid binaries] for in-flight payloads,
+        # so sealed shm returns can be rewritten on "done"
+        self.returns: Dict[bytes, list] = {}
+        # req_ids of get RPCs forwarded to the head, whose replies may
+        # carry ("node_shm", oid) markers to rewrite as arena locations
+        self.gets: set = set()
+
+
+class NodeDaemon:
+    def __init__(self, head_address, head_authkey: bytes,
+                 node_token: str, object_store_memory: int,
+                 inline_max: int, spill_dir: Optional[str] = None):
+        from ray_tpu._private.runtime.shm_store import ShmObjectStore
+
+        self.store = ShmObjectStore(object_store_memory,
+                                    spill_dir=spill_dir)
+        self.inline_max = inline_max
+        self._slots: Dict[int, _WorkerSlot] = {}
+        self._lock = threading.Lock()
+        self._shutdown = False
+
+        # workers dial this daemon, never the head (they may share no
+        # filesystem/host with it)
+        self._authkey = os.urandom(16)
+        self._sock_dir = tempfile.mkdtemp(prefix="ray_tpu_node_")
+        self._listener = Listener(
+            address=os.path.join(self._sock_dir, "node.sock"),
+            family="AF_UNIX", authkey=self._authkey)
+
+        self._head = Client(head_address, authkey=head_authkey)
+        self._head_lock = threading.Lock()
+        # arena name travels in the hello so the head can reap the
+        # segment if this daemon is SIGKILLed (machine-death chaos)
+        self._head.send(("hello", node_token, os.getpid(),
+                         self.store.arena.name))
+
+    # ------------------------------------------------------------------
+    def _send_head(self, msg: tuple) -> None:
+        try:
+            with self._head_lock:
+                self._head.send(msg)
+        except (OSError, ValueError):
+            # head gone: nothing to report to; the main loop will exit
+            pass
+
+    # ------------------------------------------------------------------
+    # worker lifecycle
+    # ------------------------------------------------------------------
+    def _spawn(self, num: int) -> None:
+        slot = _WorkerSlot(num)
+        with self._lock:
+            self._slots[num] = slot
+        env = dict(os.environ)
+        env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in sys.path if p) + os.pathsep + env.get("PYTHONPATH", "")
+        slot.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.runtime.worker_process",
+             self._listener.address, self.store.arena.name,
+             str(self.inline_max), str(num)],
+            env=env, close_fds=True)
+        slot.pid = slot.proc.pid
+        threading.Thread(target=self._monitor, args=(slot,), daemon=True,
+                         name=f"ray_tpu_node_monitor_{num}").start()
+
+    def _monitor(self, slot: _WorkerSlot) -> None:
+        slot.proc.wait()
+        with self._lock:
+            gone = self._slots.pop(slot.num, None)
+        if gone is not None and not self._shutdown:
+            self._send_head(("worker_died", slot.num,
+                             slot.proc.returncode))
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            try:
+                hello = conn.recv()
+            except (EOFError, OSError):
+                conn.close()
+                continue
+            if not (isinstance(hello, tuple) and len(hello) == 3
+                    and hello[0] == "hello"):
+                conn.close()
+                continue
+            _, num, kind = hello
+            with self._lock:
+                slot = self._slots.get(num)
+            if slot is None:
+                conn.close()
+                continue
+            if kind == "task":
+                slot.conn = conn
+                threading.Thread(target=self._worker_reader,
+                                 args=(slot,), daemon=True,
+                                 name=f"ray_tpu_node_reader_{num}").start()
+            else:
+                slot.ctrl = conn
+
+    # ------------------------------------------------------------------
+    # worker -> head forwarding, with node-local interception
+    # ------------------------------------------------------------------
+    def _worker_reader(self, slot: _WorkerSlot) -> None:
+        while True:
+            try:
+                msg = slot.conn.recv()
+            except (EOFError, OSError):
+                return  # _monitor reports the death
+            out = self._intercept(slot, msg)
+            if out is not None:
+                self._send_head(("w", slot.num, out))
+
+    def _intercept(self, slot: _WorkerSlot, msg: tuple) -> Optional[tuple]:
+        """Serve node-local object-plane ops; rewrite sealed returns.
+        Returns the message to forward to the head, or None if fully
+        handled here."""
+        kind = msg[0]
+        if kind == "rpc":
+            _, req_id, op, args = msg
+            if op == "create":
+                oid_bin, nbytes = args
+                try:
+                    offset = self.store.create(ObjectID(oid_bin), nbytes)
+                    reply = ("reply", req_id, True, offset)
+                except BaseException as e:  # noqa: BLE001
+                    import cloudpickle
+                    reply = ("reply", req_id, False, cloudpickle.dumps(e))
+                self._to_worker(slot, reply)
+                return None
+            if op == "put":
+                oid_bin, loc = args
+                if loc[0] == "shm":
+                    # seal here; the head records the location only
+                    self.store.seal(ObjectID(oid_bin))
+                    return ("rpc", req_id, "put",
+                            (oid_bin, ("remote_shm", loc[2])))
+                return msg
+            if op == "get":
+                oid_bins, timeout = args
+                locs = []
+                for b in oid_bins:
+                    loc = self.store.locate(ObjectID(b))
+                    if loc is None:
+                        # something not arena-resident (unsealed, spilled,
+                        # exception, or remote): the head decides; its
+                        # reply may point back here via node_shm markers
+                        slot.gets.add(req_id)
+                        return msg
+                    locs.append(("shm", loc[0], loc[1]))
+                self._to_worker(slot, ("reply", req_id, True, locs))
+                return None
+            return msg
+        if kind in ("done",):
+            task_id_bin, entries = msg[1], msg[2]
+            return_bins = slot.returns.pop(task_id_bin, [])
+            out = []
+            for i, entry in enumerate(entries):
+                if entry[0] == "shm" and i < len(return_bins):
+                    self.store.seal(ObjectID(return_bins[i]))
+                    out.append(("remote_shm", entry[2]))
+                else:
+                    out.append(entry)
+            return (msg[0], task_id_bin, out)
+        if kind == "err":
+            slot.returns.pop(msg[1], None)
+        return msg
+
+    def _localize(self, loc: tuple) -> tuple:
+        """Rewrite a head get-reply entry pointing at THIS node's store
+        (("node_shm", oid)) into a zero-copy arena location, restoring
+        from the spill tier when evicted."""
+        if not (isinstance(loc, tuple) and loc and loc[0] == "node_shm"):
+            return loc
+        oid = ObjectID(loc[1])
+        arena_loc = self.store.locate(oid)
+        if arena_loc is not None:
+            return ("shm", arena_loc[0], arena_loc[1])
+        sobj = self.store.get_serialized(oid)  # spilled -> restore
+        if sobj is not None:
+            return ("inline", sobj.to_bytes())
+        import cloudpickle
+
+        from ray_tpu import exceptions as rex
+        return ("exc", cloudpickle.dumps(
+            rex.ObjectLostError(oid.hex())))
+
+    def _to_worker(self, slot: _WorkerSlot, msg: tuple) -> None:
+        try:
+            slot.conn.send(msg)
+        except (OSError, ValueError):
+            pass
+
+    # ------------------------------------------------------------------
+    # head -> daemon main loop
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="ray_tpu_node_accept").start()
+        while not self._shutdown:
+            try:
+                msg = self._head.recv()
+            except (EOFError, OSError):
+                break  # head gone: the node dies with it
+            kind = msg[0]
+            if kind == "spawn":
+                self._spawn(msg[1])
+            elif kind == "to_w":
+                num, payload = msg[1], msg[2]
+                with self._lock:
+                    slot = self._slots.get(num)
+                if slot is not None and slot.conn is not None:
+                    if payload[0] in ("task", "actor_create", "actor_call"):
+                        p = payload[1]
+                        rids = p.get("return_ids")
+                        if rids:
+                            slot.returns[p["task_id"]] = list(rids)
+                    elif (payload[0] == "reply"
+                          and payload[1] in slot.gets):
+                        slot.gets.discard(payload[1])
+                        if payload[2]:
+                            payload = ("reply", payload[1], True,
+                                       [self._localize(loc)
+                                        for loc in payload[3]])
+                    self._to_worker(slot, payload)
+            elif kind == "to_ctrl":
+                with self._lock:
+                    slot = self._slots.get(msg[1])
+                if slot is not None and slot.ctrl is not None:
+                    try:
+                        slot.ctrl.send(msg[2])
+                    except (OSError, ValueError):
+                        pass
+            elif kind == "kill":
+                with self._lock:
+                    slot = self._slots.get(msg[1])
+                if slot is not None and slot.proc is not None:
+                    try:
+                        slot.proc.kill()
+                    except Exception:
+                        pass
+            elif kind == "fetch":
+                fid, oid_bin = msg[1], msg[2]
+                sobj = self.store.get_serialized(ObjectID(oid_bin))
+                if sobj is None:
+                    self._send_head(("fetched", fid, False, None))
+                else:
+                    self._send_head(("fetched", fid, True, sobj.to_bytes()))
+            elif kind == "free":
+                for b in msg[1]:
+                    self.store.free_object(ObjectID(b))
+            elif kind == "ping":
+                with self._lock:
+                    pids = {s.num: s.pid for s in self._slots.values()
+                            if s.proc is not None and s.proc.poll() is None}
+                self._send_head(("pong", msg[1], pids))
+            elif kind == "exit":
+                break
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self._lock:
+            slots = list(self._slots.values())
+        for s in slots:
+            if s.conn is not None:
+                try:
+                    s.conn.send(("exit",))
+                except (OSError, ValueError):
+                    pass
+        for s in slots:
+            if s.proc is not None:
+                try:
+                    s.proc.wait(timeout=1.0)
+                except subprocess.TimeoutExpired:
+                    s.proc.kill()
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        try:
+            os.rmdir(self._sock_dir)
+        except OSError:
+            pass
+        self.store.shutdown()
+
+
+def _main(argv) -> None:
+    """``python -m ray_tpu._private.runtime.node_daemon <host> <port>
+    <token> <object_store_memory> <inline_max>`` with the head authkey in
+    RAY_TPU_HEAD_AUTHKEY. Exec'd by the head's Cluster harness (or by
+    `ray_tpu start --address=...` on another machine)."""
+    host, port, token = argv[0], int(argv[1]), argv[2]
+    mem, inline_max = int(argv[3]), int(argv[4])
+    authkey = bytes.fromhex(os.environ["RAY_TPU_HEAD_AUTHKEY"])
+    daemon = NodeDaemon((host, port), authkey, token, mem, inline_max)
+    daemon.run()
+
+
+if __name__ == "__main__":
+    # canonical-import re-entry (same reason as worker_process.py)
+    from ray_tpu._private.runtime import node_daemon as _canonical
+
+    _canonical._main(sys.argv[1:])

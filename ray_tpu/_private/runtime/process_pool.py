@@ -48,6 +48,17 @@ class ShmPlaceholder:
     __slots__ = ()
 
 
+class RemotePlaceholder:
+    """Memory-store entry whose bytes live in a REMOTE node's arena
+    (see runtime/remote_pool.py); the GCS object directory records
+    which node. Resolved head-side by fetching on first access."""
+
+    __slots__ = ("node_index",)
+
+    def __init__(self, node_index: int):
+        self.node_index = node_index
+
+
 _PLACEHOLDER = ShmPlaceholder()
 
 
@@ -101,6 +112,8 @@ class _Handle:
 
 
 class ProcessWorkerPool:
+    is_remote = False
+
     def __init__(self, worker, num_workers: int, shm_store,
                  node_index: int = 0):
         self._worker = worker
@@ -124,6 +137,13 @@ class ProcessWorkerPool:
         # unix socket) — never fork/spawn of this process, whose jax/TPU
         # state and threads are not fork-safe and whose __main__ must not
         # be re-run
+        self._start_transport()
+        for _ in range(num_workers):
+            self._handles.append(self._spawn())
+
+    def _start_transport(self) -> None:
+        """Local transport: a unix socket the exec'd workers dial back
+        to (remote pools talk to a node daemon instead)."""
         self._authkey = os.urandom(16)
         self._sock_dir = tempfile.mkdtemp(prefix="ray_tpu_pool_")
         self._listener = Listener(
@@ -131,8 +151,6 @@ class ProcessWorkerPool:
             family="AF_UNIX", authkey=self._authkey)
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="ray_tpu_pool_accept").start()
-        for _ in range(num_workers):
-            self._handles.append(self._spawn())
 
     # ------------------------------------------------------------------
     # worker lifecycle
@@ -213,11 +231,7 @@ class ProcessWorkerPool:
         with self._lock:
             handles = list(self._handles) + list(self._actor_handles)
         for h in handles:
-            if h.proc is not None:
-                try:
-                    h.proc.kill()
-                except Exception:
-                    pass
+            self._kill_handle(h)
 
     def fail_node(self, reason: str) -> None:
         """The node this pool backs died: fail queued work retriably, kill
@@ -240,11 +254,16 @@ class ProcessWorkerPool:
             retry = self._worker._handle_task_failure(spec, return_ids, exc)
             self._finish_task(pending, spec.task_id, retry)
         for h in handles:
-            if h.proc is not None:
-                try:
-                    h.proc.kill()
-                except Exception:
-                    pass
+            self._kill_handle(h)
+
+    def _kill_handle(self, h: _Handle) -> None:
+        """SIGKILL the worker behind a handle (remote pools route this
+        through the node daemon)."""
+        if h.proc is not None:
+            try:
+                h.proc.kill()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     # dedicated actor workers (reference: every actor gets its own
@@ -269,11 +288,8 @@ class ProcessWorkerPool:
                 pass
             h.dead = True
             self._by_num.pop(h.worker_num, None)
-        if kill and h.proc is not None:
-            try:
-                h.proc.kill()
-            except Exception:
-                pass
+        if kill:
+            self._kill_handle(h)
         elif h.conn is not None:
             try:
                 with h.send_lock:
@@ -373,9 +389,10 @@ class ProcessWorkerPool:
             raise _DepError(rex.ObjectLostError(oid.hex()))
         if entry.is_exception:
             raise _DepError(entry.value)
-        if isinstance(entry.value, ShmPlaceholder):
-            # not in the arena (locate failed) but placeholder-backed:
-            # the object was SPILLED to disk — restore and ship by value
+        if isinstance(entry.value, (ShmPlaceholder, RemotePlaceholder)):
+            # not in this node's arena: SPILLED to disk (restore) or
+            # resident on a remote node (head-mediated fetch) — either
+            # way _entry_value materializes it to ship by value
             return self._worker._entry_value(oid, entry)
         return entry.value
 
@@ -417,30 +434,35 @@ class ProcessWorkerPool:
             except (EOFError, OSError):
                 self._on_worker_failure(h, None)
                 return
-            kind = msg[0]
-            try:
-                if kind == "ready":
-                    h.pid = msg[1]
-                    h.ready = True
-                    if h.actor_rt is not None:
-                        h.actor_rt._on_worker_ready(h)
-                    else:
-                        self._mark_idle(h)
-                elif kind == "done":
-                    if h.actor_rt is not None:
-                        h.actor_rt._on_remote_done(TaskID(msg[1]), msg[2])
-                    else:
-                        self._on_done(h, TaskID(msg[1]), msg[2])
-                elif kind == "err":
-                    if h.actor_rt is not None:
-                        h.actor_rt._on_remote_err(TaskID(msg[1]), msg[2],
-                                                  msg[3])
-                    else:
-                        self._on_err(h, TaskID(msg[1]), msg[2], msg[3])
-                elif kind == "rpc":
-                    self._on_rpc(h, msg[1], msg[2], msg[3])
-            except Exception:
-                logger.exception("pool reader failed handling %s", kind)
+            self._handle_worker_msg(h, msg)
+
+    def _handle_worker_msg(self, h: _Handle, msg: tuple) -> None:
+        """One worker->owner message (shared by the local per-worker
+        reader threads and the remote node demux)."""
+        kind = msg[0]
+        try:
+            if kind == "ready":
+                h.pid = msg[1]
+                h.ready = True
+                if h.actor_rt is not None:
+                    h.actor_rt._on_worker_ready(h)
+                else:
+                    self._mark_idle(h)
+            elif kind == "done":
+                if h.actor_rt is not None:
+                    h.actor_rt._on_remote_done(TaskID(msg[1]), msg[2])
+                else:
+                    self._on_done(h, TaskID(msg[1]), msg[2])
+            elif kind == "err":
+                if h.actor_rt is not None:
+                    h.actor_rt._on_remote_err(TaskID(msg[1]), msg[2],
+                                              msg[3])
+                else:
+                    self._on_err(h, TaskID(msg[1]), msg[2], msg[3])
+            elif kind == "rpc":
+                self._on_rpc(h, msg[1], msg[2], msg[3])
+        except Exception:
+            logger.exception("pool reader failed handling %s", kind)
 
     def _mark_idle(self, h: _Handle) -> None:
         nxt = None
@@ -606,6 +628,17 @@ class ProcessWorkerPool:
             if entry.is_exception:
                 out.append(("exc", cloudpickle.dumps(entry.value)))
                 continue
+            if isinstance(entry.value, RemotePlaceholder):
+                # produced on a remote node: head-mediated pull, shipped
+                # inline to this (local) worker
+                data = self._worker.fetch_object_bytes(
+                    oid, entry.value.node_index)
+                if data is None:
+                    out.append(("exc", cloudpickle.dumps(
+                        rex.ObjectLostError(oid.hex()))))
+                else:
+                    out.append(("inline", data))
+                continue
             loc = self._shm.locate(oid)
             if loc is not None:
                 out.append(("shm", loc[0], loc[1]))
@@ -686,10 +719,7 @@ class ProcessWorkerPool:
             return False
         if force:
             h.force_cancelled = True
-            try:
-                h.proc.kill()
-            except Exception:
-                pass
+            self._kill_handle(h)
         elif h.ctrl is not None:
             try:
                 h.ctrl.send(("cancel", task_id.binary()))

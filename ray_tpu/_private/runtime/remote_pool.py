@@ -1,0 +1,465 @@
+"""Head-side runtime for remote (off-head) nodes.
+
+Reference surfaces: the head's view of a remote raylet — ray
+src/ray/raylet_client/ (lease/cancel RPCs to a node), the object
+manager's cross-node half (src/ray/object_manager/: Pull/Push of object
+chunks between nodes), and the GCS object directory
+(src/ray/object_manager/ownership_object_directory.cc) that maps objects
+to the nodes holding their primary copy.
+
+``RemoteNodePool`` subclasses ProcessWorkerPool so every owner-side
+protocol (lease grants, retries, borrower bookkeeping, the whole actor
+message protocol) is byte-identical for local and remote nodes; only
+the transport differs. Worker pipes become proxy sends over the single
+head<->daemon connection; a demux thread fans incoming daemon traffic
+out to per-worker queues (preserving per-worker message order, exactly
+like the local per-worker reader threads). Object movement:
+
+  - task results stay in the PRODUCING node's arena; the head stores a
+    ``RemotePlaceholder`` and records the location in the GCS object
+    directory (bytes cross the wire only on first cross-node use);
+  - a dep already resident on the target node ships as a ``_PullValue``
+    marker the worker resolves from its local arena zero-copy;
+  - a dep living on the head (or a third node) is embedded in the task
+    payload — fetched head-side first if needed (head-mediated
+    transfer; the reference does node-to-node pushes, which this
+    protocol admits later by handing the daemon a peer address instead
+    of inline bytes);
+  - daemon connection loss IS node-failure detection (the DCN story:
+    a dead TCP link marks the node dead, like the reference's
+    health-check RPC timeouts).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import subprocess
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from multiprocessing.connection import Listener
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as rex
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.runtime.process_pool import (_DepError, _Handle,
+                                                   _RequeueDeps,
+                                                   ProcessWorkerPool,
+                                                   RemotePlaceholder)
+from ray_tpu._private.runtime.worker_process import _PullValue
+from ray_tpu._private.serialization import serialize
+
+logger = logging.getLogger(__name__)
+
+
+class _ProxyConn:
+    """Send-only facade standing in for a worker pipe: routes through
+    the daemon link tagged with the worker number."""
+
+    __slots__ = ("_pool", "_num", "_channel")
+
+    def __init__(self, pool: "RemoteNodePool", num: int, channel: str):
+        self._pool = pool
+        self._num = num
+        self._channel = channel
+
+    def send(self, msg) -> None:
+        self._pool._send_daemon((self._channel, self._num, msg))
+
+    def close(self) -> None:
+        pass
+
+
+class RemoteNodePool(ProcessWorkerPool):
+    is_remote = True
+
+    def __init__(self, worker, num_workers: int, node_index: int, conn,
+                 node_id, daemon_proc: Optional[subprocess.Popen] = None,
+                 arena_name: Optional[str] = None):
+        self._arena_name = arena_name
+        self._conn = conn
+        self._conn_lock = threading.Lock()
+        self._conn_dead = False
+        self.node_id = node_id
+        self._daemon_proc = daemon_proc
+        self._hqueues: Dict[int, queue.Queue] = {}
+        self._fetches: Dict[int, Tuple[threading.Event, list]] = {}
+        self._pings: Dict[int, Tuple[threading.Event, list]] = {}
+        self._req_seq = 0
+        self._req_lock = threading.Lock()
+        # blocking worker RPCs (get/wait) must not stall the demux
+        # thread; per-worker ordering is preserved by the handle queues,
+        # and rpc replies are request-id-matched worker-side
+        self._rpc_pool = ThreadPoolExecutor(
+            max_workers=max(num_workers + 2, 4),
+            thread_name_prefix="ray_tpu_remote_rpc")
+        super().__init__(worker, num_workers, None, node_index=node_index)
+
+    # -- transport -----------------------------------------------------
+    def _start_transport(self) -> None:
+        threading.Thread(target=self._demux_loop, daemon=True,
+                         name=f"ray_tpu_remote_demux_{self.node_index}"
+                         ).start()
+
+    def _send_daemon(self, msg: tuple) -> None:
+        try:
+            with self._conn_lock:
+                self._conn.send(msg)
+        except (OSError, ValueError):
+            pass  # demux EOF handles the failure
+
+    def _next_req(self) -> int:
+        with self._req_lock:
+            self._req_seq += 1
+            return self._req_seq
+
+    def _demux_loop(self) -> None:
+        while True:
+            try:
+                msg = self._conn.recv()
+            except (EOFError, OSError, TypeError, ValueError):
+                # TypeError/ValueError: conn closed under a blocked recv
+                self._on_daemon_lost()
+                return
+            kind = msg[0]
+            if kind == "w":
+                num, wmsg = msg[1], msg[2]
+                with self._lock:
+                    h = self._by_num.get(num)
+                q = self._hqueues.get(num)
+                if h is not None and q is not None:
+                    q.put(wmsg)
+            elif kind == "worker_died":
+                q = self._hqueues.get(msg[1])
+                if q is not None:
+                    q.put(("__died__", msg[2]))
+            elif kind == "fetched":
+                slot = self._fetches.pop(msg[1], None)
+                if slot is not None:
+                    slot[1][:] = [msg[2], msg[3]]
+                    slot[0].set()
+            elif kind == "pong":
+                slot = self._pings.pop(msg[1], None)
+                if slot is not None:
+                    slot[1][:] = [msg[2]]
+                    slot[0].set()
+
+    def _on_daemon_lost(self) -> None:
+        self._conn_dead = True
+        # unblock fetch/ping waiters
+        for table in (self._fetches, self._pings):
+            for ev, _slot in list(table.values()):
+                ev.set()
+            table.clear()
+        # snapshot: _queue_loop threads pop _hqueues as they die
+        for q in list(self._hqueues.values()):
+            q.put(("__died__", "daemon connection lost"))
+        if not self._shutdown and not self._node_dead:
+            logger.warning("node %s: daemon connection lost; marking dead",
+                           self.node_id.hex()[:16])
+            try:
+                self._worker.on_node_failure(
+                    self.node_id, reason="daemon connection lost")
+            except Exception:
+                logger.exception("on_node_failure failed")
+        self._unlink_dead_arena()
+
+    def _unlink_dead_arena(self) -> None:
+        """A SIGKILLed daemon can't unlink its own arena; reap it once
+        the daemon process is confirmed gone (head-spawned only)."""
+        if self._arena_name is None or self._daemon_proc is None:
+            return
+        try:
+            self._daemon_proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            return
+        from multiprocessing import shared_memory
+        try:
+            seg = shared_memory.SharedMemory(name=self._arena_name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception:
+            logger.debug("arena reap failed", exc_info=True)
+        self._arena_name = None
+
+    # -- worker lifecycle ----------------------------------------------
+    def _spawn(self) -> _Handle:
+        with self._lock:
+            self._worker_seq += 1
+            num = self._worker_seq
+        h = _Handle(num)
+        h.conn = _ProxyConn(self, num, "to_w")
+        h.ctrl = _ProxyConn(self, num, "to_ctrl")
+        q: queue.Queue = queue.Queue()
+        self._hqueues[num] = q
+        with self._lock:
+            self._by_num[num] = h
+        threading.Thread(target=self._queue_loop, args=(h, q), daemon=True,
+                         name=f"ray_tpu_remote_w{num}").start()
+        self._send_daemon(("spawn", num))
+        return h
+
+    def _queue_loop(self, h: _Handle, q: queue.Queue) -> None:
+        """Per-worker message pump — the remote analog of the local
+        per-worker reader thread (same ordering guarantees)."""
+        while True:
+            msg = q.get()
+            if msg[0] == "__died__":
+                self._hqueues.pop(h.worker_num, None)
+                self._on_worker_failure(h, msg[1])
+                return
+            if msg[0] == "rpc":
+                # blocking get/wait must not stall this worker's pump
+                # either: an actor's kill/exit travels h.conn, but
+                # completions for OTHER workers (which a get may await)
+                # come through other queues — only same-worker ordering
+                # matters, and a worker blocks in its rpc anyway
+                self._rpc_pool.submit(self._handle_worker_msg, h, msg)
+            else:
+                self._handle_worker_msg(h, msg)
+
+    def _kill_handle(self, h: _Handle) -> None:
+        self._send_daemon(("kill", h.worker_num))
+
+    def pids(self) -> List[int]:
+        pids = self._ping()
+        return sorted(pids.values()) if pids else []
+
+    def live_process_count(self) -> int:
+        pids = self._ping()
+        return len(pids) if pids else 0
+
+    def _ping(self, timeout: float = 2.0) -> Optional[Dict[int, int]]:
+        if self._conn_dead:
+            return None
+        pid_ = self._next_req()
+        ev: threading.Event = threading.Event()
+        slot: list = []
+        self._pings[pid_] = (ev, slot)
+        self._send_daemon(("ping", pid_))
+        if not ev.wait(timeout) or not slot:
+            self._pings.pop(pid_, None)
+            return None
+        return slot[0]
+
+    def simulate_machine_death(self) -> None:
+        """Chaos: SIGKILL the node daemon (the whole 'machine'). The
+        control plane is NOT told; the severed connection / health
+        checks must notice."""
+        self._respawn_disabled = True
+        if self._daemon_proc is not None:
+            try:
+                self._daemon_proc.kill()
+            except Exception:
+                pass
+        else:
+            self._send_daemon(("exit",))
+
+    # -- object movement ----------------------------------------------
+    def fetch_object(self, oid: ObjectID,
+                     timeout: Optional[float] = None) -> Optional[bytes]:
+        """Pull an object's framed bytes out of the node's arena/spill
+        tier (the PullManager request). The timeout guards against a
+        hung daemon, not a slow transfer — default is config-driven so
+        multi-GB objects don't misreport as lost."""
+        if self._conn_dead:
+            return None
+        if timeout is None:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+            timeout = GLOBAL_CONFIG.object_transfer_timeout_s
+        fid = self._next_req()
+        ev: threading.Event = threading.Event()
+        slot: list = []
+        self._fetches[fid] = (ev, slot)
+        self._send_daemon(("fetch", fid, oid.binary()))
+        if not ev.wait(timeout) or not slot or not slot[0]:
+            self._fetches.pop(fid, None)
+            return None
+        return slot[1]
+
+    def free_remote(self, oids: List[ObjectID]) -> None:
+        self._send_daemon(("free", [o.binary() for o in oids]))
+
+    def _resolve_for_ship(self, v: Any) -> Any:
+        if not isinstance(v, ObjectRef):
+            return v
+        oid = v.object_id()
+        loc = self._worker.gcs.object_location_get(oid)
+        if loc == self.node_index:
+            # already resident in the target node's arena: the worker
+            # reads it zero-copy through its daemon (no wire bytes)
+            return _PullValue(oid.binary())
+        entry = self._worker.memory_store.get_entry(oid)
+        if entry is None:
+            if self._worker.object_recovery.maybe_recover(oid):
+                raise _RequeueDeps([oid])
+            entry = self._worker.memory_store.get_entry(oid)
+        if entry is None:
+            raise _DepError(rex.ObjectLostError(oid.hex()))
+        if entry.is_exception:
+            raise _DepError(entry.value)
+        # resolves head-arena placeholders, spilled restores, AND
+        # third-node RemotePlaceholders (head-mediated fetch), then
+        # embeds the value in the payload — the actual DCN transfer
+        return self._worker._entry_value(oid, entry)
+
+    def store_result_entries(self, return_ids: List[ObjectID],
+                             entries: list) -> None:
+        for oid, entry in zip(return_ids, entries):
+            if entry[0] == "remote_shm":
+                self._worker.memory_store.put(
+                    oid, RemotePlaceholder(self.node_index))
+                self._worker.gcs.object_location_add(oid, self.node_index)
+            else:
+                from ray_tpu._private.serialization import (SerializedObject,
+                                                            deserialize)
+                value = deserialize(SerializedObject.from_bytes(entry[1]))
+                self._worker.memory_store.put(oid, value)
+            self._worker.scheduler.notify_object_ready(oid)
+
+    # -- worker-initiated RPC overrides --------------------------------
+    def _rpc_put(self, h: _Handle, oid_bin: bytes, loc: tuple) -> bool:
+        if loc[0] != "remote_shm":
+            return super()._rpc_put(h, oid_bin, loc)
+        oid = ObjectID(oid_bin)
+        self._worker.reference_counter.add_owned_object(oid)
+        self._worker.reference_counter.add_borrower(oid, h.worker_id)
+        h.borrows.add(oid)
+        self._worker.memory_store.put(oid, RemotePlaceholder(self.node_index))
+        self._worker.gcs.object_location_add(oid, self.node_index)
+        self._worker.scheduler.notify_object_ready(oid)
+        return True
+
+    def _rpc_get(self, h: _Handle, oid_bins: list,
+                 timeout: Optional[float]) -> list:
+        oids = [ObjectID(b) for b in oid_bins]
+        try:
+            entries = self._worker.memory_store.wait_and_get(oids, timeout)
+        except TimeoutError as e:
+            raise rex.GetTimeoutError(str(e)) from None
+        out = []
+        for oid, entry in zip(oids, entries):
+            if entry.is_exception:
+                out.append(("exc", cloudpickle.dumps(entry.value)))
+                continue
+            value = entry.value
+            if isinstance(value, RemotePlaceholder):
+                if value.node_index == self.node_index:
+                    # resident on the REQUESTING node: daemon rewrites
+                    # this to a zero-copy arena location
+                    out.append(("node_shm", oid.binary()))
+                    continue
+                data = self._worker.fetch_object_bytes(oid,
+                                                       value.node_index)
+                if data is None:
+                    out.append(("exc", cloudpickle.dumps(
+                        rex.ObjectLostError(oid.hex()))))
+                else:
+                    out.append(("inline", data))
+                continue
+            from ray_tpu._private.runtime.process_pool import ShmPlaceholder
+            if isinstance(value, ShmPlaceholder):
+                sobj = self._worker.shm_store.get_serialized(oid)
+                if sobj is None:
+                    out.append(("exc", cloudpickle.dumps(
+                        rex.ObjectLostError(oid.hex()))))
+                else:
+                    out.append(("inline", sobj.to_bytes()))
+            else:
+                out.append(("inline", serialize(value).to_bytes()))
+        return out
+
+    def fail_node(self, reason: str) -> None:
+        super().fail_node(reason)
+        self._send_daemon(("exit",))
+
+    # -- shutdown ------------------------------------------------------
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._queue.clear()
+            self._idle.clear()
+        self._send_daemon(("exit",))
+        try:
+            with self._conn_lock:
+                self._conn.close()
+        except Exception:
+            pass
+        if self._daemon_proc is not None:
+            try:
+                self._daemon_proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                self._daemon_proc.kill()
+        self._unlink_dead_arena()
+        self._rpc_pool.shutdown(wait=False)
+
+
+class HeadServer:
+    """The head's TCP registration endpoint: node daemons (and later
+    remote clients) dial in with an HMAC handshake and a token issued
+    at spawn time (reference: the GCS server's listening port that
+    raylets register against)."""
+
+    def __init__(self):
+        self.authkey = os.urandom(16)
+        self._listener = Listener(("127.0.0.1", 0), authkey=self.authkey)
+        self.address: Tuple[str, int] = self._listener.address
+        self._pending: Dict[str, Tuple[threading.Event, list]] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self.on_unsolicited = None  # hook for client/CLI registrations
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="ray_tpu_head_accept").start()
+
+    def expect(self, token: str) -> Tuple[threading.Event, list]:
+        slot: Tuple[threading.Event, list] = (threading.Event(), [])
+        with self._lock:
+            self._pending[token] = slot
+        return slot
+
+    def issue_token(self) -> str:
+        return uuid.uuid4().hex
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            try:
+                hello = conn.recv()
+            except (EOFError, OSError):
+                conn.close()
+                continue
+            if not (isinstance(hello, tuple) and len(hello) >= 2
+                    and hello[0] == "hello"):
+                conn.close()
+                continue
+            token = hello[1]
+            with self._lock:
+                slot = self._pending.pop(token, None)
+            if slot is not None:
+                slot[1][:] = [conn, hello]
+                slot[0].set()
+            elif self.on_unsolicited is not None:
+                try:
+                    self.on_unsolicited(conn, hello)
+                except Exception:
+                    logger.exception("unsolicited registration failed")
+                    conn.close()
+            else:
+                conn.close()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass

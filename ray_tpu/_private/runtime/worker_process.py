@@ -46,6 +46,19 @@ class _ShmValue:
         self.nbytes = nbytes
 
 
+class _PullValue:
+    """Placeholder for an arg resident in the WORKER's node arena (or
+    pullable through it): resolved by a get RPC, which the node daemon
+    answers with a zero-copy arena location when the object is already
+    local (remote-node locality path — the head ships this marker
+    instead of bytes when the dep lives where the task runs)."""
+
+    __slots__ = ("oid_bin",)
+
+    def __init__(self, oid_bin: bytes):
+        self.oid_bin = oid_bin
+
+
 def fn_id_of(blob: bytes) -> bytes:
     return hashlib.sha1(blob).digest()
 
@@ -343,6 +356,17 @@ class _WorkerRunner:
         if isinstance(v, _ShmValue):
             view = self.arena.view(v.offset, v.nbytes)
             return deserialize(SerializedObject.from_bytes(view))
+        if isinstance(v, _PullValue):
+            from ray_tpu import exceptions as rex
+
+            locs = self.rpc("get", ([v.oid_bin], None))
+            loc = locs[0]
+            if loc[0] == "exc":
+                exc = cloudpickle.loads(loc[1])
+                if isinstance(exc, rex.TaskError):
+                    raise exc.as_instanceof_cause()
+                raise exc
+            return self.load_location(loc)
         return v
 
     # -- main loop ---------------------------------------------------------

@@ -159,13 +159,17 @@ def fire_edges_np(done_mask: np.ndarray, src: np.ndarray, dst: np.ndarray,
 
 
 def pack_bundles_np(demands: np.ndarray, avail: np.ndarray, cap: np.ndarray,
-                    strategy: str) -> Optional[np.ndarray]:
+                    strategy: str,
+                    eligible: Optional[np.ndarray] = None
+                    ) -> Optional[np.ndarray]:
     """Bin-pack one placement group's bundles onto nodes.
 
     The decision core of the reference's GcsPlacementGroupScheduler
     (ray: src/ray/gcs/gcs_server/gcs_placement_group_scheduler.cc) as a
-    vectorized solve: demands [B,R], avail/cap [N,R]. Returns node index
-    per bundle, or None if no placement exists under ``avail``.
+    vectorized solve: demands [B,R], avail/cap [N,R]. ``eligible`` [B,N]
+    restricts which nodes may host each bundle (per-NAME custom-resource
+    feasibility computed by the caller). Returns node index per bundle,
+    or None if no placement exists under ``avail``.
 
     Strategies (reference: python/ray/util/placement_group.py):
       PACK         prefer one node, spill when full
@@ -176,6 +180,9 @@ def pack_bundles_np(demands: np.ndarray, avail: np.ndarray, cap: np.ndarray,
     B, R = demands.shape
     N = avail.shape[0]
     alive = cap.any(axis=1)
+    ok = np.broadcast_to(alive, (B, N)).copy()
+    if eligible is not None:
+        ok &= eligible
     rem = avail.copy()
     out = np.full(B, -1, dtype=np.int32)
     # least-loaded-first node order (deterministic tiebreak by index)
@@ -186,8 +193,9 @@ def pack_bundles_np(demands: np.ndarray, avail: np.ndarray, cap: np.ndarray,
 
     if strategy == "STRICT_PACK":
         total = demands.sum(axis=0)
+        all_ok = ok.all(axis=0)
         for n in order:
-            if alive[n] and (rem[n] >= total).all():
+            if all_ok[n] and (rem[n] >= total).all():
                 out[:] = n
                 return out
         return None
@@ -199,7 +207,8 @@ def pack_bundles_np(demands: np.ndarray, avail: np.ndarray, cap: np.ndarray,
         for b in bundle_order:
             placed = False
             for n in order:
-                if alive[n] and not used[n] and (rem[n] >= demands[b]).all():
+                if ok[b, n] and not used[n] \
+                        and (rem[n] >= demands[b]).all():
                     out[b] = n
                     rem[n] -= demands[b]
                     used[n] = True
@@ -215,7 +224,7 @@ def pack_bundles_np(demands: np.ndarray, avail: np.ndarray, cap: np.ndarray,
             placed = False
             for prefer_fresh in (True, False):
                 for n in order:
-                    if not alive[n] or (used[n] and prefer_fresh):
+                    if not ok[b, n] or (used[n] and prefer_fresh):
                         continue
                     if (rem[n] >= demands[b]).all():
                         out[b] = n
@@ -233,7 +242,7 @@ def pack_bundles_np(demands: np.ndarray, avail: np.ndarray, cap: np.ndarray,
     for b in bundle_order:
         placed = False
         for n in order:
-            if alive[n] and (rem[n] >= demands[b]).all():
+            if ok[b, n] and (rem[n] >= demands[b]).all():
                 out[b] = n
                 rem[n] -= demands[b]
                 placed = True

@@ -22,18 +22,25 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.scheduler.base import PendingTask, SchedulerBase
-from ray_tpu._private.task_spec import resources_to_vector
+from ray_tpu._private.task_spec import custom_resources, resources_to_vector
 
 
 class NodeState:
     __slots__ = ("capacity", "available", "node_id", "pg_id", "bundle_index",
-                 "parent", "defunct")
+                 "parent", "defunct", "custom", "custom_avail")
 
     def __init__(self, capacity: Tuple[float, ...], node_id=None,
-                 pg_id=None, bundle_index: int = -1, parent: int = -1):
+                 pg_id=None, bundle_index: int = -1, parent: int = -1,
+                 custom_resources: Optional[Dict[str, float]] = None):
         self.capacity = list(capacity)
         self.available = list(capacity)
         self.node_id = node_id
+        # declared NAMED resources: per-name placement feasibility rides
+        # the eligibility masks; per-name QUANTITY is debited host-side
+        # at allocate/release (the batched kernel sees the aggregate
+        # CUSTOM dimension; the per-name check is re-validated at apply)
+        self.custom: Dict[str, float] = dict(custom_resources or {})
+        self.custom_avail: Dict[str, float] = dict(self.custom)
         # bundle rows: a committed placement-group bundle is a virtual
         # node whose capacity was carved out of ``parent``'s availability
         # (reference: PG bundles become per-bundle resources,
@@ -48,6 +55,25 @@ class NodeState:
     @property
     def is_bundle(self) -> bool:
         return self.pg_id is not None
+
+    def has_custom(self, custom: Dict[str, float]) -> bool:
+        """Per-name feasibility: every named demand must be declared on
+        the node at sufficient capacity."""
+        return all(self.custom.get(k, 0.0) >= v for k, v in custom.items())
+
+    def fits_custom(self, custom: Dict[str, float]) -> bool:
+        """Per-name availability (has_custom checks declared capacity)."""
+        return all(self.custom_avail.get(k, 0.0) >= v - 1e-9
+                   for k, v in custom.items())
+
+    def allocate_custom(self, custom: Dict[str, float]) -> None:
+        for k, v in custom.items():
+            self.custom_avail[k] = self.custom_avail.get(k, 0.0) - v
+
+    def release_custom(self, custom: Dict[str, float]) -> None:
+        for k, v in custom.items():
+            self.custom_avail[k] = min(
+                self.custom_avail.get(k, 0.0) + v, self.custom.get(k, 0.0))
 
     def fits(self, demand: Tuple[float, ...]) -> bool:
         return all(a >= d for a, d in zip(self.available, demand))
@@ -136,14 +162,17 @@ class EventScheduler(SchedulerBase):
             if 0 <= node_index < len(self._nodes):
                 node = self._nodes[node_index]
                 vec = resources_to_vector(resources)
+                custom = custom_resources(resources)
                 if node.defunct:
                     # removed bundle: this task's share of the carved-out
                     # capacity returns to the parent now that it is free
                     self._nodes[node.parent].release(vec)
+                    self._nodes[node.parent].release_custom(custom)
                     node.capacity = [max(c - v, 0.0)
                                      for c, v in zip(node.capacity, vec)]
                 else:
                     node.release(vec)
+                    node.release_custom(custom)
             to_dispatch = self._drain_ready_locked()
         self._run_dispatch(to_dispatch)
 
@@ -173,7 +202,8 @@ class EventScheduler(SchedulerBase):
                 "nodes": [
                     {"available": list(n.available),
                      "capacity": list(n.capacity),
-                     "is_bundle": n.is_bundle}
+                     "is_bundle": n.is_bundle,
+                     "custom": dict(n.custom)}
                     for n in self._nodes
                 ],
             }
@@ -243,11 +273,13 @@ class EventScheduler(SchedulerBase):
         """Directly charge a row if it fits (actor restart-elsewhere:
         the replacement node must account for the actor's resources)."""
         vec = resources_to_vector(resources)
+        custom = custom_resources(resources)
         with self._lock:
             if not (0 <= index < len(self._nodes)):
                 return False
             n = self._nodes[index]
-            if n.fits(vec) and any(c > 0 for c in n.capacity):
+            if n.fits(vec) and any(c > 0 for c in n.capacity) \
+                    and n.has_custom(custom):
                 n.allocate(vec)
                 return True
             return False
@@ -277,6 +309,9 @@ class EventScheduler(SchedulerBase):
                 self._nodes[node_index].capacity)
             self._nodes[node_index].available = [0.0] * len(
                 self._nodes[node_index].available)
+            # a dead node's named resources leave the cluster with it
+            self._nodes[node_index].custom = {}
+            self._nodes[node_index].custom_avail = {}
 
     # -- placement groups ---------------------------------------------------
     def pack_snapshot(self):
@@ -294,16 +329,17 @@ class EventScheduler(SchedulerBase):
 
     def add_bundle_nodes(self, pg_id, placements) -> Optional[List[int]]:
         """Atomically reserve bundles: placements = [(parent_row,
-        demand_vec), ...] in bundle order. All-or-nothing: validates every
-        reservation against current availability first (2-phase commit of
-        the reference's PrepareBundleResources/CommitBundleResources,
+        demand_vec, custom_dict), ...] in bundle order. All-or-nothing:
+        validates every reservation against current availability first
+        (2-phase commit of the reference's PrepareBundleResources/
+        CommitBundleResources,
         ray: src/ray/raylet/placement_group_resource_manager.cc). Returns
         the new bundle row indices, or None if any reservation no longer
         fits (caller repacks against a fresh snapshot)."""
         to_dispatch: List[PendingTask] = []
         with self._lock:
             need: Dict[int, List[float]] = {}
-            for parent, vec in placements:
+            for parent, vec, _custom in placements:
                 acc = need.setdefault(parent, [0.0] * len(vec))
                 for i, v in enumerate(vec):
                     acc[i] += v
@@ -311,11 +347,13 @@ class EventScheduler(SchedulerBase):
                 if not self._nodes[parent].fits(tuple(total)):
                     return None
             rows = []
-            for bindex, (parent, vec) in enumerate(placements):
+            for bindex, (parent, vec, custom) in enumerate(placements):
                 self._nodes[parent].allocate(tuple(vec))
+                self._nodes[parent].allocate_custom(custom)
                 self._nodes.append(NodeState(
                     tuple(vec), node_id=self._nodes[parent].node_id,
-                    pg_id=pg_id, bundle_index=bindex, parent=parent))
+                    pg_id=pg_id, bundle_index=bindex, parent=parent,
+                    custom_resources=custom))
                 rows.append(len(self._nodes) - 1)
             # bundle rows make parked PG tasks feasible
             if self._infeasible:
@@ -376,6 +414,9 @@ class EventScheduler(SchedulerBase):
                         and any(c > 0 for c in n.capacity):
                     parent = self._nodes[n.parent]
                     parent.release(tuple(n.available))
+                    # unused named resources return now; the in-use part
+                    # follows task-by-task via the defunct completion path
+                    parent.release_custom(n.custom_avail)
                     in_use = [c - a for c, a in zip(n.capacity, n.available)]
                     n.capacity = in_use
                     n.available = [0.0] * len(n.available)
@@ -392,40 +433,47 @@ class EventScheduler(SchedulerBase):
             if task.cancelled:
                 continue
             demand = task.spec.resource_vector()
+            custom = custom_resources(task.spec.resources)
             # resolve soft affinity ONCE: the fallback placement must be
             # used for the infeasibility check too, or a soft-aff task
             # whose fallback nodes are momentarily full parks forever
             placement = self._effective_placement_locked(
-                task.spec.placement())
-            idx = self._pick_node(demand, threshold, placement)
+                task.spec.placement(), custom)
+            idx = self._pick_node(demand, threshold, placement, custom)
             if idx is None:
-                if not any(self._eligible(i, placement) and n.feasible(demand)
+                if not any(self._eligible(i, placement, custom)
+                           and n.feasible(demand)
                            for i, n in enumerate(self._nodes)):
                     self._infeasible.append(task)
                 else:
                     deferred.append(task)
                 continue
             self._nodes[idx].allocate(demand)
+            self._nodes[idx].allocate_custom(custom)
             task.node_index = idx
             self._num_dispatched += 1
             out.append(task)
         self._ready.extend(deferred)
         return out
 
-    def _effective_placement_locked(self, placement: Tuple) -> Tuple:
+    def _effective_placement_locked(self, placement: Tuple,
+                                    custom: Dict[str, float]) -> Tuple:
         """Soft node affinity whose target is missing/dead resolves to the
         default placement (mirrors TensorScheduler._mask_row)."""
         if placement[0] == "aff" and len(placement) > 2 and placement[2]:
             target_alive = any(
-                self._eligible(i, placement)
+                self._eligible(i, placement, custom)
                 and any(c > 0 for c in n.capacity)
                 for i, n in enumerate(self._nodes))
             if not target_alive:
                 return ("default",)
         return placement
 
-    def _eligible(self, idx: int, placement: Tuple) -> bool:
+    def _eligible(self, idx: int, placement: Tuple,
+                  custom: Dict[str, float] = {}) -> bool:
         node = self._nodes[idx]
+        if custom and not node.has_custom(custom):
+            return False
         kind = placement[0]
         if kind == "pg":
             _, pid, bindex = placement
@@ -442,16 +490,17 @@ class EventScheduler(SchedulerBase):
         return not node.is_bundle   # default / spread
 
     def _pick_node(self, demand: Tuple[float, ...], threshold: float,
-                   placement: Tuple = ("default",)) -> Optional[int]:
+                   placement: Tuple = ("default",),
+                   custom: Dict[str, float] = {}) -> Optional[int]:
         kind = placement[0]
         if kind == "aff":
             best, best_load = None, float("inf")
             target_alive = False
             for i, n in enumerate(self._nodes):
-                if self._eligible(i, placement):
+                if self._eligible(i, placement, custom):
                     if any(c > 0 for c in n.capacity):
                         target_alive = True
-                    if n.fits(demand):
+                    if n.fits(demand) and n.fits_custom(custom):
                         ld = n.load()
                         if ld < best_load:
                             best, best_load = i, ld
@@ -470,13 +519,15 @@ class EventScheduler(SchedulerBase):
         # least-loaded eligible node that fits. SPREAD and PG classes skip
         # the local bias (PG rows exclude node 0 anyway).
         if kind == "default" and self._nodes \
-                and self._eligible(0, placement) \
+                and self._eligible(0, placement, custom) \
                 and self._nodes[0].fits(demand) \
+                and self._nodes[0].fits_custom(custom) \
                 and self._nodes[0].load() < threshold:
             return 0
         best, best_load = None, float("inf")
         for i, n in enumerate(self._nodes):
-            if self._eligible(i, placement) and n.fits(demand):
+            if self._eligible(i, placement, custom) and n.fits(demand) \
+                    and n.fits_custom(custom):
                 ld = n.load()
                 if ld < best_load:
                     best, best_load = i, ld

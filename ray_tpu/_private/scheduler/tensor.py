@@ -43,7 +43,7 @@ from ray_tpu._private.scheduler import kernels
 from ray_tpu._private.scheduler.base import PendingTask, SchedulerBase
 from ray_tpu._private.scheduler.kernels import DONE, FREE, RUNNING, WAITING
 from ray_tpu._private.scheduler.local import NodeState
-from ray_tpu._private.task_spec import resources_to_vector
+from ray_tpu._private.task_spec import custom_resources, resources_to_vector
 
 
 class TensorScheduler(SchedulerBase):
@@ -84,6 +84,9 @@ class TensorScheduler(SchedulerBase):
         # SPREAD, node affinity). Rebuilt lazily when classes or the node
         # set change; the kernels consume them as [K,N] / [K] arrays.
         self._class_place: List[Tuple] = []
+        # named custom demands per class (per-name feasibility lives in
+        # the eligibility masks; the demand MATRIX keeps a fixed width)
+        self._class_custom: List[Dict[str, float]] = []
         self._class_mask = np.zeros((0, 0), dtype=bool)
         self._class_spread = np.zeros(0, dtype=bool)
         self._mask_dirty = False
@@ -175,7 +178,8 @@ class TensorScheduler(SchedulerBase):
                 "nodes": [
                     {"available": self._avail[i].tolist(),
                      "capacity": self._cap[i].tolist(),
-                     "is_bundle": self._node_states[i].is_bundle}
+                     "is_bundle": self._node_states[i].is_bundle,
+                     "custom": dict(self._node_states[i].custom)}
                     for i in range(len(self._node_states))
                 ],
             }
@@ -265,10 +269,14 @@ class TensorScheduler(SchedulerBase):
                 return False
             vec = np.asarray(resources_to_vector(resources),
                              dtype=np.float32)[:self._cap.shape[1]]
+            custom = custom_resources(resources)
+            ns = self._node_states[index]
             if self._cap[index].any() \
-                    and (self._avail[index] >= vec - 1e-6).all():
+                    and (self._avail[index] >= vec - 1e-6).all() \
+                    and ns.has_custom(custom) and ns.fits_custom(custom):
                 self._avail[index] -= vec
-                self._node_states[index].allocate(tuple(vec.tolist()))
+                ns.allocate(tuple(vec.tolist()))
+                ns.allocate_custom(custom)
                 return True
             return False
 
@@ -290,6 +298,9 @@ class TensorScheduler(SchedulerBase):
             self._avail[node_index] = 0.0
             self._node_states[node_index].capacity = [0.0] * self._cap.shape[1]
             self._node_states[node_index].available = [0.0] * self._cap.shape[1]
+            # a dead node's named resources leave the cluster with it
+            self._node_states[node_index].custom = {}
+            self._node_states[node_index].custom_avail = {}
             # soft-affinity classes pinned to this node must re-resolve
             # (dead target -> fall back to the default node set)
             self._mask_dirty = True
@@ -321,29 +332,32 @@ class TensorScheduler(SchedulerBase):
 
     def add_bundle_nodes(self, pg_id, placements) -> Optional[List[int]]:
         """Atomically reserve bundles: placements = [(parent_row,
-        demand_vec), ...] in bundle order; all-or-nothing (the 2-phase
-        prepare/commit of the reference's GcsPlacementGroupScheduler,
+        demand_vec, custom_dict), ...] in bundle order; all-or-nothing
+        (the 2-phase prepare/commit of the reference's
+        GcsPlacementGroupScheduler,
         ray: src/ray/raylet/placement_group_resource_manager.cc). Returns
         new bundle rows or None if availability moved since the pack."""
         with self._wake:
             n_res = self._cap.shape[1]
             need: Dict[int, np.ndarray] = {}
-            for parent, vec in placements:
+            for parent, vec, _custom in placements:
                 acc = need.setdefault(parent, np.zeros(n_res, np.float32))
                 acc[:len(vec)] += np.asarray(vec, dtype=np.float32)[:n_res]
             for parent, total in need.items():
                 if not (self._avail[parent] >= total - 1e-6).all():
                     return None
             rows = []
-            for bindex, (parent, vec) in enumerate(placements):
+            for bindex, (parent, vec, custom) in enumerate(placements):
                 v = np.zeros(n_res, np.float32)
                 v[:len(vec)] = np.asarray(vec, dtype=np.float32)[:n_res]
                 self._avail[parent] -= v
                 self._node_states[parent].allocate(tuple(v.tolist()))
+                self._node_states[parent].allocate_custom(custom)
                 row = self._append_node(NodeState(
                     tuple(v.tolist()),
                     node_id=self._node_states[parent].node_id,
-                    pg_id=pg_id, bundle_index=bindex, parent=parent))
+                    pg_id=pg_id, bundle_index=bindex, parent=parent,
+                    custom_resources=custom))
                 rows.append(row)
             self._dirty = True
             self._wake.notify()
@@ -389,6 +403,10 @@ class TensorScheduler(SchedulerBase):
                     self._avail[parent] = np.minimum(
                         self._avail[parent] + free, self._cap[parent])
                     self._node_states[parent].release(tuple(free.tolist()))
+                    # the UNUSED part of the bundle's named resources
+                    # returns now; the in-use part follows task-by-task
+                    # through the defunct completion path
+                    self._node_states[parent].release_custom(ns.custom_avail)
                     in_use = self._cap[i] - free
                     self._cap[i] = in_use
                     self._avail[i] = 0.0
@@ -463,8 +481,10 @@ class TensorScheduler(SchedulerBase):
                 d[0, :w] = vec[:w]
                 self._demands = np.concatenate([self._demands, d], axis=0)
                 place = spec.placement()
+                custom = custom_resources(spec.resources)
                 self._class_place.append(place)
-                self._append_class_mask_locked(place)
+                self._class_custom.append(custom)
+                self._append_class_mask_locked(place, custom)
             self._cls[slot] = cidx
             pending_deps = []
             for dep in task.deps:
@@ -494,6 +514,7 @@ class TensorScheduler(SchedulerBase):
             if 0 <= node_index < len(self._node_states):
                 vec = np.asarray(resources_to_vector(resources),
                                  dtype=np.float32)[:self._cap.shape[1]]
+                custom = custom_resources(resources)
                 ns = self._node_states[node_index]
                 if ns.defunct:
                     # removed bundle: this task's share of the carved-out
@@ -502,6 +523,7 @@ class TensorScheduler(SchedulerBase):
                     self._avail[parent] = np.minimum(
                         self._avail[parent] + vec, self._cap[parent])
                     self._node_states[parent].release(tuple(vec))
+                    self._node_states[parent].release_custom(custom)
                     self._cap[node_index] = np.maximum(
                         self._cap[node_index] - vec, 0.0)
                     ns.capacity = self._cap[node_index].tolist()
@@ -509,6 +531,7 @@ class TensorScheduler(SchedulerBase):
                     self._avail[node_index] = np.minimum(
                         self._avail[node_index] + vec, self._cap[node_index])
                     ns.release(tuple(vec))
+                    ns.release_custom(custom)
 
         # snapshot for the out-of-lock assignment pass
         ready_idx = np.flatnonzero((self._state == WAITING) & (self._indeg <= 0))
@@ -520,13 +543,28 @@ class TensorScheduler(SchedulerBase):
                 self._avail.copy(), self._cap.copy(),
                 self._class_mask.copy(), self._class_spread.copy())
 
-    def _mask_row(self, place: Tuple) -> Tuple[np.ndarray, bool]:
+    def _mask_row(self, place: Tuple,
+                  custom: Dict[str, float] = {}) -> Tuple[np.ndarray, bool]:
         """(eligibility row [N], spread flag) for one placement descriptor
-        (see TaskSpec.placement) against the current node set."""
+        (see TaskSpec.placement) + named custom demands against the
+        current node set."""
         nodes = self._node_states
         N = len(nodes)
         non_bundle = np.asarray([not ns.is_bundle for ns in nodes],
                                 dtype=bool) if N else np.zeros(0, bool)
+        if custom:
+            # per-NAME feasibility (quantity accounting rides the shared
+            # CUSTOM capacity dimension)
+            custom_ok = np.asarray([ns.has_custom(custom) for ns in nodes],
+                                   dtype=bool) if N else np.zeros(0, bool)
+        else:
+            custom_ok = None
+
+        def finish(row: np.ndarray, spread: bool):
+            if custom_ok is not None:
+                row = row & custom_ok
+            return row, spread
+
         row = np.zeros(N, dtype=bool)
         kind = place[0]
         if kind == "pg":
@@ -536,7 +574,7 @@ class TensorScheduler(SchedulerBase):
                         and ns.pg_id.binary() == pid \
                         and (bindex < 0 or ns.bundle_index == bindex):
                     row[i] = True
-            return row, False
+            return finish(row, False)
         if kind == "aff":
             nid, soft = place[1], place[2]
             found_alive = False
@@ -552,15 +590,16 @@ class TensorScheduler(SchedulerBase):
             # DEAD (a live-but-busy node means: wait for it)
             if soft and not found_alive:
                 row = non_bundle.copy()
-            return row, False
-        return non_bundle.copy(), kind == "spread"
+            return finish(row, False)
+        return finish(non_bundle.copy(), kind == "spread")
 
-    def _append_class_mask_locked(self, place: Tuple) -> None:
+    def _append_class_mask_locked(self, place: Tuple,
+                                  custom: Dict[str, float] = {}) -> None:
         """Append one class row without a full K*N rebuild (classes are
         minted far more often than the node set changes)."""
         if self._mask_dirty:
             return  # a full rebuild is due anyway
-        row, spread = self._mask_row(place)
+        row, spread = self._mask_row(place, custom)
         if self._class_mask.shape[0] == 0:
             self._class_mask = row[None, :]
         else:
@@ -575,7 +614,8 @@ class TensorScheduler(SchedulerBase):
         mask = np.zeros((K, N), dtype=bool)
         spread = np.zeros(K, dtype=bool)
         for k, place in enumerate(self._class_place):
-            mask[k], spread[k] = self._mask_row(place)
+            mask[k], spread[k] = self._mask_row(place,
+                                                self._class_custom[k])
         self._class_mask = mask
         self._class_spread = spread
         self._mask_dirty = False
@@ -681,11 +721,22 @@ class TensorScheduler(SchedulerBase):
             if task is None or task.cancelled:
                 self._release_slot(slot)
                 continue
+            # per-NAME custom quantities are finer than the kernel's
+            # aggregate CUSTOM dimension: re-validate + debit here (the
+            # task waits a tick if its specific name is exhausted even
+            # though the aggregate still fits)
+            custom = self._class_custom[self._cls[slot]]
+            ns = self._node_states[node]
+            if custom and not ns.fits_custom(custom):
+                # name exhausted though the aggregate fits: stay WAITING;
+                # the completion that frees the name re-ticks the loop
+                continue
             self._state[slot] = RUNNING
             self._node_of[slot] = node
             self._avail[node] -= demand
             task.node_index = node
-            self._node_states[node].allocate(tuple(demand.tolist()))
+            ns.allocate(tuple(demand.tolist()))
+            ns.allocate_custom(custom)
             self._num_dispatched += 1
             out.append(task)
         return out

@@ -23,12 +23,18 @@ class TaskType(enum.Enum):
 
 
 # Resource vector layout used by the tensorized scheduler. Keep in sync with
-# config sched_num_resources.
+# config sched_num_resources. Named custom resources keep their quantity
+# accounting in the shared CUSTOM dimension (aggregate per node) while
+# per-NAME feasibility rides the class->node eligibility masks — the
+# batched-kernel shape stays fixed no matter how many names exist
+# (reference semantics: custom resources constrain placement,
+# ray: src/ray/common/scheduling/resource_set.h).
 RESOURCE_CPU = 0
 RESOURCE_TPU = 1
 RESOURCE_MEM = 2
 RESOURCE_CUSTOM = 3
 RESOURCE_NAMES = ("CPU", "TPU", "memory", "custom")
+BUILTIN_RESOURCES = ("CPU", "TPU", "GPU", "memory")
 
 
 def resources_to_vector(resources: Dict[str, float]) -> Tuple[float, ...]:
@@ -43,6 +49,13 @@ def resources_to_vector(resources: Dict[str, float]) -> Tuple[float, ...]:
         else:
             vec[RESOURCE_CUSTOM] += v
     return tuple(vec)
+
+
+def custom_resources(resources: Dict[str, float]) -> Dict[str, float]:
+    """The named (non-builtin) demands: feasibility is per-name against
+    each node's declared customs."""
+    return {k: v for k, v in resources.items()
+            if k not in BUILTIN_RESOURCES and v > 0}
 
 
 @dataclasses.dataclass

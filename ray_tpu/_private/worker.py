@@ -150,7 +150,8 @@ class Worker:
     def __init__(self, *, num_cpus: Optional[float] = None,
                  num_workers: Optional[int] = None,
                  scheduler_factory: Optional[Callable] = None,
-                 job_id: Optional[JobID] = None):
+                 job_id: Optional[JobID] = None,
+                 resources: Optional[Dict[str, float]] = None):
         self.job_id = job_id or JobID.from_random()
         self.worker_id = WorkerID.from_random()
         self.alive = True
@@ -180,10 +181,16 @@ class Worker:
             self.process_pool = ProcessWorkerPool(self, nworkers,
                                                   self.shm_store)
 
-        # node 0 = "this node"; virtual cluster tests add more
+        # node 0 = "this node"; virtual cluster tests add more. Named
+        # custom resources must be DECLARED (init(resources={...})) to be
+        # schedulable here — an undeclared name parks tasks as infeasible
+        # until a node providing it joins (reference semantics).
         self.node_id = NodeID.from_random()
-        node = NodeState((capacity_cpu, _detect_tpu_count(), 1e18, 1e18),
-                         node_id=self.node_id)
+        head_custom = dict(resources or {})
+        node = NodeState((capacity_cpu, _detect_tpu_count(), 1e18,
+                          sum(head_custom.values())),
+                         node_id=self.node_id,
+                         custom_resources=head_custom)
         contains = self.memory_store.contains
         if scheduler_factory is not None:
             self.scheduler: SchedulerBase = scheduler_factory(
@@ -196,7 +203,8 @@ class Worker:
         self.gcs = GcsService(self)
         self.gcs.register_node(
             self.node_id, 0,
-            {"CPU": capacity_cpu, "TPU": _detect_tpu_count()},
+            {"CPU": capacity_cpu, "TPU": _detect_tpu_count(),
+             **head_custom},
             kind="process" if self.process_pool is not None else "local",
             pool=self.process_pool)
         self.gcs.register_job(self.job_id)
@@ -205,6 +213,9 @@ class Worker:
         self._node_pools: Dict[int, Any] = {}
         if self.process_pool is not None:
             self._node_pools[0] = self.process_pool
+        # TCP registration endpoint for remote node daemons / clients
+        # (created lazily with the first remote node)
+        self._head_server = None
 
         # placement groups (bundle reservation over the scheduler)
         from ray_tpu._private.placement_groups import PlacementGroupManager
@@ -302,8 +313,11 @@ class Worker:
 
     def _entry_value(self, object_id: ObjectID, entry) -> Any:
         """Resolve a memory-store entry, deserializing shm-resident bytes
-        zero-copy on first access (plasma client get analog)."""
-        from ray_tpu._private.runtime.process_pool import ShmPlaceholder
+        zero-copy on first access (plasma client get analog); objects
+        resident in a REMOTE node's arena fetch over the node link on
+        first head-side access (PullManager analog)."""
+        from ray_tpu._private.runtime.process_pool import (RemotePlaceholder,
+                                                           ShmPlaceholder)
         value = entry.value
         if isinstance(value, ShmPlaceholder):
             from ray_tpu._private.serialization import deserialize
@@ -312,7 +326,24 @@ class Worker:
                 raise rex.ObjectLostError(object_id.hex())
             value = deserialize(sobj)
             entry.value = value  # memoize the zero-copy view object
+        elif isinstance(value, RemotePlaceholder):
+            from ray_tpu._private.serialization import (SerializedObject,
+                                                        deserialize)
+            data = self.fetch_object_bytes(object_id, value.node_index)
+            if data is None:
+                raise rex.ObjectLostError(object_id.hex())
+            value = deserialize(SerializedObject.from_bytes(data))
+            entry.value = value  # memoize: later reads are local
         return value
+
+    def fetch_object_bytes(self, object_id: ObjectID,
+                           node_index: int) -> Optional[bytes]:
+        """Framed bytes of an object primary-resident on a remote node
+        (None if the node or object is gone)."""
+        pool = self._node_pools.get(node_index)
+        if pool is None or not getattr(pool, "is_remote", False):
+            return None
+        return pool.fetch_object(object_id)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         ids = [r.object_id() for r in refs]
@@ -332,8 +363,7 @@ class Worker:
                 if isinstance(exc, rex.TaskError):
                     raise exc.as_instanceof_cause()
                 raise exc
-            out.append(self._entry_value(oid, entry)
-                       if self.shm_store is not None else entry.value)
+            out.append(self._entry_value(oid, entry))
         return out
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
@@ -461,8 +491,8 @@ class Worker:
             self.shm_store = ShmObjectStore(GLOBAL_CONFIG.object_store_memory)
         custom = sum((resources or {}).values())
         node_id = NodeID.from_random()
-        state = NodeState((num_cpus, num_tpus, 1e18, custom or 1e18),
-                          node_id=node_id)
+        state = NodeState((num_cpus, num_tpus, 1e18, custom),
+                          node_id=node_id, custom_resources=resources)
         row = self.scheduler.add_node(state)
         pool = ProcessWorkerPool(self, num_workers or max(int(num_cpus), 1),
                                  self.shm_store, node_index=row)
@@ -471,6 +501,59 @@ class Worker:
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus,
                            **(resources or {})},
             kind="process", pool=pool)
+        self.gcs.start_health_checks()
+        return entry
+
+    def add_remote_cluster_node(self, num_cpus: float = 4.0,
+                                num_tpus: float = 0.0,
+                                num_workers: Optional[int] = None,
+                                resources: Optional[Dict[str, float]] = None):
+        """Add a node backed by a NODE DAEMON process with its OWN shm
+        arena, connected over TCP (localhost stands in for the DCN) —
+        the real multi-host topology, unlike add_cluster_node's
+        same-process pools sharing the head arena. Reference: one
+        raylet+plasma per node, registered with the GCS over the
+        network."""
+        import subprocess
+        import sys
+
+        from ray_tpu._private.runtime.remote_pool import (HeadServer,
+                                                          RemoteNodePool)
+
+        if self._head_server is None:
+            self._head_server = HeadServer()
+        token = self._head_server.issue_token()
+        slot_ev, slot = self._head_server.expect(token)
+        env = dict(os.environ)
+        env["RAY_TPU_HEAD_AUTHKEY"] = self._head_server.authkey.hex()
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in sys.path if p) + os.pathsep + env.get("PYTHONPATH", "")
+        host, port = self._head_server.address
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.runtime.node_daemon",
+             host, str(port), token,
+             str(GLOBAL_CONFIG.object_store_memory),
+             str(GLOBAL_CONFIG.inline_object_max_bytes)],
+            env=env, close_fds=True)
+        if not slot_ev.wait(timeout=30.0) or not slot:
+            proc.kill()
+            raise RuntimeError("node daemon failed to register with the "
+                               "head within 30s")
+        conn, hello = slot[0], slot[1]
+        arena_name = hello[3] if len(hello) > 3 else None
+        custom = sum((resources or {}).values())
+        node_id = NodeID.from_random()
+        state = NodeState((num_cpus, num_tpus, 1e18, custom),
+                          node_id=node_id, custom_resources=resources)
+        row = self.scheduler.add_node(state)
+        pool = RemoteNodePool(self, num_workers or max(int(num_cpus), 1),
+                              row, conn, node_id, daemon_proc=proc,
+                              arena_name=arena_name)
+        self._node_pools[row] = pool
+        entry = self.gcs.register_node(
+            node_id, row, {"CPU": num_cpus, "TPU": num_tpus,
+                           **(resources or {})},
+            kind="remote", pool=pool)
         self.gcs.start_health_checks()
         return entry
 
@@ -490,6 +573,18 @@ class Worker:
         # 1) no new assignments to the node (also invalidates in-flight
         #    snapshot decisions at apply time)
         self.scheduler.remove_node(entry.index)
+        # 1b) objects primary-resident in the dead node's arena are LOST
+        #     unless already fetched/memoized head-side; drop them so a
+        #     later get() reconstructs from lineage
+        from ray_tpu._private.runtime.process_pool import RemotePlaceholder
+        for oid in self.gcs.objects_on_node(entry.index):
+            self.gcs.object_location_pop(oid)
+            e = self.memory_store.get_entry(oid)
+            if e is not None and not e.is_exception \
+                    and isinstance(e.value, RemotePlaceholder) \
+                    and e.value.node_index == entry.index:
+                self.object_recovery.note_freed(oid)
+                self.memory_store.delete([oid])
         # 2) placement groups with bundles on the node reschedule
         self.placement_groups.on_node_dead(entry.index)
         # 3) fail queued + running work retriably; kill worker processes.
@@ -609,8 +704,7 @@ class Worker:
                 if entry.is_exception:
                     dep_error = entry.value
                     return None
-                return (self._entry_value(oid, entry)
-                        if self.shm_store is not None else entry.value)
+                return self._entry_value(oid, entry)
             return v
 
         args = tuple(resolve(a) for a in spec.args)
@@ -705,11 +799,21 @@ class Worker:
             self.memory_store.delete([oid])
             if self.shm_store is not None:
                 self.shm_store.free_object(oid)
+            self._free_remote_copy(oid)
+
+    def _free_remote_copy(self, object_id: ObjectID) -> None:
+        node = self.gcs.object_location_pop(object_id)
+        if node is None:
+            return
+        pool = self._node_pools.get(node)
+        if pool is not None and getattr(pool, "is_remote", False):
+            pool.free_remote([object_id])
 
     def _on_object_out_of_scope(self, object_id: ObjectID) -> None:
         self.memory_store.delete([object_id])
         if self.shm_store is not None:
             self.shm_store.free_object(object_id)
+        self._free_remote_copy(object_id)
         self.task_manager.evict_lineage(object_id.task_id())
 
     def shutdown(self) -> None:
@@ -733,6 +837,8 @@ class Worker:
         if self.process_pool is not None:
             self.process_pool.shutdown()
         self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._head_server is not None:
+            self._head_server.close()
         if self.shm_store is not None:
             self.shm_store.shutdown()
 
@@ -816,6 +922,7 @@ def _async_raise_in_task(task_id: TaskID) -> None:
 
 def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
          scheduler: Optional[str] = None, ignore_reinit_error: bool = False,
+         resources: Optional[Dict[str, float]] = None,
          _system_config: Optional[dict] = None, **kwargs) -> "Worker":
     global global_worker
     with _init_lock:
@@ -842,7 +949,8 @@ def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
             raise ValueError(f"unknown scheduler {impl!r}: tensor | event")
         GLOBAL_CONFIG.freeze()
         global_worker = Worker(num_cpus=num_cpus, num_workers=num_workers,
-                               scheduler_factory=scheduler_factory)
+                               scheduler_factory=scheduler_factory,
+                               resources=resources)
         return global_worker
 
 

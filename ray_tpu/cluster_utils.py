@@ -74,11 +74,21 @@ class Cluster:
 
     def add_node(self, num_cpus: float = 4.0, num_tpus: float = 0.0,
                  num_workers: Optional[int] = None,
-                 resources: Optional[Dict[str, float]] = None) -> ClusterNode:
+                 resources: Optional[Dict[str, float]] = None,
+                 remote: bool = False) -> ClusterNode:
+        """``remote=True`` backs the node with a NODE DAEMON process
+        owning its own shm arena, reached over TCP — the true multi-host
+        topology (localhost stands in for the DCN); the default shares
+        the head process's arena (virtual same-host node)."""
         w = worker_mod.get_worker()
-        entry = w.add_cluster_node(num_cpus=num_cpus, num_tpus=num_tpus,
-                                   num_workers=num_workers,
-                                   resources=resources)
+        if remote:
+            entry = w.add_remote_cluster_node(
+                num_cpus=num_cpus, num_tpus=num_tpus,
+                num_workers=num_workers, resources=resources)
+        else:
+            entry = w.add_cluster_node(num_cpus=num_cpus, num_tpus=num_tpus,
+                                       num_workers=num_workers,
+                                       resources=resources)
         node = ClusterNode(entry)
         self._nodes.append(node)
         return node

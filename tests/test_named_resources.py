@@ -1,0 +1,156 @@
+"""Per-name custom resource semantics.
+
+Reference: ray custom resources (src/ray/common/scheduling/
+resource_set.h; python: @ray.remote(resources={"name": n})): a named
+demand is only schedulable on nodes DECLARING that name with enough
+capacity; undeclared names park tasks as infeasible until a providing
+node joins. Here quantity accounting rides the shared CUSTOM capacity
+dimension while per-name feasibility rides the class->node eligibility
+masks (task_spec.py custom_resources, scheduler/*._mask_row /
+_eligible), keeping the batched kernel's shape fixed.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(params=["tensor", "event"])
+def sched(request):
+    ray_tpu.shutdown()
+    yield request.param
+    ray_tpu.shutdown()
+
+
+def test_undeclared_name_parks_until_node_joins(sched):
+    c = Cluster(initialize_head=True,
+                head_node_args=dict(num_cpus=2, scheduler=sched))
+    try:
+        @ray_tpu.remote(resources={"accel": 1.0})
+        def f():
+            return "ran"
+
+        ref = f.remote()
+        # head declares no "accel": the task must NOT run
+        ready, _ = ray_tpu.wait([ref], timeout=0.5)
+        assert not ready
+        c.add_node(num_cpus=2, resources={"accel": 2.0})
+        assert ray_tpu.get(ref, timeout=15.0) == "ran"
+    finally:
+        c.shutdown()
+
+
+def test_name_mismatch_is_not_schedulable(sched):
+    c = Cluster(initialize_head=True,
+                head_node_args=dict(num_cpus=2, scheduler=sched))
+    try:
+        c.add_node(num_cpus=2, resources={"foo": 4.0})
+
+        @ray_tpu.remote(resources={"bar": 1.0})
+        def f():
+            return 1
+
+        ready, _ = ray_tpu.wait([f.remote()], timeout=0.5)
+        assert not ready  # "foo" capacity must not satisfy "bar"
+    finally:
+        c.shutdown()
+
+
+def test_head_declared_resources(sched):
+    ray_tpu.init(num_cpus=2, scheduler=sched,
+                 resources={"accel": 1.0})
+    try:
+        @ray_tpu.remote(resources={"accel": 1.0})
+        def f():
+            return 42
+
+        assert ray_tpu.get(f.remote(), timeout=10.0) == 42
+        assert ray_tpu.cluster_resources().get("accel") == 1.0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_named_capacity_limits_concurrency(sched):
+    ray_tpu.init(num_cpus=8, num_workers=8, scheduler=sched,
+                 resources={"slot": 2.0})
+    try:
+        import threading
+        peak = [0]
+        cur = [0]
+        lock = threading.Lock()
+
+        @ray_tpu.remote(resources={"slot": 1.0})
+        def task():
+            with lock:
+                cur[0] += 1
+                peak[0] = max(peak[0], cur[0])
+            time.sleep(0.15)
+            with lock:
+                cur[0] -= 1
+            return 1
+
+        assert sum(ray_tpu.get([task.remote() for _ in range(6)],
+                               timeout=30.0)) == 6
+        assert peak[0] <= 2  # aggregate CUSTOM dim enforces quantity
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_two_names_do_not_oversubscribe(sched):
+    # a node declaring {"A":1, "B":1} has aggregate CUSTOM capacity 2,
+    # but two {"A":1} tasks must still serialize: per-name quantities
+    # are debited host-side at allocate/apply time
+    ray_tpu.init(num_cpus=8, num_workers=8, scheduler=sched,
+                 resources={"A": 1.0, "B": 1.0})
+    try:
+        import threading
+        peak, cur = [0], [0]
+        lock = threading.Lock()
+
+        @ray_tpu.remote(resources={"A": 1.0})
+        def task():
+            with lock:
+                cur[0] += 1
+                peak[0] = max(peak[0], cur[0])
+            time.sleep(0.15)
+            with lock:
+                cur[0] -= 1
+            return 1
+
+        assert sum(ray_tpu.get([task.remote() for _ in range(4)],
+                               timeout=30.0)) == 4
+        assert peak[0] == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_placement_group_respects_names():
+    ray_tpu.shutdown()
+    c = Cluster(initialize_head=True,
+                head_node_args=dict(num_cpus=2, scheduler="tensor"))
+    try:
+        c.add_node(num_cpus=2, resources={"accel": 1.0})
+        from ray_tpu.util.placement_group import placement_group
+
+        pg = placement_group([{"accel": 1.0}], strategy="PACK")
+        ray_tpu.get(pg.ready(), timeout=15.0)
+        w = worker_mod.get_worker()
+        table = w.placement_groups.table()
+        entry = table[pg.id.hex()]
+        assert entry["state"] == "CREATED"
+        # the bundle row's parent must be the accel node (row 1)
+        row = entry["bundle_rows"][0]
+        ns = w.scheduler.node_state(row)
+        assert ns.parent == 1
+
+        # a group demanding an undeclared name parks (feasible nowhere)
+        pg2 = placement_group([{"nvme": 1.0}], strategy="PACK")
+        from ray_tpu.exceptions import PlacementGroupUnschedulableError
+        with pytest.raises(PlacementGroupUnschedulableError):
+            ray_tpu.get(pg2.ready(), timeout=5.0)
+    finally:
+        c.shutdown()
