@@ -137,7 +137,10 @@ def method(num_returns: int = 1):
 def available_resources() -> dict:
     """Cluster-wide free resources over PHYSICAL nodes (placement-group
     bundle rows are reservations, not new capacity)."""
-    stats = _worker.get_worker().scheduler.stats()
+    w = _worker.get_worker()
+    if getattr(w, "is_client", False):
+        return w.state("available_resources")
+    stats = w.scheduler.stats()
     out: dict = {}
     from ray_tpu._private.task_spec import RESOURCE_NAMES
     for node in stats.get("nodes", []):
@@ -145,11 +148,18 @@ def available_resources() -> dict:
             continue
         for name, avail in zip(RESOURCE_NAMES, node["available"]):
             out[name] = out.get(name, 0.0) + avail
+        # per-name availability mirrors cluster_resources()' per-name
+        # capacities (the reference idiom diffs the two dicts by name)
+        for name, avail in node.get("custom_avail", {}).items():
+            out[name] = out.get(name, 0.0) + avail
     return out
 
 
 def cluster_resources() -> dict:
-    stats = _worker.get_worker().scheduler.stats()
+    w = _worker.get_worker()
+    if getattr(w, "is_client", False):
+        return w.state("cluster_resources")
+    stats = w.scheduler.stats()
     out: dict = {}
     from ray_tpu._private.task_spec import RESOURCE_NAMES
     for node in stats.get("nodes", []):
@@ -165,7 +175,10 @@ def cluster_resources() -> dict:
 
 
 def nodes() -> List[dict]:
-    stats = _worker.get_worker().scheduler.stats()
+    w = _worker.get_worker()
+    if getattr(w, "is_client", False):
+        return w.state("nodes")
+    stats = w.scheduler.stats()
     return [
         {"NodeID": i, "Alive": any(c > 0 for c in n["capacity"]),
          "Resources": dict(zip(("CPU", "TPU", "memory", "custom"),

@@ -45,6 +45,72 @@ def _cmd_status(args) -> int:
     return 0
 
 
+def _cmd_start(args) -> int:
+    """Run a head serving remote clients + joining nodes, or a node
+    daemon joining a head (reference: `ray start --head` /
+    `ray start --address=...`)."""
+    import json as _json
+    import os
+    import signal
+
+    if args.head:
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+
+        resources = _json.loads(args.resources) if args.resources else None
+        kw = dict(ignore_reinit_error=True, resources=resources)
+        if args.num_cpus:
+            kw["num_cpus"] = args.num_cpus
+        if args.num_workers:
+            kw["num_workers"] = args.num_workers
+        if args.worker_mode:
+            kw["_system_config"] = {"worker_mode": args.worker_mode}
+        ray_tpu.init(**kw)
+        w = worker_mod.get_worker()
+        hs = w.enable_head_endpoint(host=args.host, port=args.port)
+        host, port = hs.address
+        connect = f"ray://{host}:{port}?key={hs.authkey.hex()}"
+        print(f"ray_tpu head started.\n"
+              f"  connect a driver:  ray_tpu.init(address={connect!r})\n"
+              f"  join a node:       python -m ray_tpu start "
+              f"--address='{connect}'", flush=True)
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+        try:
+            while not stop:
+                signal.pause()
+        except KeyboardInterrupt:
+            pass
+        ray_tpu.shutdown()
+        return 0
+
+    if not args.address:
+        print("usage: start --head | start --address=ray://host:port?key=..",
+              file=sys.stderr)
+        return 2
+    from ray_tpu._private.client import parse_client_address
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.runtime.node_daemon import NodeDaemon
+
+    host, port, key = parse_client_address(args.address)
+    if key is None:
+        print("the address must include ?key=... (printed by the head)",
+              file=sys.stderr)
+        return 2
+    info = dict(num_cpus=args.num_cpus or 4.0,
+                num_workers=args.num_workers or 0,
+                resources=_json.loads(args.resources)
+                if args.resources else {})
+    daemon = NodeDaemon((host, port), key, "join",
+                        GLOBAL_CONFIG.object_store_memory,
+                        GLOBAL_CONFIG.inline_object_max_bytes,
+                        join_info=info)
+    print(f"ray_tpu node joined head at {host}:{port} "
+          f"(pid {os.getpid()})", flush=True)
+    daemon.run()
+    return 0
+
+
 def _cmd_microbenchmark(args) -> int:
     from ray_tpu._private import perf
 
@@ -107,6 +173,21 @@ def main(argv=None) -> int:
         prog="python -m ray_tpu",
         description="ray_tpu command line interface")
     sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head (serving clients and "
+                       "joining nodes) or join as a node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--address", default="",
+                   help="ray://host:port?key=... of a running head")
+    p.add_argument("--num-cpus", type=float, default=0)
+    p.add_argument("--num-workers", type=int, default=0)
+    p.add_argument("--resources", default="",
+                   help='JSON dict of named resources, e.g. \'{"a": 2}\'')
+    p.add_argument("--worker-mode", default="",
+                   choices=["", "thread", "process"])
+    p.set_defaults(fn=_cmd_start)
 
     p = sub.add_parser("status", help="show node/cluster resources")
     p.add_argument("--metrics-port", type=int, default=0,

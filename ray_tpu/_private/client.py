@@ -1,0 +1,474 @@
+"""Ray-client analog: a remote driver talking to a running head.
+
+Reference surfaces: ray's client mode (python/ray/util/client/ — a gRPC
+proxy where `ray.init(address="ray://host:port")` makes the local
+process a THIN CLIENT of a remote cluster: tasks/actors/objects live on
+the server; the client holds proxy refs) and the dataserver's
+per-session reference pinning.
+
+Transport: the same authenticated framed-tuple TCP connection the node
+daemons use (HeadServer, runtime/remote_pool.py). One connection per
+client session; requests are (op, req_id, payload) with req-id-matched
+replies so a blocking `get` does not serialize unrelated calls (each
+request runs on its own server thread).
+
+Ownership: every ObjectRef handed to a client is PINNED server-side
+under the client's session (a local reference held on the ref's
+behalf); the client counts its local refs and releases each id once its
+last local ref dies; a dropped connection releases the whole session.
+
+Surface: init/put/get/wait/remote tasks/actors (create, method calls,
+named lookup, kill)/cancel/cluster state verbs. Driver-side-only APIs
+(timeline, snapshot, placement group creation) raise in client mode.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as rex
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+
+# ----------------------------------------------------------------------
+# server side (runs in the head process)
+# ----------------------------------------------------------------------
+
+class ClientSession:
+    __slots__ = ("client_id", "conn", "send_lock", "pinned")
+
+    def __init__(self, client_id: str, conn):
+        self.client_id = client_id
+        self.conn = conn
+        self.send_lock = threading.Lock()
+        self.pinned: set = set()  # ObjectIDs held on the client's behalf
+
+
+class ClientServer:
+    """Serves client sessions registered through the HeadServer."""
+
+    def __init__(self, worker):
+        self._worker = worker
+        self._sessions: Dict[str, ClientSession] = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="ray_tpu_client_srv")
+
+    # -- session lifecycle --------------------------------------------
+    def attach(self, conn, hello: tuple) -> None:
+        """HeadServer on_unsolicited hook for ('hello', 'client', id)."""
+        client_id = hello[2] if len(hello) > 2 else uuid.uuid4().hex
+        session = ClientSession(client_id, conn)
+        with self._lock:
+            self._sessions[client_id] = session
+        threading.Thread(target=self._serve, args=(session,), daemon=True,
+                         name=f"ray_tpu_client_{client_id[:8]}").start()
+
+    def _serve(self, s: ClientSession) -> None:
+        try:
+            s.conn.send(("ready",))
+        except (OSError, ValueError):
+            return
+        while True:
+            try:
+                msg = s.conn.recv()
+            except (EOFError, OSError, TypeError, ValueError):
+                break
+            if not (isinstance(msg, tuple) and len(msg) == 3):
+                break
+            op, req_id, payload = msg
+            self._pool.submit(self._handle, s, op, req_id, payload)
+        self._drop(s)
+
+    def _drop(self, s: ClientSession) -> None:
+        with self._lock:
+            self._sessions.pop(s.client_id, None)
+        # the session's pins die with it
+        for oid in list(s.pinned):
+            try:
+                self._worker.reference_counter.remove_local_reference(oid)
+            except Exception:
+                pass
+        s.pinned.clear()
+        try:
+            s.conn.close()
+        except Exception:
+            pass
+
+    def _handle(self, s: ClientSession, op: str, req_id: int,
+                payload: tuple) -> None:
+        try:
+            result = getattr(self, f"_op_{op}")(s, *payload)
+            ok = True
+        except BaseException as e:  # noqa: BLE001
+            ok, result = False, cloudpickle.dumps(e)
+        try:
+            with s.send_lock:
+                s.conn.send((req_id, ok, result))
+        except (OSError, ValueError):
+            pass
+
+    def _pin(self, s: ClientSession, oid: ObjectID) -> None:
+        if oid not in s.pinned:
+            self._worker.reference_counter.add_local_reference(oid)
+            s.pinned.add(oid)
+
+    # -- ops -----------------------------------------------------------
+    def _op_put(self, s, blob: bytes) -> bytes:
+        ref = self._worker.put(cloudpickle.loads(blob))
+        self._pin(s, ref.object_id())
+        return ref.object_id().binary()
+
+    def _op_get(self, s, oid_bins: list, timeout) -> list:
+        refs = [ObjectRef(ObjectID(b), None, _register=False)
+                for b in oid_bins]
+        # worker.get already raises driver-semantics exceptions (incl.
+        # TaskError cause conversion); _handle ships them to the client
+        return [cloudpickle.dumps(v, protocol=5)
+                for v in self._worker.get(refs, timeout)]
+
+    def _op_wait(self, s, oid_bins: list, num_returns: int, timeout) -> list:
+        refs = [ObjectRef(ObjectID(b), None, _register=False)
+                for b in oid_bins]
+        ready, _ = self._worker.wait(refs, num_returns, timeout)
+        return [r.object_id().binary() for r in ready]
+
+    def _op_submit(self, s, blob: bytes) -> list:
+        from ray_tpu._private.task_spec import TaskSpec
+        d = cloudpickle.loads(blob)
+        func = cloudpickle.loads(d["func_blob"])
+        args, kwargs = cloudpickle.loads(d["args_blob"])
+        from ray_tpu._private.ids import PlacementGroupID
+        spec = TaskSpec(
+            task_id=self._worker.next_task_id(),
+            name=d["name"],
+            func=func,
+            func_descriptor=d["func_descriptor"],
+            args=args,
+            kwargs=kwargs,
+            num_returns=d["num_returns"],
+            resources=d["resources"],
+            max_retries=d["max_retries"],
+            retry_exceptions=d["retry_exceptions"],
+            scheduling_strategy=cloudpickle.loads(d["strategy_blob"])
+            if d.get("strategy_blob") else None,
+            placement_group_id=(PlacementGroupID(d["pg_id"])
+                                if d.get("pg_id") is not None else None),
+            placement_group_bundle_index=d.get("pg_bundle_index", -1),
+            placement_group_capture_child_tasks=d.get("pg_capture", False),
+            runtime_env=d.get("runtime_env"),
+            generator=d.get("generator", False),
+        )
+        refs = self._worker.submit_task(spec)
+        for r in refs:
+            self._pin(s, r.object_id())
+        return [r.object_id().binary() for r in refs]
+
+    def _op_cancel(self, s, oid_bin: bytes, force: bool) -> bool:
+        self._worker.cancel_task(
+            ObjectRef(ObjectID(oid_bin), None, _register=False), force)
+        return True
+
+    def _op_create_actor(self, s, cls_blob: bytes, opts_blob: bytes,
+                         args_blob: bytes) -> tuple:
+        from ray_tpu.actor import ActorClass
+        cls = cloudpickle.loads(cls_blob)
+        opts = cloudpickle.loads(opts_blob)
+        args, kwargs = cloudpickle.loads(args_blob)
+        handle = ActorClass(cls, opts).remote(*args, **kwargs)
+        return (handle.actor_id.binary(), cls.__name__)
+
+    def _op_actor_call(self, s, actor_bin: bytes, method: str,
+                       args_blob: bytes, num_returns: int) -> list:
+        from ray_tpu.actor import ActorHandle
+        handle = ActorHandle(ActorID(actor_bin))
+        args, kwargs = cloudpickle.loads(args_blob)
+        refs = handle._submit_method(method, args, kwargs, num_returns)
+        refs = refs if isinstance(refs, list) else [refs]
+        for r in refs:
+            self._pin(s, r.object_id())
+        return [r.object_id().binary() for r in refs]
+
+    def _op_get_actor(self, s, name: str, namespace: str) -> tuple:
+        from ray_tpu.actor import get_actor
+        handle = get_actor(name, namespace)
+        return (handle.actor_id.binary(), handle._class_name)
+
+    def _op_kill_actor(self, s, actor_bin: bytes, no_restart: bool) -> bool:
+        from ray_tpu.actor import ActorHandle, kill
+        kill(ActorHandle(ActorID(actor_bin)), no_restart=no_restart)
+        return True
+
+    def _op_release(self, s, oid_bins: list) -> bool:
+        for b in oid_bins:
+            oid = ObjectID(b)
+            if oid in s.pinned:
+                s.pinned.discard(oid)
+                self._worker.reference_counter.remove_local_reference(oid)
+        return True
+
+    def _op_state(self, s, verb: str) -> Any:
+        import ray_tpu
+        if verb == "cluster_resources":
+            return ray_tpu.cluster_resources()
+        if verb == "available_resources":
+            return ray_tpu.available_resources()
+        if verb == "nodes":
+            return ray_tpu.nodes()
+        raise ValueError(f"unknown state verb {verb!r}")
+
+    def _op_ping(self, s) -> str:
+        return "pong"
+
+    def shutdown(self) -> None:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        for s in sessions:
+            self._drop(s)
+        self._pool.shutdown(wait=False)
+
+
+# ----------------------------------------------------------------------
+# client side (the remote driver process)
+# ----------------------------------------------------------------------
+
+class _ClientRC:
+    """Client-local refcounts; the server holds one pin per id until the
+    client's last local ref dies (then a release is sent)."""
+
+    def __init__(self, cw: "ClientWorker"):
+        self._cw = cw
+        self._counts: Dict[ObjectID, int] = {}
+        self._lock = threading.Lock()
+
+    def add_local_reference(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._counts[oid] = self._counts.get(oid, 0) + 1
+
+    def remove_local_reference(self, oid: ObjectID) -> None:
+        with self._lock:
+            n = self._counts.get(oid, 0) - 1
+            if n > 0:
+                self._counts[oid] = n
+                return
+            self._counts.pop(oid, None)
+        self._cw._release(oid)
+
+    def add_owned_object(self, oid, **kw) -> None:  # client owns nothing
+        pass
+
+    def pin(self, oid) -> None:
+        pass
+
+
+class ClientWorker:
+    """Installed as the global worker when init(address='ray://...')."""
+
+    is_client = True
+
+    def __init__(self, host: str, port: int, authkey: bytes):
+        from multiprocessing.connection import Client as _Connect
+
+        self.worker_id = WorkerID.from_random()
+        self.job_id = JobID.from_random()  # provisional ids only
+        self.alive = True
+        self.client_id = uuid.uuid4().hex
+        self._conn = _Connect((host, port), authkey=authkey)
+        self._conn.send(("hello", "client", self.client_id))
+        self._send_lock = threading.Lock()
+        self._replies: Dict[int, Tuple[threading.Event, list]] = {}
+        self._req_seq = 0
+        self._seq_lock = threading.Lock()
+        self.reference_counter = _ClientRC(self)
+        self._task_seq_lock = threading.Lock()
+        self._task_seq = 0
+        ready = self._conn.recv()
+        if ready != ("ready",):
+            raise ConnectionError("head did not acknowledge the client "
+                                  f"session (got {ready!r})")
+        threading.Thread(target=self._reader, daemon=True,
+                         name="ray_tpu_client_reader").start()
+
+    # -- transport ----------------------------------------------------
+    def _reader(self) -> None:
+        while True:
+            try:
+                msg = self._conn.recv()
+            except (EOFError, OSError, TypeError, ValueError):
+                self.alive = False
+                for ev, _slot in list(self._replies.values()):
+                    ev.set()
+                return
+            req_id, ok, data = msg
+            slot = self._replies.pop(req_id, None)
+            if slot is not None:
+                slot[1][:] = [ok, data]
+                slot[0].set()
+
+    def _rpc(self, op: str, *payload, timeout: Optional[float] = None):
+        if not self.alive:
+            raise ConnectionError("client session disconnected")
+        with self._seq_lock:
+            self._req_seq += 1
+            req_id = self._req_seq
+        ev: threading.Event = threading.Event()
+        slot: list = []
+        self._replies[req_id] = (ev, slot)
+        with self._send_lock:
+            self._conn.send((op, req_id, payload))
+        if not ev.wait(timeout) or not slot:
+            self._replies.pop(req_id, None)
+            if not self.alive:
+                raise ConnectionError("client session disconnected")
+            raise rex.GetTimeoutError(f"client rpc {op} timed out")
+        ok, data = slot
+        if not ok:
+            raise cloudpickle.loads(data)
+        return data
+
+    def _release(self, oid: ObjectID) -> None:
+        if not self.alive:
+            return
+        try:
+            with self._seq_lock:
+                self._req_seq += 1
+                req_id = self._req_seq
+            with self._send_lock:
+                self._conn.send(("release", req_id, ([oid.binary()],)))
+            # fire-and-forget: no reply wait (reader drops unmatched)
+            self._replies.pop(req_id, None)
+        except (OSError, ValueError):
+            pass
+
+    # -- context helpers (provisional; the server re-keys) -------------
+    def next_task_id(self) -> TaskID:
+        with self._task_seq_lock:
+            self._task_seq += 1
+            return TaskID.of(self.job_id, seq=self._task_seq)
+
+    @property
+    def current_task_id(self) -> TaskID:
+        return TaskID.of(self.job_id)
+
+    def was_current_task_cancelled(self) -> bool:
+        return False
+
+    def defer_unref(self, oid: ObjectID) -> None:
+        self.reference_counter.remove_local_reference(oid)
+
+    def run_callback_when_ready(self, oid, cb) -> None:
+        raise NotImplementedError("futures/await on refs require a "
+                                  "driver-side runtime (not client mode)")
+
+    # -- object plane ---------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed")
+        oid_bin = self._rpc("put", cloudpickle.dumps(value, protocol=5))
+        return ObjectRef(ObjectID(oid_bin), None)
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float]) -> List[Any]:
+        blobs = self._rpc("get", [r.object_id().binary() for r in refs],
+                          timeout)
+        return [cloudpickle.loads(b) for b in blobs]
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int,
+             timeout: Optional[float]):
+        ready_bins = set(self._rpc(
+            "wait", [r.object_id().binary() for r in refs], num_returns,
+            timeout))
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.object_id().binary() in ready_bins
+             and len(ready) < num_returns else not_ready).append(r)
+        return ready, not_ready
+
+    # -- task plane -----------------------------------------------------
+    def submit_task(self, spec) -> List[ObjectRef]:
+        d = dict(
+            name=spec.name,
+            func_blob=cloudpickle.dumps(spec.func),
+            func_descriptor=spec.func_descriptor,
+            args_blob=cloudpickle.dumps((spec.args, spec.kwargs), protocol=5),
+            num_returns=spec.num_returns,
+            resources=spec.resources,
+            max_retries=spec.max_retries,
+            retry_exceptions=spec.retry_exceptions,
+            runtime_env=spec.runtime_env,
+            generator=spec.generator,
+        )
+        if spec.scheduling_strategy is not None:
+            d["strategy_blob"] = cloudpickle.dumps(spec.scheduling_strategy)
+        if spec.placement_group_id is not None:
+            d["pg_id"] = spec.placement_group_id.binary()
+            d["pg_bundle_index"] = spec.placement_group_bundle_index
+            d["pg_capture"] = spec.placement_group_capture_child_tasks
+        return_bins = self._rpc("submit", cloudpickle.dumps(d))
+        return [ObjectRef(ObjectID(b), None) for b in return_bins]
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False) -> None:
+        self._rpc("cancel", ref.object_id().binary(), force)
+
+    # -- actors ---------------------------------------------------------
+    def create_actor(self, cls: type, opts: dict, args, kwargs):
+        from ray_tpu.actor import ActorHandle
+        actor_bin, class_name = self._rpc(
+            "create_actor", cloudpickle.dumps(cls), cloudpickle.dumps(opts),
+            cloudpickle.dumps((args, kwargs), protocol=5))
+        return ActorHandle(ActorID(actor_bin), class_name)
+
+    def actor_call(self, actor_id: ActorID, method: str, args, kwargs,
+                   num_returns: int):
+        bins = self._rpc("actor_call", actor_id.binary(), method,
+                         cloudpickle.dumps((args, kwargs), protocol=5),
+                         num_returns)
+        refs = [ObjectRef(ObjectID(b), None) for b in bins]
+        return refs[0] if num_returns == 1 else refs
+
+    def get_actor(self, name: str, namespace: str):
+        from ray_tpu.actor import ActorHandle
+        actor_bin, class_name = self._rpc("get_actor", name, namespace)
+        return ActorHandle(ActorID(actor_bin), class_name)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        self._rpc("kill_actor", actor_id.binary(), no_restart)
+
+    # -- state ----------------------------------------------------------
+    def state(self, verb: str):
+        return self._rpc("state", verb)
+
+    # -- lifecycle -------------------------------------------------------
+    def shutdown(self) -> None:
+        self.alive = False
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+def parse_client_address(address: str) -> Tuple[str, int, Optional[bytes]]:
+    """ray://host:port?key=<hex> -> (host, port, authkey|None)."""
+    rest = address[len("ray://"):]
+    key: Optional[bytes] = None
+    if "?" in rest:
+        rest, _, query = rest.partition("?")
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if k == "key":
+                key = bytes.fromhex(v)
+    host, _, port = rest.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(
+            f"bad client address {address!r}: expected "
+            "ray://host:port[?key=hex]")
+    return host, int(port), key

@@ -80,7 +80,8 @@ class _WorkerSlot:
 class NodeDaemon:
     def __init__(self, head_address, head_authkey: bytes,
                  node_token: str, object_store_memory: int,
-                 inline_max: int, spill_dir: Optional[str] = None):
+                 inline_max: int, spill_dir: Optional[str] = None,
+                 join_info: Optional[dict] = None):
         from ray_tpu._private.runtime.shm_store import ShmObjectStore
 
         self.store = ShmObjectStore(object_store_memory,
@@ -101,9 +102,15 @@ class NodeDaemon:
         self._head = Client(head_address, authkey=head_authkey)
         self._head_lock = threading.Lock()
         # arena name travels in the hello so the head can reap the
-        # segment if this daemon is SIGKILLed (machine-death chaos)
-        self._head.send(("hello", node_token, os.getpid(),
-                         self.store.arena.name))
+        # segment if this daemon is SIGKILLed (machine-death chaos).
+        # token "join" = self-started daemon (ray_tpu start --address):
+        # declared resources travel too and the head ADOPTS the node.
+        if node_token == "join":
+            self._head.send(("hello", "join", os.getpid(),
+                             self.store.arena.name, dict(join_info or {})))
+        else:
+            self._head.send(("hello", node_token, os.getpid(),
+                             self.store.arena.name))
 
     # ------------------------------------------------------------------
     def _send_head(self, msg: tuple) -> None:
@@ -239,6 +246,13 @@ class NodeDaemon:
             slot.returns.pop(msg[1], None)
         return msg
 
+    def _serve_fetch(self, fid: int, oid_bin: bytes) -> None:
+        sobj = self.store.get_serialized(ObjectID(oid_bin))
+        if sobj is None:
+            self._send_head(("fetched", fid, False, None))
+        else:
+            self._send_head(("fetched", fid, True, sobj.to_bytes()))
+
     def _localize(self, loc: tuple) -> tuple:
         """Rewrite a head get-reply entry pointing at THIS node's store
         (("node_shm", oid)) into a zero-copy arena location, restoring
@@ -313,12 +327,12 @@ class NodeDaemon:
                     except Exception:
                         pass
             elif kind == "fetch":
-                fid, oid_bin = msg[1], msg[2]
-                sobj = self.store.get_serialized(ObjectID(oid_bin))
-                if sobj is None:
-                    self._send_head(("fetched", fid, False, None))
-                else:
-                    self._send_head(("fetched", fid, True, sobj.to_bytes()))
+                # off the run loop: serializing + sending a large object
+                # must not stall task dispatch / pings for the node
+                # (sends are serialized by _head_lock)
+                threading.Thread(
+                    target=self._serve_fetch, args=(msg[1], msg[2]),
+                    daemon=True, name="ray_tpu_node_fetch").start()
             elif kind == "free":
                 for b in msg[1]:
                     self.store.free_object(ObjectID(b))
@@ -360,13 +374,18 @@ class NodeDaemon:
 
 def _main(argv) -> None:
     """``python -m ray_tpu._private.runtime.node_daemon <host> <port>
-    <token> <object_store_memory> <inline_max>`` with the head authkey in
-    RAY_TPU_HEAD_AUTHKEY. Exec'd by the head's Cluster harness (or by
-    `ray_tpu start --address=...` on another machine)."""
+    <token> <object_store_memory> <inline_max> [join_info_json]`` with
+    the head authkey in RAY_TPU_HEAD_AUTHKEY. Exec'd by the head's
+    Cluster harness, or self-started with token "join" by
+    `ray_tpu start --address=...` on another machine."""
+    import json
+
     host, port, token = argv[0], int(argv[1]), argv[2]
     mem, inline_max = int(argv[3]), int(argv[4])
+    join_info = json.loads(argv[5]) if len(argv) > 5 else None
     authkey = bytes.fromhex(os.environ["RAY_TPU_HEAD_AUTHKEY"])
-    daemon = NodeDaemon((host, port), authkey, token, mem, inline_max)
+    daemon = NodeDaemon((host, port), authkey, token, mem, inline_max,
+                        join_info=join_info)
     daemon.run()
 
 

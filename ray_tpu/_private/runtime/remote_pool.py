@@ -243,6 +243,11 @@ class RemoteNodePool(ProcessWorkerPool):
         ev: threading.Event = threading.Event()
         slot: list = []
         self._pings[pid_] = (ev, slot)
+        if self._conn_dead:
+            # registered after _on_daemon_lost swept the table: bail now
+            # instead of waiting out the timeout
+            self._pings.pop(pid_, None)
+            return None
         self._send_daemon(("ping", pid_))
         if not ev.wait(timeout) or not slot:
             self._pings.pop(pid_, None)
@@ -278,6 +283,11 @@ class RemoteNodePool(ProcessWorkerPool):
         ev: threading.Event = threading.Event()
         slot: list = []
         self._fetches[fid] = (ev, slot)
+        if self._conn_dead:
+            # registered after _on_daemon_lost swept the table: bail now
+            # instead of waiting out the transfer timeout
+            self._fetches.pop(fid, None)
+            return None
         self._send_daemon(("fetch", fid, oid.binary()))
         if not ev.wait(timeout) or not slot or not slot[0]:
             self._fetches.pop(fid, None)
@@ -407,9 +417,10 @@ class HeadServer:
     at spawn time (reference: the GCS server's listening port that
     raylets register against)."""
 
-    def __init__(self):
-        self.authkey = os.urandom(16)
-        self._listener = Listener(("127.0.0.1", 0), authkey=self.authkey)
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 authkey: Optional[bytes] = None):
+        self.authkey = authkey or os.urandom(16)
+        self._listener = Listener((host, port), authkey=self.authkey)
         self.address: Tuple[str, int] = self._listener.address
         self._pending: Dict[str, Tuple[threading.Event, list]] = {}
         self._lock = threading.Lock()

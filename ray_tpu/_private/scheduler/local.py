@@ -203,7 +203,8 @@ class EventScheduler(SchedulerBase):
                     {"available": list(n.available),
                      "capacity": list(n.capacity),
                      "is_bundle": n.is_bundle,
-                     "custom": dict(n.custom)}
+                     "custom": dict(n.custom),
+                     "custom_avail": dict(n.custom_avail)}
                     for n in self._nodes
                 ],
             }
@@ -279,8 +280,9 @@ class EventScheduler(SchedulerBase):
                 return False
             n = self._nodes[index]
             if n.fits(vec) and any(c > 0 for c in n.capacity) \
-                    and n.has_custom(custom):
+                    and n.has_custom(custom) and n.fits_custom(custom):
                 n.allocate(vec)
+                n.allocate_custom(custom)
                 return True
             return False
 

@@ -179,7 +179,9 @@ class TensorScheduler(SchedulerBase):
                     {"available": self._avail[i].tolist(),
                      "capacity": self._cap[i].tolist(),
                      "is_bundle": self._node_states[i].is_bundle,
-                     "custom": dict(self._node_states[i].custom)}
+                     "custom": dict(self._node_states[i].custom),
+                     "custom_avail":
+                         dict(self._node_states[i].custom_avail)}
                     for i in range(len(self._node_states))
                 ],
             }

@@ -216,6 +216,7 @@ class Worker:
         # TCP registration endpoint for remote node daemons / clients
         # (created lazily with the first remote node)
         self._head_server = None
+        self.client_server = None
 
         # placement groups (bundle reservation over the scheduler)
         from ray_tpu._private.placement_groups import PlacementGroupManager
@@ -557,6 +558,67 @@ class Worker:
         self.gcs.start_health_checks()
         return entry
 
+    def enable_head_endpoint(self, host: str = "127.0.0.1", port: int = 0):
+        """Open (or return) the head's TCP endpoint and accept
+        UNSOLICITED registrations: remote clients (`ray://` sessions)
+        and joining node daemons (`ray_tpu start --address=...`).
+        Returns the HeadServer; its address/authkey form the connect
+        string."""
+        from ray_tpu._private.client import ClientServer
+        from ray_tpu._private.runtime.remote_pool import HeadServer
+
+        if self._head_server is not None:
+            cur_host, cur_port = self._head_server.address
+            if (port != 0 and port != cur_port) or host != cur_host:
+                raise RuntimeError(
+                    f"head endpoint already bound to {cur_host}:{cur_port} "
+                    f"(created when the first remote node was added); call "
+                    f"enable_head_endpoint(host=..., port=...) BEFORE "
+                    f"adding remote nodes to pick the bind address")
+        if self._head_server is None:
+            self._head_server = HeadServer(host, port)
+        if self.client_server is None:
+            self.client_server = ClientServer(self)
+        self._head_server.on_unsolicited = self._on_unsolicited_hello
+        return self._head_server
+
+    def _on_unsolicited_hello(self, conn, hello: tuple) -> None:
+        kind = hello[1]
+        if kind == "client":
+            self.client_server.attach(conn, hello)
+        elif kind == "join" and len(hello) >= 5:
+            self.adopt_remote_node(conn, hello)
+        else:
+            conn.close()
+
+    def adopt_remote_node(self, conn, hello: tuple):
+        """A node daemon started out-of-band (`ray_tpu start
+        --address=head:port`) registers itself: same runtime as
+        add_remote_cluster_node but the daemon process belongs to
+        another launcher (possibly another machine)."""
+        from ray_tpu._private.runtime.remote_pool import RemoteNodePool
+
+        arena_name, info = hello[3], hello[4]
+        num_cpus = float(info.get("num_cpus", 4.0))
+        num_tpus = float(info.get("num_tpus", 0.0))
+        resources = dict(info.get("resources") or {})
+        num_workers = int(info.get("num_workers") or max(int(num_cpus), 1))
+        node_id = NodeID.from_random()
+        state = NodeState((num_cpus, num_tpus, 1e18,
+                           sum(resources.values())),
+                          node_id=node_id, custom_resources=resources)
+        row = self.scheduler.add_node(state)
+        pool = RemoteNodePool(self, num_workers, row, conn, node_id,
+                              daemon_proc=None, arena_name=None)
+        self._node_pools[row] = pool
+        entry = self.gcs.register_node(
+            node_id, row, {"CPU": num_cpus, "TPU": num_tpus, **resources},
+            kind="remote", pool=pool)
+        self.gcs.start_health_checks()
+        logger.info("adopted remote node %s (row %d, arena %s)",
+                    node_id.hex()[:16], row, arena_name)
+        return entry
+
     def on_node_failure(self, node_id: NodeID, reason: str = "") -> None:
         """Node death: mark dead, stop scheduling to it, fail/retry its
         in-flight work, reschedule its placement-group bundles, and fail
@@ -837,6 +899,8 @@ class Worker:
         if self.process_pool is not None:
             self.process_pool.shutdown()
         self._pool.shutdown(wait=False, cancel_futures=True)
+        if self.client_server is not None:
+            self.client_server.shutdown()
         if self._head_server is not None:
             self._head_server.close()
         if self.shm_store is not None:
@@ -923,6 +987,7 @@ def _async_raise_in_task(task_id: TaskID) -> None:
 def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
          scheduler: Optional[str] = None, ignore_reinit_error: bool = False,
          resources: Optional[Dict[str, float]] = None,
+         address: Optional[str] = None,
          _system_config: Optional[dict] = None, **kwargs) -> "Worker":
     global global_worker
     with _init_lock:
@@ -931,6 +996,19 @@ def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
                 return global_worker
             raise RuntimeError("ray_tpu.init() called twice; pass "
                                "ignore_reinit_error=True to allow")
+        if address is not None and address.startswith("ray://"):
+            # client mode: this process becomes a THIN CLIENT of a
+            # running head (reference: ray client, python/ray/util/client)
+            from ray_tpu._private.client import (ClientWorker,
+                                                 parse_client_address)
+            host, port, key = parse_client_address(address)
+            if key is None:
+                raise ValueError(
+                    "client address needs the head's key: use the "
+                    "ray://host:port?key=... string printed by "
+                    "`python -m ray_tpu start --head`")
+            global_worker = ClientWorker(host, port, key)  # type: ignore
+            return global_worker  # type: ignore[return-value]
         if _system_config:
             GLOBAL_CONFIG.unfreeze()
             GLOBAL_CONFIG.apply_system_config(_system_config)

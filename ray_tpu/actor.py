@@ -794,6 +794,9 @@ class ActorHandle:
 
     def _submit_method(self, method_name, args, kwargs, num_returns):
         worker = worker_mod.get_worker()
+        if getattr(worker, "is_client", False):
+            return worker.actor_call(self._actor_id, method_name, args,
+                                     kwargs, num_returns)
         rt = self._runtime()
         with self._seq_lock:
             self._seq += 1
@@ -852,6 +855,9 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         worker = worker_mod.get_worker()
+        if getattr(worker, "is_client", False):
+            return worker.create_actor(self._cls, self._options, args,
+                                       kwargs)
         opts = self._options
         name = opts.get("name")
         namespace = opts.get("namespace") or "default"
@@ -945,6 +951,8 @@ def _submit_actor_creation(worker, pending, create):
 
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     worker = worker_mod.get_worker()
+    if getattr(worker, "is_client", False):
+        return worker.get_actor(name, namespace)
     actor_id = worker.gcs.get_actor_by_name(name, namespace)
     if actor_id is None:
         raise ValueError(f"no actor named {name!r} in namespace "
@@ -959,6 +967,9 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
 
 def kill(handle: ActorHandle, *, no_restart: bool = True) -> None:
     worker = worker_mod.get_worker()
+    if getattr(worker, "is_client", False):
+        worker.kill_actor(handle.actor_id, no_restart)
+        return
     with worker._actors_lock:
         rt = worker.actors.get(handle.actor_id)
     if rt is None:

@@ -1,0 +1,216 @@
+"""Ray-client analog + CLI head/node start.
+
+Reference pattern: ray client tests (python/ray/util/client) — a driver
+process connects to a RUNNING head over the network and uses the full
+task/actor/object API as a thin client; `ray start --head` /
+`ray start --address=...` assemble a cluster from shells.
+
+Here: a real head subprocess (`python -m ray_tpu start --head`), a
+client session in this test process (`init(address="ray://...")`), and
+a node daemon joining via the CLI. Everything crosses real TCP.
+"""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def head():
+    ray_tpu.shutdown()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # head runs WITHOUT jax platform tweaks from conftest; force cpu to
+    # keep startup light
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "4", "--num-workers", "4",
+         "--resources", '{"head_res": 2}'],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    address = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.05)
+            continue
+        m = re.search(r"address='(ray://[^']+)'", line)
+        if m:
+            address = m.group(1)
+            break
+    assert address, "head did not print a connect string"
+    yield proc, address
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.fixture
+def client(head):
+    _proc, address = head
+    ray_tpu.shutdown()
+    w = ray_tpu.init(address=address)
+    yield w
+    ray_tpu.shutdown()
+
+
+class TestClientBasics:
+    def test_put_get_roundtrip(self, client):
+        ref = ray_tpu.put({"k": [1, 2, 3]})
+        assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+    def test_remote_task(self, client):
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        assert ray_tpu.get(add.remote(2, 3)) == 5
+
+    def test_task_runs_in_head_process(self, head, client):
+        proc, _ = head
+
+        @ray_tpu.remote
+        def whoami():
+            import os
+            return os.getpid()
+
+        pid = ray_tpu.get(whoami.remote())
+        assert pid == proc.pid  # head is thread-mode: tasks run in-process
+
+    def test_ref_dataflow(self, client):
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        @ray_tpu.remote
+        def total(*xs):
+            return sum(xs)
+
+        refs = [sq.remote(i) for i in range(5)]
+        assert ray_tpu.get(total.remote(*refs)) == sum(i * i
+                                                       for i in range(5))
+
+    def test_task_error_propagates(self, client):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("kapow")
+
+        with pytest.raises(ValueError, match="kapow"):
+            ray_tpu.get(boom.remote())
+
+    def test_wait(self, client):
+        @ray_tpu.remote
+        def slow():
+            time.sleep(5.0)
+            return 1
+
+        @ray_tpu.remote
+        def fast():
+            return 2
+
+        f, s = fast.remote(), slow.remote()
+        ready, not_ready = ray_tpu.wait([f, s], num_returns=1,
+                                        timeout=10.0)
+        assert ready == [f] and not_ready == [s]
+        ray_tpu.cancel(s, force=False)
+
+    def test_state_verbs(self, client):
+        res = ray_tpu.cluster_resources()
+        assert res["CPU"] == 4.0
+        assert res.get("head_res") == 2.0
+        assert len(ray_tpu.nodes()) >= 1
+
+    def test_named_resource_scheduling(self, client):
+        @ray_tpu.remote(resources={"head_res": 1.0})
+        def f():
+            return "ok"
+
+        assert ray_tpu.get(f.remote()) == "ok"
+
+
+class TestClientActors:
+    def test_actor_lifecycle(self, client):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self, start):
+                self.v = start
+
+            def incr(self, k=1):
+                self.v += k
+                return self.v
+
+        c = Counter.remote(10)
+        assert ray_tpu.get(c.incr.remote()) == 11
+        assert ray_tpu.get(c.incr.remote(5)) == 16
+        ray_tpu.kill(c)
+
+    def test_named_actor(self, client):
+        @ray_tpu.remote
+        class Store:
+            def __init__(self):
+                self.d = {}
+
+            def set(self, k, v):
+                self.d[k] = v
+                return True
+
+            def get(self, k):
+                return self.d.get(k)
+
+        s = Store.options(name="client_store").remote()
+        ray_tpu.get(s.set.remote("a", 1))
+        s2 = ray_tpu.get_actor("client_store")
+        assert ray_tpu.get(s2.get.remote("a")) == 1
+        ray_tpu.kill(s)
+
+
+class TestCliNodeJoin:
+    def test_node_joins_via_cli(self, head, client):
+        _proc, address = head
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        node = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu", "start",
+             "--address", address, "--num-cpus", "2",
+             "--resources", '{"joined": 2}'],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            # generous deadline: the daemon subprocess cold-imports jax,
+            # which can take >30s when the suite saturates the host
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if ray_tpu.cluster_resources().get("joined") == 2.0:
+                    break
+                if node.poll() is not None:
+                    pytest.fail("node daemon exited early:\n"
+                                + (node.stdout.read() or ""))
+                time.sleep(0.2)
+            assert ray_tpu.cluster_resources().get("joined") == 2.0
+
+            @ray_tpu.remote(resources={"joined": 1.0})
+            def where():
+                import os
+                return os.getpid()
+
+            pid = ray_tpu.get(where.remote(), timeout=30.0)
+            # ran in a worker process of the JOINED node, not the head
+            assert pid != _proc.pid and pid != os.getpid()
+        finally:
+            node.terminate()
+            try:
+                node.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                node.kill()
