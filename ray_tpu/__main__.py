@@ -74,11 +74,11 @@ def _cmd_start(args) -> int:
               f"  connect a driver:  ray_tpu.init(address={connect!r})\n"
               f"  join a node:       python -m ray_tpu start "
               f"--address='{connect}'", flush=True)
-        stop = []
-        signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+        import threading
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
         try:
-            while not stop:
-                signal.pause()
+            stop.wait()  # Event.wait: no lost-signal window, EINTR-safe
         except KeyboardInterrupt:
             pass
         ray_tpu.shutdown()

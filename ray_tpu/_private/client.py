@@ -27,7 +27,6 @@ from __future__ import annotations
 import logging
 import threading
 import uuid
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
@@ -60,8 +59,6 @@ class ClientServer:
         self._worker = worker
         self._sessions: Dict[str, ClientSession] = {}
         self._lock = threading.Lock()
-        self._pool = ThreadPoolExecutor(max_workers=16,
-                                        thread_name_prefix="ray_tpu_client_srv")
 
     # -- session lifecycle --------------------------------------------
     def attach(self, conn, hello: tuple) -> None:
@@ -86,7 +83,12 @@ class ClientServer:
             if not (isinstance(msg, tuple) and len(msg) == 3):
                 break
             op, req_id, payload = msg
-            self._pool.submit(self._handle, s, op, req_id, payload)
+            # a THREAD per request (not a bounded pool): blocking
+            # gets/waits with no timeout must never starve the
+            # puts/submits that would unblock them
+            threading.Thread(target=self._handle,
+                             args=(s, op, req_id, payload), daemon=True,
+                             name="ray_tpu_client_req").start()
         self._drop(s)
 
     def _drop(self, s: ClientSession) -> None:
@@ -110,7 +112,14 @@ class ClientServer:
             result = getattr(self, f"_op_{op}")(s, *payload)
             ok = True
         except BaseException as e:  # noqa: BLE001
-            ok, result = False, cloudpickle.dumps(e)
+            ok = False
+            try:
+                result = cloudpickle.dumps(e)
+            except Exception:
+                # unpicklable exception (open handle, lock, ...): the
+                # client must still get A reply, not hang forever
+                result = cloudpickle.dumps(
+                    RuntimeError(f"[unpicklable {type(e).__name__}] {e}"))
         try:
             with s.send_lock:
                 s.conn.send((req_id, ok, result))
@@ -129,12 +138,22 @@ class ClientServer:
         return ref.object_id().binary()
 
     def _op_get(self, s, oid_bins: list, timeout) -> list:
+        from ray_tpu._private.runtime.process_pool import _dumps_collect_refs
+
         refs = [ObjectRef(ObjectID(b), None, _register=False)
                 for b in oid_bins]
         # worker.get already raises driver-semantics exceptions (incl.
         # TaskError cause conversion); _handle ships them to the client
-        return [cloudpickle.dumps(v, protocol=5)
-                for v in self._worker.get(refs, timeout)]
+        out = []
+        for v in self._worker.get(refs, timeout):
+            # ObjectRefs NESTED in fetched values become client-held
+            # refs too: pin them or the server may free the objects
+            # while the client still points at them
+            blob, contained = _dumps_collect_refs(v)
+            for r in contained:
+                self._pin(s, r.object_id())
+            out.append(blob)
+        return out
 
     def _op_wait(self, s, oid_bins: list, num_returns: int, timeout) -> list:
         refs = [ObjectRef(ObjectID(b), None, _register=False)
@@ -234,7 +253,6 @@ class ClientServer:
             sessions = list(self._sessions.values())
         for s in sessions:
             self._drop(s)
-        self._pool.shutdown(wait=False)
 
 
 # ----------------------------------------------------------------------
@@ -323,6 +341,11 @@ class ClientWorker:
         ev: threading.Event = threading.Event()
         slot: list = []
         self._replies[req_id] = (ev, slot)
+        if not self.alive:
+            # registered after the reader's disconnect sweep: bail now
+            # instead of waiting forever on a reply that cannot come
+            self._replies.pop(req_id, None)
+            raise ConnectionError("client session disconnected")
         with self._send_lock:
             self._conn.send((op, req_id, payload))
         if not ev.wait(timeout) or not slot:
@@ -458,6 +481,11 @@ class ClientWorker:
 
 def parse_client_address(address: str) -> Tuple[str, int, Optional[bytes]]:
     """ray://host:port?key=<hex> -> (host, port, authkey|None)."""
+    if not address.startswith("ray://"):
+        raise ValueError(
+            f"bad client address {address!r}: must start with ray:// "
+            "(use the connect string printed by "
+            "`python -m ray_tpu start --head`)")
     rest = address[len("ray://"):]
     key: Optional[bytes] = None
     if "?" in rest:

@@ -171,12 +171,18 @@ class RemoteNodePool(ProcessWorkerPool):
 
     def _unlink_dead_arena(self) -> None:
         """A SIGKILLed daemon can't unlink its own arena; reap it once
-        the daemon process is confirmed gone (head-spawned only)."""
-        if self._arena_name is None or self._daemon_proc is None:
+        the daemon is confirmed gone. Head-spawned daemons: wait on the
+        child process. Adopted (CLI-joined) daemons: the severed
+        connection is the death signal; a joined daemon on ANOTHER host
+        leaves no segment here, so the by-name reap is a no-op there."""
+        if self._arena_name is None:
             return
-        try:
-            self._daemon_proc.wait(timeout=5.0)
-        except subprocess.TimeoutExpired:
+        if self._daemon_proc is not None:
+            try:
+                self._daemon_proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                return
+        elif not self._conn_dead:
             return
         from multiprocessing import shared_memory
         try:

@@ -608,8 +608,11 @@ class Worker:
                            sum(resources.values())),
                           node_id=node_id, custom_resources=resources)
         row = self.scheduler.add_node(state)
+        # arena_name travels so a SAME-host joined daemon's segment can
+        # be reaped after death (on another host the name matches
+        # nothing here and the reap is a no-op)
         pool = RemoteNodePool(self, num_workers, row, conn, node_id,
-                              daemon_proc=None, arena_name=None)
+                              daemon_proc=None, arena_name=arena_name)
         self._node_pools[row] = pool
         entry = self.gcs.register_node(
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus, **resources},

@@ -25,7 +25,7 @@ from ray_tpu._private import worker as worker_mod
 from ray_tpu.cluster_utils import Cluster
 
 
-def wait_for(cond, timeout=15.0, interval=0.02):
+def wait_for(cond, timeout=60.0, interval=0.02):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if cond():
@@ -75,7 +75,7 @@ class TestRemoteNodeBasics:
 
         ref = produce.remote()
         # readiness is signalled without the bytes crossing the wire
-        ready, _ = ray_tpu.wait([ref], timeout=15.0)
+        ready, _ = ray_tpu.wait([ref], timeout=60.0)
         assert ready
         assert w.gcs.object_location_get(ref.object_id()) is not None
         # first head-side access fetches + memoizes
@@ -207,7 +207,7 @@ class TestRemoteNodeFailure:
         ref = produce.options(scheduling_strategy=
                               NodeAffinitySchedulingStrategy(
                                   node.node_id, soft=True)).remote()
-        ready, _ = ray_tpu.wait([ref], timeout=15.0)
+        ready, _ = ray_tpu.wait([ref], timeout=60.0)
         assert ready
         w = worker_mod.get_worker()
         assert w.gcs.object_location_get(ref.object_id()) is not None
@@ -230,7 +230,7 @@ class TestObjectDirectoryLifecycle:
             return np.zeros(BIG // 8)
 
         ref = produce.remote()
-        ray_tpu.wait([ref], timeout=15.0)
+        ray_tpu.wait([ref], timeout=60.0)
         oid = ref.object_id()
         assert w.gcs.object_location_get(oid) is not None
         del ref
