@@ -19,5 +19,12 @@ never look inside a block except in driver-side aggregations).
 
 from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,  # noqa: F401
                                   from_items, range)  # noqa: A004
+from ray_tpu.data.datasource import (from_arrow, from_numpy,  # noqa: F401
+                                     from_pandas, read_binary_files,
+                                     read_csv, read_json, read_numpy,
+                                     read_parquet, read_text)
 
-__all__ = ["Dataset", "range", "from_items", "ActorPoolStrategy"]
+__all__ = ["Dataset", "range", "from_items", "ActorPoolStrategy",
+           "read_text", "read_csv", "read_json", "read_binary_files",
+           "read_numpy", "read_parquet", "from_pandas", "from_numpy",
+           "from_arrow"]

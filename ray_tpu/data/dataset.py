@@ -162,6 +162,27 @@ class Dataset:
         for block in self._execute():
             yield from block
 
+    # -- datasinks (reference: Dataset.write_* -> Datasink tasks) -------
+    def write_csv(self, path: str) -> List[str]:
+        from ray_tpu.data import datasource
+
+        return datasource.write_csv(self, path)
+
+    def write_json(self, path: str) -> List[str]:
+        from ray_tpu.data import datasource
+
+        return datasource.write_json(self, path)
+
+    def write_parquet(self, path: str) -> List[str]:
+        from ray_tpu.data import datasource
+
+        return datasource.write_parquet(self, path)
+
+    def to_pandas(self):
+        from ray_tpu.data import datasource
+
+        return datasource.to_pandas(self)
+
     def materialize(self) -> "MaterializedDataset":
         """Run the pipeline, keeping blocks in the object store as refs
         (the reference's ds.materialize())."""
