@@ -118,6 +118,22 @@ def main() -> int:
         traceback.print_exc()
         out["mfu"] = None
 
+    # --- LLM serving: paged-attention decode throughput ----------------
+    try:
+        d = perf.llm_decode_throughput(smoke=smoke)
+        out["llm_decode"] = {
+            "tokens_per_sec": round(d["tokens_per_sec"], 1),
+            "batch_slots": d["batch_slots"],
+            "n_params": d["n_params"],
+            "new_tokens": d["new_tokens"],
+        }
+        print(f"  llm decode: {d['tokens_per_sec']:.0f} tok/s "
+              f"({d['batch_slots']} slots, {d['n_params']/1e6:.0f}M "
+              f"params)", file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
+        out["llm_decode"] = None
+
     import os
 
     # context: process-worker throughput is HOST-core bound (N worker

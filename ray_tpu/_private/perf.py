@@ -132,7 +132,8 @@ def model_mfu(d_model: int = 2048, n_layers: int = 8, n_heads: int = 16,
               n_kv_heads: int = 8, d_ff: int = 5632,
               vocab_size: int = 32_768, seq_len: int = 2048,
               batch_size: int = 16, steps: int = 10,
-              smoke: bool = False) -> Dict[str, Any]:
+              smoke: bool = False,
+              remat_policy: str = "dots") -> Dict[str, Any]:
     """Flagship transformer train-step perf on the default device.
 
     Adaptive batch: halves on out-of-memory until the step fits. Returns
@@ -153,7 +154,8 @@ def model_mfu(d_model: int = 2048, n_layers: int = 8, n_heads: int = 16,
                             n_layers=n_layers, n_heads=n_heads,
                             n_kv_heads=n_kv_heads, d_ff=d_ff,
                             max_seq_len=seq_len,
-                            remat=not smoke)
+                            remat=not smoke,
+                            remat_policy=remat_policy)
     model = Transformer(cfg)
     optimizer = ts.make_optimizer()
     step_fn = ts.make_train_step(model, optimizer)
@@ -228,4 +230,58 @@ def model_mfu(d_model: int = 2048, n_layers: int = 8, n_heads: int = 16,
         "mfu": mfu,
         "hfu": hfu,
         "loss": loss_host,
+    }
+
+
+def llm_decode_throughput(smoke: bool = False) -> dict:
+    """Paged-attention decode tokens/s on the attached device
+    (models/inference.py engine, full continuous batch). The analog of
+    the reference serving stack's decode-throughput benchmark."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.inference import InferenceConfig, InferenceEngine
+    from ray_tpu.models.transformer import Transformer, TransformerConfig
+
+    if smoke:
+        mcfg = TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                 n_heads=4, n_kv_heads=2, d_ff=128,
+                                 max_seq_len=256)
+        batch, new_tokens, pages = 2, 16, 64
+    else:
+        # serving-shaped model: head_dim 128 keeps the Pallas kernel on
+        # full-width lanes
+        mcfg = TransformerConfig(vocab_size=32000, d_model=1024,
+                                 n_layers=8, n_heads=8, n_kv_heads=4,
+                                 d_ff=2816, max_seq_len=2048)
+        batch, new_tokens, pages = 8, 64, 512
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    icfg = InferenceConfig(batch_size=batch, page_size=16,
+                           max_pages_per_seq=16, num_pages=pages,
+                           prefill_buckets=(16,), max_new_tokens=new_tokens)
+    engine = InferenceEngine(params, mcfg, icfg)
+    try:
+        prompt = [1, 2, 3, 4]
+        # warm compiles (prefill + EVERY decode-chunk program the timed
+        # run will pick): same max_new as the measurement, or chunk
+        # programs compile inside the timing window
+        engine.generate(prompt, new_tokens, timeout=900.0)
+        t0 = time.perf_counter()
+        futs = [engine.submit([i + 1] * 4, new_tokens)
+                for i in range(batch)]
+        total = sum(len(f.result(timeout=600)) for f in futs)
+        dt = time.perf_counter() - t0
+    finally:
+        engine.shutdown()
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    return {
+        "tokens_per_sec": total / dt,
+        "batch_slots": batch,
+        "new_tokens": new_tokens,
+        "n_params": int(n_params),
+        "seconds": dt,
     }

@@ -16,7 +16,6 @@ driver never needs it).
 
 from __future__ import annotations
 
-import builtins
 import glob as _glob
 import os
 from typing import Any, Dict, List, Optional, Sequence, Union
@@ -36,15 +35,17 @@ def _expand_paths(paths: Paths) -> List[str]:
         if os.path.isdir(p):
             for root, _dirs, files in sorted(os.walk(p)):
                 out.extend(os.path.join(root, f) for f in sorted(files))
+        elif os.path.exists(p):
+            # existence first: a real file named "part[1].txt" must not
+            # be misread as a glob character class
+            out.append(p)
         elif any(ch in p for ch in "*?["):
             hits = sorted(_glob.glob(p))
             if not hits:
                 raise FileNotFoundError(f"no files match {p!r}")
             out.extend(hits)
         else:
-            if not os.path.exists(p):
-                raise FileNotFoundError(p)
-            out.append(p)
+            raise FileNotFoundError(p)
     if not out:
         raise FileNotFoundError(f"no files under {paths!r}")
     return out
@@ -113,7 +114,10 @@ def read_json(paths: Paths, *, encoding: str = "utf-8") -> Dataset:
         if not text:
             return []
         if text[0] == "[":
-            return list(json.loads(text))
+            try:
+                return list(json.loads(text))
+            except json.JSONDecodeError:
+                pass  # JSONL whose rows are arrays: fall through
         return [json.loads(ln) for ln in text.splitlines() if ln.strip()]
 
     return _file_source(paths, "read_json", parse)

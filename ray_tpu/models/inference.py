@@ -1,0 +1,511 @@
+"""LLM inference engine: paged KV cache + continuous batching.
+
+Reference surface: the serving stack the reference framework runs
+(vLLM-style engine: paged KV cache, page tables per sequence,
+continuous batching that admits new requests as finished ones free
+their slots — on GPU). TPU-native rebuild: the decode step is ONE
+jitted program with fully static shapes (fixed batch slots, fixed page
+geometry), paged attention is the Pallas kernel in
+ops/paged_attention.py (arXiv:2604.15464 pattern, PAPERS.md), prefill
+jits per prompt-length bucket so compile count stays bounded, and all
+ragged-ness lives in page tables + sequence lengths (data, not shapes).
+
+Weights are the flagship Transformer's (models/transformer.py) taken
+as-is — the same param tree a Train run produces serves directly; a
+parity test pins this functional forward to the flax module's output.
+
+    engine = InferenceEngine(params, model_cfg, InferenceConfig(...))
+    fut = engine.submit([1, 2, 3], max_new_tokens=16)
+    tokens = fut.result()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.transformer import TransformerConfig, _rope
+from ray_tpu.ops.paged_attention import (append_token_kv,
+                                         paged_attention_auto,
+                                         write_prefill_kv)
+
+
+@dataclasses.dataclass(frozen=True)
+class InferenceConfig:
+    batch_size: int = 4            # concurrent decode slots
+    page_size: int = 16
+    max_pages_per_seq: int = 16    # max context = page_size * this
+    num_pages: int = 128           # total physical pages (all slots)
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128)
+    max_new_tokens: int = 32
+    eos_id: Optional[int] = None
+    # max greedy steps fused into one device dispatch (lax.scan);
+    # admission happens between chunks. Large chunks amortize dispatch
+    # round trips (the dominant cost on remote/tunneled chips). Idle
+    # slots' dummy appends wrap within the reserved parking page, so
+    # chunks may exceed page_size.
+    decode_chunk: int = 32
+
+    @property
+    def max_context(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+
+# ----------------------------------------------------------------------
+# functional forward over the flax param tree
+# ----------------------------------------------------------------------
+
+def _rms(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+            * scale).astype(x.dtype)
+
+
+def _mlp(p, x, dtype):
+    h = (jax.nn.silu(x @ p["w_gate"].astype(dtype))
+         * (x @ p["w_up"].astype(dtype)))
+    return h @ p["w_down"].astype(dtype)
+
+
+def _prefill_layer(p, cfg: TransformerConfig, x, positions):
+    """Full-attention prefill for one layer over [1,S,Dm]; returns
+    (x_out, k [S,KV,D], v [S,KV,D])."""
+    a = p["Attention_0"]
+    h = _rms(x, p["RMSNorm_0"]["scale"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, a["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, a["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, a["wv"].astype(cfg.dtype))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    kr = jnp.repeat(k, rep, axis=2)
+    vr = jnp.repeat(v, rep, axis=2)
+    s = x.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    scores = jnp.einsum("bshk,bthk->bhst", q, kr) / jnp.sqrt(cfg.head_dim)
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    attn = jnp.einsum("bhst,bthk->bshk", probs, vr)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, a["wo"].astype(cfg.dtype))
+    x = x + _mlp(p["MLP_0"], _rms(x, p["RMSNorm_1"]["scale"],
+                                  cfg.norm_eps), cfg.dtype)
+    return x, k[0], v[0]
+
+
+def _decode_layer(p, cfg: TransformerConfig, x, positions, k_pages,
+                  v_pages, page_table, seq_lens):
+    """Single-token decode for one layer over [B,Dm] against the paged
+    cache; appends this token's K/V. seq_lens = cache length BEFORE the
+    token. Returns (x_out, k_pages, v_pages)."""
+    a = p["Attention_0"]
+    h = _rms(x, p["RMSNorm_0"]["scale"], cfg.norm_eps)
+    q = jnp.einsum("bd,dhk->bhk", h, a["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bd,dhk->bhk", h, a["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bd,dhk->bhk", h, a["wv"].astype(cfg.dtype))
+    # rope over a length-1 "sequence" per slot
+    q = _rope(q[:, None], positions[:, None], cfg.rope_theta)[:, 0]
+    k = _rope(k[:, None], positions[:, None], cfg.rope_theta)[:, 0]
+    k_pages, v_pages = append_token_kv(k_pages, v_pages, k, v,
+                                       page_table, seq_lens)
+    out = paged_attention_auto(q, k_pages, v_pages, page_table,
+                               seq_lens + 1)
+    x = x + jnp.einsum("bhk,hkd->bd", out.astype(cfg.dtype),
+                       a["wo"].astype(cfg.dtype))
+    x = x + _mlp(p["MLP_0"], _rms(x, p["RMSNorm_1"]["scale"],
+                                  cfg.norm_eps), cfg.dtype)
+    return x, k_pages, v_pages
+
+
+def prefill(params: Dict[str, Any], cfg: TransformerConfig,
+            tokens: jnp.ndarray):
+    """tokens [1,S] (padded to a bucket) -> (logits [S,V] f32,
+    k_seq/v_seq [L,S,KV,D])."""
+    embed = params["embedding"]
+    x = embed.astype(cfg.dtype)[tokens]
+    s = tokens.shape[1]
+    positions = jnp.arange(s)[None, :]
+    ks, vs = [], []
+    for i in range(cfg.n_layers):
+        x, k, v = _prefill_layer(params[f"layer_{i}"], cfg, x, positions)
+        ks.append(k)
+        vs.append(v)
+    x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
+    return (logits[0].astype(jnp.float32), jnp.stack(ks), jnp.stack(vs))
+
+
+def decode_step(params: Dict[str, Any], cfg: TransformerConfig,
+                tokens: jnp.ndarray, k_pages: jnp.ndarray,
+                v_pages: jnp.ndarray, page_table: jnp.ndarray,
+                seq_lens: jnp.ndarray):
+    """One continuous-batching step: tokens [B] int32 (last emitted or
+    last prompt token per slot), cache = per-layer TUPLES of
+    [P,KV,page,D] arrays (a pytree, never re-stacked: each layer's
+    scatter update aliases its own buffer in place under jit/scan —
+    stacking into one [L,...] array would copy the whole cache every
+    step). Returns (next_logits [B,V] f32, k_pages, v_pages)."""
+    embed = params["embedding"]
+    x = embed.astype(cfg.dtype)[tokens]          # [B, Dm]
+    positions = seq_lens                          # this token's position
+    new_k, new_v = [], []
+    for i in range(cfg.n_layers):
+        x, kp, vp = _decode_layer(params[f"layer_{i}"], cfg, x, positions,
+                                  k_pages[i], v_pages[i], page_table,
+                                  seq_lens)
+        new_k.append(kp)
+        new_v.append(vp)
+    x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps)
+    logits = jnp.einsum("bd,vd->bv", x, embed.astype(cfg.dtype))
+    return (logits.astype(jnp.float32), tuple(new_k), tuple(new_v))
+
+
+def decode_chunk(params: Dict[str, Any], cfg: TransformerConfig,
+                 tokens: jnp.ndarray, k_pages: jnp.ndarray,
+                 v_pages: jnp.ndarray, page_table: jnp.ndarray,
+                 seq_lens: jnp.ndarray, *, n_steps: int):
+    """n_steps greedy decode steps in ONE jitted program (lax.scan with
+    argmax feedback). Returns (tokens [n_steps, B] int32, next_tokens
+    [B], next_lens [B], k_pages, v_pages): the feedback state comes
+    back as DEVICE arrays so the engine can chain chunks without a
+    host round trip — on a remote/tunneled chip the dispatch RTT is
+    orders of magnitude above the device time (measured 0.2 ms/chunk
+    compute vs ~1 s RTT), so chunks pipeline asynchronously and the
+    host syncs only when a request completes."""
+    def body(carry, _):
+        toks, kp, vp, lens = carry
+        logits, kp, vp = decode_step(params, cfg, toks, kp, vp,
+                                     page_table, lens)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, kp, vp, lens + 1), nxt
+
+    carry, outs = jax.lax.scan(body,
+                               (tokens, k_pages, v_pages, seq_lens),
+                               None, length=n_steps)
+    toks, k_out, v_out, lens = carry
+    return outs, toks, lens, k_out, v_out
+
+
+# ----------------------------------------------------------------------
+# the engine
+# ----------------------------------------------------------------------
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "future", "out")
+
+    def __init__(self, prompt: List[int], max_new: int):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.future: Future = Future()
+        self.out: List[int] = []
+
+
+class _Slot:
+    __slots__ = ("req", "pages", "seq_len", "last_token")
+
+    def __init__(self):
+        self.req: Optional[_Request] = None
+        self.pages: List[int] = []
+        self.seq_len = 0
+        self.last_token = 0
+
+
+class InferenceEngine:
+    """Continuous-batching decode loop over a paged KV cache."""
+
+    def __init__(self, params: Dict[str, Any], model_cfg: TransformerConfig,
+                 cfg: InferenceConfig = InferenceConfig()):
+        if "params" in params and "embedding" not in params:
+            params = params["params"]
+        self.params = params
+        self.mcfg = model_cfg
+        self.cfg = cfg
+        L = model_cfg.n_layers
+        KV, D = model_cfg.n_kv_heads, model_cfg.head_dim
+        # per-layer tuple (pytree), NOT a stacked [L,...] array: in-place
+        # scatter updates per layer under the donated decode program
+        self._k_pages = tuple(
+            jnp.zeros((cfg.num_pages, KV, cfg.page_size, D),
+                      model_cfg.dtype) for _ in range(L))
+        self._v_pages = tuple(
+            jnp.zeros((cfg.num_pages, KV, cfg.page_size, D),
+                      model_cfg.dtype) for _ in range(L))
+        # the LAST physical page is the parking page for idle decode
+        # slots (their dummy K/V appends land there), never allocated
+        self._free_pages: List[int] = list(range(cfg.num_pages - 1))
+        self._slots = [_Slot() for _ in range(cfg.batch_size)]
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._shutdown = False
+        self.num_steps = 0
+        self.max_concurrent = 0
+
+        # params are ARGUMENTS of the jitted programs, never closed-over
+        # constants (a closure would bake every weight into the HLO as a
+        # literal — catastrophic compile times at real model sizes).
+        # The cache is donated: each step updates it in place on device.
+        mcfg = self.mcfg
+        # chunked decode programs (1, 2, 4, ... decode_chunk steps per
+        # dispatch); the loop picks the largest chunk no active slot's
+        # remaining budget forbids
+        self._chunk_sizes = []
+        n = 1
+        while n <= max(1, cfg.decode_chunk):
+            self._chunk_sizes.append(n)
+            n *= 2
+        self._decode_chunks = {}
+        for steps in self._chunk_sizes:
+            fn = jax.jit(
+                lambda p, toks, kp, vp, table, lens, _n=steps:
+                decode_chunk(p, mcfg, toks, kp, vp, table, lens,
+                             n_steps=_n),
+                donate_argnums=(2, 3))
+            self._decode_chunks[steps] = \
+                (lambda *a, _f=fn: _f(self.params, *a))
+        # one jitted program per bucket: forward + ALL cache-page writes
+        # + next-token argmax in a single dispatch (eager per-layer
+        # writes would cost a dispatch each — dominating admission)
+        def prefill_write(p, toks, kp, vp, pages, plen):
+            logits, k_seq, v_seq = prefill(p, mcfg, toks)
+            new_k, new_v = [], []
+            for i in range(mcfg.n_layers):
+                ki, vi = write_prefill_kv(kp[i], vp[i], k_seq[i],
+                                          v_seq[i], pages)
+                new_k.append(ki)
+                new_v.append(vi)
+            nxt = jnp.argmax(logits[plen - 1]).astype(jnp.int32)
+            return nxt, tuple(new_k), tuple(new_v)
+
+        prefill_fn = jax.jit(prefill_write, donate_argnums=(2, 3))
+        self._prefills = {
+            b: (lambda toks, kp, vp, pages, plen, _f=prefill_fn:
+                _f(self.params, toks, kp, vp, pages, plen))
+            for b in cfg.prefill_buckets
+        }
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ray_tpu_llm_engine")
+        self._thread.start()
+
+    # -- API -----------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None) -> Future:
+        """Returns a Future resolving to the GENERATED token list."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        max_new = (self.cfg.max_new_tokens if max_new_tokens is None
+                   else max_new_tokens)
+        if max_new <= 0:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if len(prompt) + max_new > self.cfg.max_context:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new({max_new}) exceeds the "
+                f"engine's max context {self.cfg.max_context}")
+        if len(prompt) > max(self.cfg.prefill_buckets):
+            raise ValueError(
+                f"prompt longer than the largest prefill bucket "
+                f"{max(self.cfg.prefill_buckets)}")
+        req = _Request(list(prompt), max_new)
+        self._queue.put(req)
+        self._wake.set()
+        return req.future
+
+    def generate(self, prompt: Sequence[int],
+                 max_new_tokens: Optional[int] = None,
+                 timeout: float = 600.0) -> List[int]:
+        return self.submit(prompt, max_new_tokens).result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "num_steps": self.num_steps,
+                "max_concurrent": self.max_concurrent,
+                "free_pages": len(self._free_pages),
+                "active": sum(s.req is not None for s in self._slots),
+                "queued": self._queue.qsize(),
+            }
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self._wake.set()
+        self._thread.join(timeout=5.0)
+        self._fail_outstanding(RuntimeError("engine shut down"))
+
+    def _fail_outstanding(self, exc: BaseException) -> None:
+        """Resolve every in-flight and queued Future exceptionally —
+        a dead engine must never leave callers blocking to timeout."""
+        for s in self._slots:
+            req, s.req = s.req, None
+            if req is not None:
+                with self._lock:
+                    self._free_pages.extend(s.pages)
+                s.pages = []
+                s.seq_len = 0
+                if not req.future.done():
+                    req.future.set_exception(exc)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(exc)
+
+    # -- internals ------------------------------------------------------
+    def _pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.cfg.page_size)
+
+    def _try_admit(self) -> None:
+        while True:
+            free_slot = next((s for s in self._slots if s.req is None),
+                             None)
+            if free_slot is None or self._queue.empty():
+                return
+            req = self._queue.queue[0]
+            total = len(req.prompt) + req.max_new
+            need = self._pages_needed(total)
+            with self._lock:
+                if need > len(self._free_pages):
+                    return  # head-of-line blocks until pages free
+                self._queue.get_nowait()
+                pages = [self._free_pages.pop() for _ in range(need)]
+            self._prefill_into(free_slot, req, pages)
+
+    def _prefill_into(self, slot: _Slot, req: _Request,
+                      pages: List[int]) -> None:
+        plen = len(req.prompt)
+        bucket = next(b for b in sorted(self.cfg.prefill_buckets)
+                      if b >= plen)
+        padded = req.prompt + [0] * (bucket - plen)
+        # the program writes bucket//page_size pages: the sequence's own
+        # pages where allocated (pad rows beyond the prompt are
+        # DON'T-CARE — appends overwrite them slot by slot, attention
+        # masks by seq_len), the parking page past its allocation
+        n_prog_pages = -(-bucket // self.cfg.page_size)
+        page_list = (pages + [self._parking_page] * n_prog_pages)[
+            :n_prog_pages]
+        nxt, self._k_pages, self._v_pages = self._prefills[bucket](
+            jnp.asarray([padded], jnp.int32), self._k_pages,
+            self._v_pages, jnp.asarray(page_list, jnp.int32),
+            jnp.asarray(plen, jnp.int32))
+        slot.req = req
+        slot.pages = pages
+        slot.seq_len = plen
+        slot.last_token = int(nxt)
+        req.out.append(slot.last_token)
+        self._maybe_finish(slot)
+
+    def _maybe_finish(self, slot: _Slot) -> None:
+        req = slot.req
+        if self.cfg.eos_id is not None and self.cfg.eos_id in req.out:
+            # EOS may land mid-chunk: trim the overrun (its KV appends
+            # stayed within the pages reserved for max_new)
+            req.out = req.out[:req.out.index(self.cfg.eos_id) + 1]
+            done = True
+        else:
+            done = len(req.out) >= req.max_new
+        if done:
+            with self._lock:
+                self._free_pages.extend(slot.pages)
+            slot.req = None
+            slot.pages = []
+            slot.seq_len = 0
+            req.future.set_result(req.out)
+
+    def _page_table(self) -> np.ndarray:
+        table = np.zeros((self.cfg.batch_size, self.cfg.max_pages_per_seq),
+                         np.int32)
+        for i, s in enumerate(self._slots):
+            for j, p in enumerate(s.pages):
+                table[i, j] = p
+        return table
+
+    def _loop(self) -> None:
+        while not self._shutdown:
+            try:
+                self._loop_once()
+            except Exception as e:  # noqa: BLE001
+                # a dispatch/compile failure (OOM, bad config) must not
+                # silently kill the engine thread with futures parked
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "inference engine step failed")
+                self._fail_outstanding(e)
+
+    def _loop_once(self) -> None:
+            self._try_admit()
+            active = [s for s in self._slots if s.req is not None]
+            if not active:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                return
+            self.max_concurrent = max(self.max_concurrent, len(active))
+            # upload the decode state once per BURST (admission and
+            # completion both sync, so host bookkeeping is authoritative
+            # here); within the burst the feedback state stays on device
+            tokens = np.zeros(self.cfg.batch_size, np.int32)
+            lens = np.zeros(self.cfg.batch_size, np.int32)
+            for i, s in enumerate(self._slots):
+                if s.req is not None:
+                    tokens[i] = s.last_token
+                    lens[i] = s.seq_len
+            # idle slots decode dummy tokens whose K/V appends land in
+            # the reserved parking page; their outputs are discarded
+            table = self._page_table()
+            for i, s in enumerate(self._slots):
+                if s.req is None:
+                    table[i, :] = self._parking_page
+            dev_toks = jnp.asarray(tokens)
+            dev_lens = jnp.asarray(lens)
+            dev_table = jnp.asarray(table)
+
+            # async burst: dispatch chunks back-to-back WITHOUT reading
+            # results (jax dispatch is async; on a remote chip the
+            # round-trip dwarfs the 0.2 ms of device work per chunk).
+            # The host materializes tokens only when some request's
+            # budget is exhausted — or per-chunk when EOS detection is
+            # configured (early exit needs the values).
+            inflight = {id(s): 0 for s in active}
+            pending: List[Tuple[Any, int]] = []
+            while True:
+                remaining = min(
+                    s.req.max_new - len(s.req.out) - inflight[id(s)]
+                    for s in active)
+                if remaining <= 0 or len(pending) >= 4:
+                    break
+                chunk = max(c for c in self._chunk_sizes
+                            if c <= remaining)
+                (outs, dev_toks, dev_lens, self._k_pages,
+                 self._v_pages) = self._decode_chunks[chunk](
+                     dev_toks, self._k_pages, self._v_pages, dev_table,
+                     dev_lens)
+                self.num_steps += 1
+                pending.append((outs, chunk))
+                for s in active:
+                    inflight[id(s)] += chunk
+                    s.seq_len += chunk
+                if self.cfg.eos_id is not None:
+                    break  # EOS needs the values: one chunk per burst
+
+            for outs, chunk in pending:
+                arr = np.asarray(outs)         # [chunk, B] (sync point)
+                for i, s in enumerate(self._slots):
+                    if s.req is None or id(s) not in inflight:
+                        continue
+                    s.req.out.extend(int(t) for t in arr[:, i])
+                    s.last_token = int(arr[-1, i])
+            for s in active:
+                if s.req is not None:
+                    self._maybe_finish(s)
+
+    @property
+    def _parking_page(self) -> int:
+        return self.cfg.num_pages - 1

@@ -45,6 +45,11 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False          # jax.checkpoint each block (HBM vs FLOPs)
+    # selective checkpointing: save matmul outputs, recompute only the
+    # cheap elementwise ops — most of remat's memory win at a fraction
+    # of its recompute cost ("dots" = jax.checkpoint_policies
+    # .dots_with_no_batch_dims_saveable; "full" recomputes everything)
+    remat_policy: str = "full"   # "full" | "dots"
     # sequence/context parallelism: ring attention over the mesh's `seq`
     # axis (ray_tpu/ops/ring_attention.py). Takes effect when the model
     # runs under parallel.mesh.use_mesh(mesh) with seq > 1.
@@ -265,7 +270,11 @@ class Transformer(nn.Module):
 
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
+            policy = None
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.\
+                    dots_with_no_batch_dims_saveable
+            block = nn.remat(Block, static_argnums=(), policy=policy)
         for i in range(cfg.n_layers):
             x = block(cfg, name=f"layer_{i}")(x, positions, mask)
 

@@ -1,0 +1,60 @@
+"""LLM serving deployment: the inference engine behind Serve.
+
+Reference surface: the reference framework's LLM serving integration
+(serve + vLLM-style engine: each replica hosts one engine; requests
+stream through the router into the engine's continuous-batching loop).
+Here each Serve replica owns an InferenceEngine
+(models/inference.py — paged KV cache + Pallas paged attention), so
+router-level scaling (replicas) composes with engine-level batching
+(slots): two independent throughput axes, as in the reference stack.
+
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_llm_app
+
+    app = build_llm_app(params, model_cfg, engine_cfg)
+    handle = serve.run(app)
+    tokens = ray_tpu.get(handle.generate.remote([1, 2, 3], 16))
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu.models.inference import InferenceConfig, InferenceEngine
+from ray_tpu.serve.core import Application, deployment
+
+
+@deployment(name="llm")
+class LLMDeployment:
+    """One engine per replica; generate() joins the replica's
+    continuous batch and returns the generated token list."""
+
+    def __init__(self, params: Any, model_cfg: Any,
+                 engine_cfg: Optional[InferenceConfig] = None):
+        self._engine = InferenceEngine(params, model_cfg,
+                                       engine_cfg or InferenceConfig())
+
+    def generate(self, prompt: Sequence[int],
+                 max_new_tokens: Optional[int] = None,
+                 timeout: float = 600.0) -> List[int]:
+        """timeout bounds queue-wait + generation on this replica (a
+        full continuous batch admits the request only when a slot
+        frees)."""
+        return self._engine.generate(list(prompt), max_new_tokens,
+                                     timeout=timeout)
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return self._engine.stats()
+
+    def __del__(self):
+        try:
+            self._engine.shutdown()
+        except Exception:
+            pass
+
+
+def build_llm_app(params: Any, model_cfg: Any,
+                  engine_cfg: Optional[InferenceConfig] = None,
+                  num_replicas: int = 1) -> Application:
+    return LLMDeployment.options(num_replicas=num_replicas).bind(
+        params, model_cfg, engine_cfg)

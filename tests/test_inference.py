@@ -1,0 +1,179 @@
+"""LLM inference engine: paged attention + continuous batching.
+
+Parity strategy (SURVEY.md §4 style): the paged-cache decode path must
+produce EXACTLY the greedy tokens of the naive full-context forward
+(the flax Transformer re-run on the whole sequence each step) — same
+params, tiny config. The Pallas kernel itself is parity-tested against
+the XLA gather reference in test_paged_attention below.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models.inference import (InferenceConfig,  # noqa: E402
+                                      InferenceEngine, decode_step,
+                                      prefill)
+from ray_tpu.models.transformer import (Transformer,  # noqa: E402
+                                        TransformerConfig)
+from ray_tpu.ops import paged_attention as pa  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=128, dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, variables["params"]
+
+
+def naive_greedy(model, params, prompt, n_new):
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = model.apply({"params": params},
+                             jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+class TestPagedAttention:
+    def test_kernel_matches_reference(self):
+        rng = np.random.default_rng(0)
+        B, H, KV, D, page, P, MP = 3, 8, 4, 32, 8, 16, 4
+        q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+        kp = jnp.asarray(rng.normal(size=(P, KV, page, D)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(P, KV, page, D)), jnp.float32)
+        table = jnp.asarray(rng.integers(0, P, size=(B, MP)), jnp.int32)
+        lens = jnp.asarray([5, 17, 32], jnp.int32)
+        ref = pa.paged_attention_reference(q, kp, vp, table, lens)
+        ker = pa.paged_attention(q, kp, vp, table, lens, interpret=True)
+        np.testing.assert_allclose(ref, ker, atol=1e-5)
+
+    def test_zero_length_sequence(self):
+        B, H, KV, D, page, P, MP = 2, 4, 2, 16, 4, 8, 2
+        q = jnp.ones((B, H, D))
+        kp = jnp.ones((P, KV, page, D))
+        vp = jnp.ones((P, KV, page, D))
+        table = jnp.zeros((B, MP), jnp.int32)
+        lens = jnp.asarray([0, 3], jnp.int32)
+        out = pa.paged_attention(q, kp, vp, table, lens, interpret=True)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(out[1], 1.0, atol=1e-5)
+
+    def test_append_token(self):
+        rng = np.random.default_rng(1)
+        B, KV, D, page, P, MP = 2, 2, 8, 4, 6, 3
+        kp = jnp.zeros((P, KV, page, D))
+        vp = jnp.zeros((P, KV, page, D))
+        table = jnp.asarray([[1, 2, 0], [3, 4, 5]], jnp.int32)
+        lens = jnp.asarray([5, 0], jnp.int32)
+        kn = jnp.asarray(rng.normal(size=(B, KV, D)), jnp.float32)
+        vn = jnp.asarray(rng.normal(size=(B, KV, D)), jnp.float32)
+        k2, _v2 = pa.append_token_kv(kp, vp, kn, vn, table, lens)
+        # seq 0: logical page 5//4=1 -> phys 2, slot 1
+        np.testing.assert_allclose(k2[2, :, 1, :], kn[0])
+        # seq 1: logical page 0 -> phys 3, slot 0
+        np.testing.assert_allclose(k2[3, :, 0, :], kn[1])
+
+
+class TestFunctionalForwardParity:
+    def test_prefill_matches_flax(self, tiny_model):
+        cfg, model, params = tiny_model
+        toks = jnp.asarray([[5, 9, 2, 40, 7, 1, 33, 12]], jnp.int32)
+        flax_logits = model.apply({"params": params}, toks)[0]
+        fn_logits, k_seq, v_seq = prefill(params, cfg, toks)
+        np.testing.assert_allclose(fn_logits, flax_logits, atol=2e-4)
+        assert k_seq.shape == (cfg.n_layers, 8, cfg.n_kv_heads,
+                               cfg.head_dim)
+
+    def test_paged_decode_matches_full_forward(self, tiny_model):
+        cfg, model, params = tiny_model
+        icfg = InferenceConfig(batch_size=2, page_size=4,
+                               max_pages_per_seq=8, num_pages=32,
+                               prefill_buckets=(8, 16))
+        engine = InferenceEngine(params, cfg, icfg)
+        try:
+            for prompt in ([3, 14, 15, 9, 2], [1, 2]):
+                got = engine.generate(prompt, max_new_tokens=8)
+                want = naive_greedy(model, params, prompt, 8)
+                assert got == want, (prompt, got, want)
+        finally:
+            engine.shutdown()
+
+
+class TestContinuousBatching:
+    def test_more_requests_than_slots(self, tiny_model):
+        cfg, _model, params = tiny_model
+        icfg = InferenceConfig(batch_size=2, page_size=4,
+                               max_pages_per_seq=8, num_pages=16,
+                               prefill_buckets=(8,))
+        engine = InferenceEngine(params, cfg, icfg)
+        try:
+            futs = [engine.submit([i + 1, i + 2], max_new_tokens=6)
+                    for i in range(5)]
+            outs = [f.result(timeout=120) for f in futs]
+            assert all(len(o) == 6 for o in outs)
+            st = engine.stats()
+            assert st["active"] == 0 and st["queued"] == 0
+            assert engine.max_concurrent <= 2
+            # all pages returned to the pool
+            assert st["free_pages"] == icfg.num_pages - 1
+        finally:
+            engine.shutdown()
+
+    def test_ragged_prompts_decode_together(self, tiny_model):
+        cfg, model, params = tiny_model
+        icfg = InferenceConfig(batch_size=3, page_size=4,
+                               max_pages_per_seq=8, num_pages=32,
+                               prefill_buckets=(8, 16))
+        engine = InferenceEngine(params, cfg, icfg)
+        try:
+            prompts = [[7], [1, 2, 3, 4, 5, 6, 7, 8], [9, 9, 9]]
+            futs = [engine.submit(p, max_new_tokens=5) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+            for p, got in zip(prompts, outs):
+                assert got == naive_greedy(model, params, p, 5)
+        finally:
+            engine.shutdown()
+
+    def test_serve_llm_deployment(self, tiny_model):
+        cfg, model, params = tiny_model
+        import ray_tpu
+        from ray_tpu import serve
+        from ray_tpu.serve.llm import build_llm_app
+
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=4)
+        try:
+            icfg = InferenceConfig(batch_size=2, page_size=4,
+                                   max_pages_per_seq=8, num_pages=32,
+                                   prefill_buckets=(8,))
+            handle = serve.run(build_llm_app(params, cfg, icfg))
+            prompt = [4, 8, 15]
+            got = ray_tpu.get(handle.generate.remote(prompt, 5),
+                              timeout=120.0)
+            assert got == naive_greedy(model, params, prompt, 5)
+            st = ray_tpu.get(handle.engine_stats.remote())
+            assert st["active"] == 0
+        finally:
+            serve.shutdown()
+            ray_tpu.shutdown()
+
+    def test_rejects_oversized(self, tiny_model):
+        cfg, _model, params = tiny_model
+        icfg = InferenceConfig(batch_size=1, page_size=4,
+                               max_pages_per_seq=2, num_pages=8,
+                               prefill_buckets=(8,))
+        engine = InferenceEngine(params, cfg, icfg)
+        try:
+            with pytest.raises(ValueError, match="max context"):
+                engine.submit([1, 2, 3, 4], max_new_tokens=32)
+            with pytest.raises(ValueError, match="empty"):
+                engine.submit([])
+        finally:
+            engine.shutdown()
