@@ -55,11 +55,18 @@ class DAGNode:
         raise NotImplementedError
 
     def _resolve_args(self, bindings, via: str):
+        """Diamond-safe: a node consumed by several downstream nodes
+        executes ONCE per graph execution (results memoized in the
+        bindings map, keyed by node identity)."""
         args = []
         for a in self._args:
             if isinstance(a, DAGNode):
-                args.append(a._execute_remote(bindings) if via == "remote"
-                            else a._call_direct(bindings))
+                key = id(a)
+                if key not in bindings:
+                    bindings[key] = (a._execute_remote(bindings)
+                                     if via == "remote"
+                                     else a._call_direct(bindings))
+                args.append(bindings[key])
             else:
                 args.append(a)
         return args
@@ -118,6 +125,32 @@ class ActorMethodNode(DAGNode):
         # value (the channel analog — no intermediate store entries)
         args = self._resolve_args(bindings, "direct")
         return ray_tpu.get(self._method.remote(*args, **self._kwargs))
+
+
+class MultiOutputNode(DAGNode):
+    """Multiple graph outputs (reference: ray.dag.MultiOutputNode):
+    execute() returns a list, one value per bound output node."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__()
+        self._args = tuple(outputs)
+
+    def _execute_remote(self, bindings):
+        return self._resolve_args(bindings, "remote")
+
+    def _call_direct(self, bindings):
+        return self._resolve_args(bindings, "direct")
+
+    # interpreted path: resolve each output ref
+    def execute(self, *input_values) -> List[Any]:
+        refs = self._execute_remote(_bind_input(self, input_values))
+        return [ray_tpu.get(r) if _is_ref(r) else r for r in refs]
+
+
+def _is_ref(x) -> bool:
+    from ray_tpu import ObjectRef
+
+    return isinstance(x, ObjectRef)
 
 
 def _bind_input(root: DAGNode, input_values) -> Dict[int, Any]:

@@ -91,6 +91,52 @@ class TestCompiledDag:
         assert dag.execute(4) == 17  # interpreted path agrees
         ray_tpu.kill(m)
 
+    def test_diamond_executes_shared_node_once(self, rt):
+        from ray_tpu.dag import InputNode
+
+        calls = []
+
+        @ray_tpu.remote
+        def base(x):
+            calls.append(1)
+            return x + 1
+
+        @ray_tpu.remote
+        def left(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def right(x):
+            return x * 3
+
+        @ray_tpu.remote
+        def join(a, b):
+            return a + b
+
+        with InputNode() as inp:
+            shared = base.bind(inp)
+            dag = join.bind(left.bind(shared), right.bind(shared))
+        # (x+1)*2 + (x+1)*3 with base evaluated ONCE
+        assert dag.execute(4) == 25
+        assert len(calls) == 1
+
+    def test_multi_output_node(self, rt):
+        from ray_tpu.dag import InputNode, MultiOutputNode
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def square(x):
+            return x * x
+
+        with InputNode() as inp:
+            dag = MultiOutputNode([double.bind(inp), square.bind(inp)])
+        assert dag.execute(5) == [10, 25]
+        compiled = dag.experimental_compile()
+        assert list(compiled.execute(6)) == [12, 36]
+
     def test_compiled_faster_than_interpreted(self, rt):
         """The point of compilation: repeated small calls skip per-call
         scheduling/store overhead (reference: aDAG's pitch)."""
