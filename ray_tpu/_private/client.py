@@ -24,6 +24,7 @@ named lookup, kill)/cancel/cluster state verbs. Driver-side-only APIs
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import uuid
@@ -83,6 +84,12 @@ class ClientServer:
             if not (isinstance(msg, tuple) and len(msg) == 3):
                 break
             op, req_id, payload = msg
+            if op in ("release", "pin"):
+                # inline, not a thread: these never block, and a
+                # release followed by a re-pin of the same oid must be
+                # applied in wire order or the pin could land first
+                self._handle(s, op, req_id, payload)
+                continue
             # a THREAD per request (not a bounded pool): blocking
             # gets/waits with no timeout must never starve the
             # puts/submits that would unblock them
@@ -235,6 +242,12 @@ class ClientServer:
                 self._worker.reference_counter.remove_local_reference(oid)
         return True
 
+    def _op_pin(self, s, oid_bins: list) -> bool:
+        """Re-pin after a release raced with a client-side re-add."""
+        for b in oid_bins:
+            self._pin(s, ObjectID(b))
+        return True
+
     def _op_state(self, s, verb: str) -> Any:
         import ray_tpu
         if verb == "cluster_resources":
@@ -261,16 +274,36 @@ class ClientServer:
 
 class _ClientRC:
     """Client-local refcounts; the server holds one pin per id until the
-    client's last local ref dies (then a release is sent)."""
+    client's last local ref dies (then a release is sent).
+
+    Race guarded here: a thread deserializing another ref to an oid
+    whose release was just sent would otherwise re-create the local
+    count with no server pin behind it. Releases are sent UNDER the
+    lock and recently released oids are remembered; a 0->1 re-add of a
+    released oid sends a re-pin, and the lock orders the two sends on
+    the wire (the server handles release/pin inline, in arrival
+    order). Best-effort: if the server drops its LAST reference in the
+    release..pin window the object is gone and a later get() raises
+    ObjectLostError — the same outcome as losing the race without the
+    guard, never silent corruption. The released-set is a bounded LRU
+    (the race window is milliseconds; remembering the recent tail is
+    enough, and an unbounded set would leak an entry per dead oid)."""
+
+    _RELEASED_CAP = 4096
 
     def __init__(self, cw: "ClientWorker"):
         self._cw = cw
         self._counts: Dict[ObjectID, int] = {}
+        self._released: "collections.OrderedDict[ObjectID, None]" = \
+            collections.OrderedDict()
         self._lock = threading.Lock()
 
     def add_local_reference(self, oid: ObjectID) -> None:
         with self._lock:
-            self._counts[oid] = self._counts.get(oid, 0) + 1
+            n = self._counts.get(oid, 0) + 1
+            self._counts[oid] = n
+            if n == 1 and self._released.pop(oid, False) is None:
+                self._cw._pin(oid)
 
     def remove_local_reference(self, oid: ObjectID) -> None:
         with self._lock:
@@ -279,7 +312,11 @@ class _ClientRC:
                 self._counts[oid] = n
                 return
             self._counts.pop(oid, None)
-        self._cw._release(oid)
+            self._released[oid] = None
+            self._released.move_to_end(oid)
+            while len(self._released) > self._RELEASED_CAP:
+                self._released.popitem(last=False)
+            self._cw._release(oid)
 
     def add_owned_object(self, oid, **kw) -> None:  # client owns nothing
         pass
@@ -321,12 +358,15 @@ class ClientWorker:
         while True:
             try:
                 msg = self._conn.recv()
+                # a malformed reply must kill the session loudly (alive
+                # False + waiters woken), not this thread silently —
+                # otherwise every pending and future _rpc hangs forever
+                req_id, ok, data = msg
             except (EOFError, OSError, TypeError, ValueError):
                 self.alive = False
                 for ev, _slot in list(self._replies.values()):
                     ev.set()
                 return
-            req_id, ok, data = msg
             slot = self._replies.pop(req_id, None)
             if slot is not None:
                 slot[1][:] = [ok, data]
@@ -358,7 +398,8 @@ class ClientWorker:
             raise cloudpickle.loads(data)
         return data
 
-    def _release(self, oid: ObjectID) -> None:
+    def _send_oneway(self, op: str, oid: ObjectID) -> None:
+        """Fire-and-forget op: no reply wait (reader drops unmatched)."""
         if not self.alive:
             return
         try:
@@ -366,11 +407,16 @@ class ClientWorker:
                 self._req_seq += 1
                 req_id = self._req_seq
             with self._send_lock:
-                self._conn.send(("release", req_id, ([oid.binary()],)))
-            # fire-and-forget: no reply wait (reader drops unmatched)
-            self._replies.pop(req_id, None)
+                self._conn.send((op, req_id, ([oid.binary()],)))
         except (OSError, ValueError):
             pass
+
+    def _release(self, oid: ObjectID) -> None:
+        self._send_oneway("release", oid)
+
+    def _pin(self, oid: ObjectID) -> None:
+        """Re-pin of a released oid being re-added (see _ClientRC)."""
+        self._send_oneway("pin", oid)
 
     # -- context helpers (provisional; the server re-keys) -------------
     def next_task_id(self) -> TaskID:

@@ -226,8 +226,19 @@ class RemoteNodePool(ProcessWorkerPool):
                 # either: an actor's kill/exit travels h.conn, but
                 # completions for OTHER workers (which a get may await)
                 # come through other queues — only same-worker ordering
-                # matters, and a worker blocks in its rpc anyway
-                self._rpc_pool.submit(self._handle_worker_msg, h, msg)
+                # matters, and a worker blocks in its rpc anyway.
+                # Indefinitely-blocking ops get a dedicated thread (like
+                # ClientServer._serve): dedicated actor workers spawn
+                # beyond num_workers, so a bounded pool could fill with
+                # blocked get/wait calls and deadlock the put/submit
+                # that would unblock them.
+                if msg[2] in ("get", "wait"):
+                    threading.Thread(
+                        target=self._handle_worker_msg, args=(h, msg),
+                        daemon=True,
+                        name=f"ray_tpu_remote_rpc_w{h.worker_num}").start()
+                else:
+                    self._rpc_pool.submit(self._handle_worker_msg, h, msg)
             else:
                 self._handle_worker_msg(h, msg)
 

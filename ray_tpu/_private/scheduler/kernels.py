@@ -358,6 +358,32 @@ def _assign_class_traced(members, d, avail, cap, threshold, n_nodes, batch_cap,
     seq_nodes = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                  order.astype(jnp.int32)])
     seq_counts = jnp.concatenate([t0[None], fit_rest[order]])
+    if spread is not None:
+        # True round-robin parity with the numpy path under SPREAD:
+        # water-fill the load-ordered nodes — every node takes
+        # min(fit, t) with t the number of full round-robin rounds, and
+        # the first r nodes still holding capacity take one extra
+        # (r = tasks left in the final partial round). Per-node COUNTS
+        # match the numpy round-robin exactly; only the task->node
+        # interleaving differs (tasks of one class are interchangeable).
+        fit_o = fit[order]
+        k_tasks = jnp.minimum(k.astype(jnp.int32), fit_o.sum())
+        lo = jnp.int32(0)
+        hi = jnp.int32(batch_cap)
+        for _ in range(int(batch_cap).bit_length() + 1):
+            mid = (lo + hi + 1) // 2
+            ok_mid = jnp.minimum(fit_o, mid).sum() <= k_tasks
+            lo = jnp.where(ok_mid, mid, lo)
+            hi = jnp.where(ok_mid, hi, mid - 1)
+        base = jnp.minimum(fit_o, lo)
+        rem = k_tasks - base.sum()
+        can_more = fit_o > lo
+        extra = can_more & (jnp.cumsum(can_more) <= rem)
+        rr_counts = base + extra.astype(jnp.int32)
+        seq_counts = jnp.where(
+            spread,
+            jnp.concatenate([jnp.zeros((1,), jnp.int32), rr_counts]),
+            seq_counts)
     cum = jnp.cumsum(seq_counts)
     total = cum[-1]
     # Segment lookup without any [C, N] materialization: ``rank`` is

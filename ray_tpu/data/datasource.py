@@ -185,7 +185,11 @@ def from_arrow(table) -> Dataset:
 
 def _write_blocks(ds: Dataset, path: str, ext: str, write_fn) -> List[str]:
     """Materialize, then one write task per block (the reference's
-    Datasink.write: tasks write their block and return the path)."""
+    Datasink.write: tasks write their block and return the path).
+
+    Write paths must live on storage shared by all nodes when the
+    cluster has remote nodes — each write task creates the directory on
+    whatever machine it runs on."""
     import ray_tpu
 
     os.makedirs(path, exist_ok=True)
@@ -193,6 +197,7 @@ def _write_blocks(ds: Dataset, path: str, ext: str, write_fn) -> List[str]:
 
     @ray_tpu.remote
     def write_block(block, out_path, _w=write_fn):
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
         _w(block, out_path)
         return out_path
 

@@ -421,6 +421,37 @@ class TestManyClasses:
         assert (node_np == node_jx).all()
         assert np.allclose(avail_np, avail_jx, atol=1e-4)
 
+    def test_spread_round_robin_parity(self):
+        """SPREAD: the jax water-filling path must land the same per-node
+        COUNTS as the numpy true round-robin (task interleaving may
+        differ; tasks of one class are interchangeable)."""
+        rng = np.random.default_rng(11)
+        for trial in range(6):
+            N = int(rng.integers(2, 9))
+            C = int(rng.integers(1, 64))
+            demands = np.asarray([[1, 0, 0, 0]], dtype=np.float32)
+            cls = np.zeros(C, dtype=np.int32)
+            cap = np.zeros((N, 4), dtype=np.float32)
+            cap[:, 0] = rng.integers(1, 32, size=N)
+            avail = cap.copy()
+            # uneven starting load so argsort order is non-trivial
+            avail[:, 0] -= rng.integers(0, 2, size=N)
+            avail[:, 0] = np.maximum(avail[:, 0], 0)
+            spread = np.ones(1, dtype=bool)
+
+            node_np, avail_np = kernels.assign_np(
+                np.arange(C), cls, demands, avail.copy(), cap, 0.5,
+                class_spread=spread)
+            node_jx, avail_jx = kernels.jax_assign(
+                cls, demands, avail.copy(), cap, 0.5,
+                class_spread=spread)
+
+            counts_np = np.bincount(node_np[node_np >= 0], minlength=N)
+            counts_jx = np.bincount(node_jx[node_jx >= 0], minlength=N)
+            assert (counts_np == counts_jx).all(), (
+                trial, counts_np, counts_jx)
+            assert np.allclose(avail_np, avail_jx, atol=1e-4)
+
     def test_class_bucket_no_recompile(self):
         """Growing the class count within a power-of-two bucket reuses the
         same compiled program (jax_assign pads the class axis)."""
