@@ -66,19 +66,25 @@ def main() -> int:
 
     # --- e2e task throughput through the public API --------------------
     e2e = {}
+    budgets = {}
     n_thread = 2_000 if smoke else 50_000
-    n_proc = 500 if smoke else 5_000
+    n_proc = 500 if smoke else 20_000
     for mode, n in (("thread", n_thread), ("process", n_proc)):
         try:
             r = perf.e2e_task_throughput(n_tasks=n, mode=mode,
                                          scheduler="tensor")
             e2e[mode] = round(r["tasks_per_sec"], 1)
+            budgets[mode] = dict(r["budget_us"],
+                                 tasks_per_tick=r["tasks_per_tick"])
             print(f"  e2e[{mode}]: {r['tasks_per_sec']:.0f} tasks/s "
-                  f"({n} tasks in {r['seconds']:.2f}s)", file=sys.stderr)
+                  f"({n} tasks in {r['seconds']:.2f}s; "
+                  f"budget {r['budget_us']} us/task, "
+                  f"{r['tasks_per_tick']} tasks/tick)", file=sys.stderr)
         except Exception:
             traceback.print_exc()
             e2e[mode] = None
     out["e2e_tasks_per_sec"] = e2e
+    out["e2e_budget_us"] = budgets
 
     # --- Data library: 100k-block map_batches pipeline -----------------
     try:

@@ -329,6 +329,7 @@ class ClientWorker:
     """Installed as the global worker when init(address='ray://...')."""
 
     is_client = True
+    needs_serialized_funcs = True  # funcs ship to the server by value
 
     def __init__(self, host: str, port: int, authkey: bytes):
         from multiprocessing.connection import Client as _Connect
@@ -466,7 +467,7 @@ class ClientWorker:
     def submit_task(self, spec) -> List[ObjectRef]:
         d = dict(
             name=spec.name,
-            func_blob=cloudpickle.dumps(spec.func),
+            func_blob=spec.serialized_func or cloudpickle.dumps(spec.func),
             func_descriptor=spec.func_descriptor,
             args_blob=cloudpickle.dumps((spec.args, spec.kwargs), protocol=5),
             num_returns=spec.num_returns,

@@ -115,6 +115,13 @@ _d = GLOBAL_CONFIG.define
 # -- core ------------------------------------------------------------------
 _d("num_workers", int, 0, "worker threads/processes; 0 = os.cpu_count()")
 _d("worker_mode", str, "thread", "worker execution backend: thread | process")
+_d("worker_tpu_access", bool, False,
+   "give process workers the TPU plugin bootstrap (default: the head "
+   "owns the chip; workers run CPU jax, starting seconds faster)")
+_d("worker_pipeline_depth", int, 0,
+   "max tasks in flight per process-worker pipe (lease pipelining, "
+   "reference: max_tasks_in_flight_per_worker); 0 = auto from the "
+   "worker-count / host-core ratio (1 on unoversubscribed hosts)")
 _d("inline_object_max_bytes", int, 100 * 1024,
    "objects at or under this size are stored in the owner's in-process "
    "memory store (reference inlines <100KB into task specs)")

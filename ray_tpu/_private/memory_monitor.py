@@ -104,15 +104,15 @@ class MemoryMonitor:
                 continue  # remote workers don't consume HEAD host memory
             with pool._lock:
                 handles = list(pool._handles)
-            for h in handles:
-                pending = h.busy
-                if pending is None or h.dead:
-                    continue
-                started = getattr(h, "_started_at", 0.0)
-                if newest is None or started > newest[0]:
-                    newest = (started, h)
+                for h in handles:
+                    if h.dead or not h.inflight:
+                        continue
+                    # newest LEASE on this worker (the last pipelined task)
+                    exec_id, inf = next(reversed(h.inflight.items()))
+                    if newest is None or inf.started_at > newest[0]:
+                        newest = (inf.started_at, h, exec_id)
         if newest is not None:
-            h = newest[1]
+            _, h, exec_id = newest
 
             def kill(h=h):
                 h.oom_kill = True
@@ -121,7 +121,7 @@ class MemoryMonitor:
                 except Exception:
                     pass
 
-            return h.exec_task_id, kill
+            return exec_id, kill
         # thread mode: a thread cannot be forced to release memory, and
         # the cooperative cancel flag would surface as a NON-retriable
         # TaskCancelledError (or do nothing once user code is running) —

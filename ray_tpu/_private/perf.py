@@ -48,7 +48,10 @@ def e2e_task_throughput(n_tasks: int = 10_000, mode: str = "thread",
     Measures the full path: RemoteFunction._remote -> Worker.submit ->
     scheduler tick -> dispatch -> execution -> result store -> get.
     """
+    import resource
+
     import ray_tpu
+    from ray_tpu._private import worker as worker_mod
 
     ray_tpu.shutdown()
     sys_cfg = {"worker_mode": mode}
@@ -62,19 +65,36 @@ def e2e_task_throughput(n_tasks: int = 10_000, mode: str = "thread",
         # Warm the pool / caches (process mode: function-blob push, worker
         # spin-up) so the measurement is steady-state.
         ray_tpu.get([_noop.remote() for _ in range(min(200, n_tasks))])
+        if mode == "process":
+            time.sleep(2.0)  # let late worker imports finish competing
 
+        sched = worker_mod.global_worker.scheduler
+        ticks0 = getattr(sched, "_num_ticks", 0)
+        ru0 = resource.getrusage(resource.RUSAGE_SELF)
         t0 = time.perf_counter()
         refs = [_noop.remote() for _ in range(n_tasks)]
+        t_submit = time.perf_counter() - t0
         ray_tpu.get(refs)
         dt = time.perf_counter() - t0
+        ru1 = resource.getrusage(resource.RUSAGE_SELF)
+        ticks = getattr(sched, "_num_ticks", 0) - ticks0
     finally:
         ray_tpu.shutdown()
+    driver_cpu = (ru1.ru_utime - ru0.ru_utime) + (ru1.ru_stime - ru0.ru_stime)
     return {
         "n_tasks": n_tasks,
         "mode": mode,
         "scheduler": scheduler,
         "seconds": dt,
         "tasks_per_sec": n_tasks / dt,
+        # per-task host-overhead budget (microseconds)
+        "budget_us": {
+            "submit": round(t_submit / n_tasks * 1e6, 1),
+            "driver_cpu_total": round(driver_cpu / n_tasks * 1e6, 1),
+            "wall_total": round(dt / n_tasks * 1e6, 1),
+        },
+        "sched_ticks": ticks,
+        "tasks_per_tick": round(n_tasks / max(ticks, 1), 1),
     }
 
 

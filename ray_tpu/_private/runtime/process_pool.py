@@ -62,6 +62,16 @@ class RemotePlaceholder:
 _PLACEHOLDER = ShmPlaceholder()
 
 
+def auto_pipeline_depth(num_workers: int) -> int:
+    """Lease-pipeline depth for a pool of num_workers processes: the
+    configured value, or (auto) the worker/core oversubscription ratio
+    capped at 8 — 1 on hosts with a core per worker."""
+    depth = GLOBAL_CONFIG.worker_pipeline_depth
+    if depth <= 0:
+        depth = max(1, min(8, -(-num_workers // (os.cpu_count() or 1))))
+    return depth
+
+
 class _RefCollectPickler(cloudpickle.Pickler):
     """cloudpickle that records every ObjectRef crossing the boundary so
     the owner can register borrows (reference: ReferenceCounter borrower
@@ -84,11 +94,25 @@ def _dumps_collect_refs(value: Any) -> Tuple[bytes, List[ObjectRef]]:
     return f.getvalue(), contained
 
 
+class _InFlight:
+    """One task leased onto a worker's pipe (the worker executes its
+    pipe FIFO; several may be in flight per worker — the reference's
+    lease pipelining, ray: NormalTaskSubmitter max_tasks_in_flight)."""
+
+    __slots__ = ("pending", "return_ids", "borrows", "started_at")
+
+    def __init__(self, pending: PendingTask, return_ids: List[ObjectID]):
+        self.pending = pending
+        self.return_ids = return_ids
+        self.borrows: Set[ObjectID] = set()
+        self.started_at = time.monotonic()
+
+
 class _Handle:
     __slots__ = ("worker_num", "proc", "conn", "ctrl", "worker_id", "pid",
-                 "busy", "exec_task_id", "return_ids", "borrows",
-                 "sent_fns", "dead", "force_cancelled", "send_lock",
-                 "ready", "actor_rt", "oom_kill", "_started_at")
+                 "inflight", "borrows",
+                 "sent_fns", "dead", "force_cancel_id", "send_lock",
+                 "ready", "actor_rt", "oom_kill")
 
     def __init__(self, worker_num: int):
         self.actor_rt = None  # set for dedicated actor workers
@@ -98,15 +122,14 @@ class _Handle:
         self.ctrl = None
         self.worker_id = WorkerID.from_random()
         self.pid: Optional[int] = None
-        self.busy: Optional[PendingTask] = None
-        self.exec_task_id: Optional[TaskID] = None
+        # exec task_id -> _InFlight, in send (= execution) order
+        self.inflight: "collections.OrderedDict[TaskID, _InFlight]" = \
+            collections.OrderedDict()
         self.oom_kill = False         # memory monitor killed this worker
-        self._started_at = 0.0        # current task's start time
-        self.return_ids: List[ObjectID] = []
-        self.borrows: Set[ObjectID] = set()
+        self.borrows: Set[ObjectID] = set()  # actor-runtime bookkeeping
         self.sent_fns: Set[bytes] = set()
         self.dead = False
-        self.force_cancelled = False
+        self.force_cancel_id: Optional[TaskID] = None
         self.send_lock = threading.Lock()
         self.ready = False
 
@@ -132,6 +155,13 @@ class ProcessWorkerPool:
         self._worker_seq = 0
         self._inline_max = GLOBAL_CONFIG.inline_object_max_bytes
         self._inject_prob = GLOBAL_CONFIG.testing_inject_task_failure_prob
+        # lease pipelining (reference: NormalTaskSubmitter
+        # max_tasks_in_flight_per_worker + ReportWorkerBacklog): several
+        # tasks ride one worker pipe so a wakeup executes a batch. Depth
+        # auto-scales with core oversubscription — on hosts with >= one
+        # core per worker it stays 1 (pure spread, lowest latency); on
+        # small hosts packing beats fake parallelism.
+        self._pipeline_depth = auto_pipeline_depth(num_workers)
         # children exec `python -m ...worker_process` and dial back here
         # (reference: raylet execs default_worker.py; registration over a
         # unix socket) — never fork/spawn of this process, whose jax/TPU
@@ -143,14 +173,27 @@ class ProcessWorkerPool:
 
     def _start_transport(self) -> None:
         """Local transport: a unix socket the exec'd workers dial back
-        to (remote pools talk to a node daemon instead)."""
+        to (remote pools talk to a node daemon instead). ONE demux
+        thread multiplexes every worker pipe (connection.wait) instead
+        of a reader thread per worker: on small hosts the per-task
+        thread ping-pong, not the pipe itself, is the dominant cost,
+        and a single drain point lets completions batch into one
+        scheduler wakeup (the reference's lease-return batching)."""
+        import socket
+
         self._authkey = os.urandom(16)
         self._sock_dir = tempfile.mkdtemp(prefix="ray_tpu_pool_")
         self._listener = Listener(
             address=os.path.join(self._sock_dir, "pool.sock"),
             family="AF_UNIX", authkey=self._authkey)
+        self._demux_conns: Dict[Any, _Handle] = {}
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="ray_tpu_pool_accept").start()
+        threading.Thread(target=self._demux_loop, daemon=True,
+                         name=f"ray_tpu_pool_demux_{self.node_index}"
+                         ).start()
 
     # ------------------------------------------------------------------
     # worker lifecycle
@@ -166,6 +209,15 @@ class ProcessWorkerPool:
         env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in sys.path if p) + os.pathsep + env.get("PYTHONPATH", "")
+        if not GLOBAL_CONFIG.worker_tpu_access:
+            # the HEAD owns the accelerator (single-chip lease; same
+            # stance as the reference's GPU ownership via resources) —
+            # worker processes skip the site-level TPU plugin bootstrap,
+            # which costs seconds of import and a device-lease fight,
+            # and fall back to CPU jax if a task imports jax at all
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            if env.get("JAX_PLATFORMS", "").lower() in ("axon", ""):
+                env["JAX_PLATFORMS"] = "cpu"
         h.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.runtime.worker_process",
              self._listener.address, self._shm.arena.name,
@@ -202,9 +254,11 @@ class ProcessWorkerPool:
                 continue
             if kind == "task":
                 h.conn = conn
-                threading.Thread(target=self._reader_loop, args=(h,),
-                                 daemon=True,
-                                 name=f"ray_tpu_pool_reader_{num}").start()
+                self._demux_conns[conn] = h
+                try:
+                    self._wake_w.send(b"w")
+                except OSError:
+                    pass
             else:
                 h.ctrl = conn
 
@@ -301,6 +355,47 @@ class ProcessWorkerPool:
     # submission (called from the driver's dispatch thread pool)
     # ------------------------------------------------------------------
     def run_task(self, pending: PendingTask) -> None:
+        payload = self._prepare_payload(pending)
+        if payload is None:
+            return
+        with self._lock:
+            if self._shutdown:
+                return
+            h = self._pick_worker_locked()
+            if h is None:
+                self._queue.append((pending, payload))
+                return
+        self._assign(h, pending, payload)
+
+    def run_task_batch(self, pendings: List[PendingTask]) -> None:
+        """One tick's lease grants for this node in one pass: payloads
+        build back to back, each worker receives ALL its tasks in a
+        single pipe message (one wakeup, one preemption — the per-send
+        context switch was the dominant cost of the one-at-a-time
+        path on oversubscribed hosts)."""
+        built: List[tuple] = []  # (pending, payload)
+        for pending in pendings:
+            payload = self._prepare_payload(pending)
+            if payload is not None:
+                built.append((pending, payload))
+        if not built:
+            return
+        per_handle: Dict[_Handle, list] = {}
+        with self._lock:
+            if self._shutdown:
+                return
+            for pending, payload in built:
+                h = self._pick_worker_locked()
+                if h is None:
+                    self._queue.append((pending, payload))
+                else:
+                    per_handle.setdefault(h, []).append((pending, payload))
+        for h, items in per_handle.items():
+            self._assign_many(h, items)
+
+    def _prepare_payload(self, pending: PendingTask) -> Optional[dict]:
+        """run_task's build/error half: a payload ready to lease, or
+        None if the task already resolved to an error/requeue."""
         spec = pending.spec
         exec_task_id = spec.task_id
         return_ids = (getattr(spec, "_retry_return_ids", None)
@@ -310,9 +405,9 @@ class ProcessWorkerPool:
                 f"task {spec.name} dispatched to a dead node")
             retry = self._worker._handle_task_failure(spec, return_ids, exc)
             self._finish_task(pending, exec_task_id, retry)
-            return
+            return None
         try:
-            payload, borrows = self._build_payload(spec, return_ids)
+            return self._build_payload(spec, return_ids)[0]
         except _RequeueDeps as e:
             from ray_tpu._private.worker import _top_level_deps
 
@@ -321,37 +416,82 @@ class ProcessWorkerPool:
             self._finish_task(pending, exec_task_id,
                               PendingTask(spec=spec, deps=list(e.oids),
                                           execute=lambda t, n: None))
-            return
+            return None
         except _DepError as e:
             self._worker._store_error(spec, return_ids, e.error)
             self._finish_task(pending, exec_task_id, None)
-            return
+            return None
         except Exception as e:  # unserializable task
             self._worker._store_error(
                 spec, return_ids,
                 rex.TaskError(spec.name, e, "task serialization failed"))
             self._finish_task(pending, exec_task_id, None)
-            return
-        with self._lock:
-            if self._shutdown:
-                return
-            if self._idle:
-                h = self._idle.popleft()
-            else:
-                self._queue.append((pending, payload))
-                return
-        self._assign(h, pending, payload)
+            return None
+
+    def _assign_many(self, h: _Handle, items: List[tuple]) -> None:
+        """Lease a run of tasks onto one worker with ONE pipe write."""
+        out = []
+        for pending, payload in items:
+            spec = pending.spec
+            contained = payload.pop("_contained")
+            inf = _InFlight(pending,
+                            [ObjectID(b) for b in payload["return_ids"]])
+            h.oom_kill = False
+            for oid in contained:
+                self._worker.reference_counter.add_borrower(oid, h.worker_id)
+                inf.borrows.add(oid)
+            with self._lock:
+                h.inflight[spec.task_id] = inf
+                self._by_task[spec.task_id] = h
+            self._worker.events.record(spec.task_id, spec.name, "started",
+                                       self.node_index)
+            out.append(payload)
+        try:
+            with h.send_lock:
+                # fn-blob strip under the send lock (see _assign)
+                for i, payload in enumerate(out):
+                    if payload["fn_id"] in h.sent_fns:
+                        out[i] = dict(payload, fn_blob=None)
+                    else:
+                        h.sent_fns.add(payload["fn_id"])
+                h.conn.send(("tasks", out))
+        except (OSError, ValueError) as e:
+            self._on_worker_failure(h, e)
+
+    def _pick_worker_locked(self) -> Optional[_Handle]:
+        """Lease target for one task: an IDLE worker first (true
+        process concurrency — tasks that sleep or block must overlap),
+        then, at depth > 1, the least-loaded busy worker with pipe room
+        (the backlog pipelines instead of round-tripping the
+        scheduler)."""
+        if self._idle:
+            return self._idle.popleft()
+        if self._pipeline_depth <= 1:
+            return None
+        best = None
+        best_n = self._pipeline_depth
+        for h in self._handles:
+            if h.dead or not h.ready or h.actor_rt is not None:
+                continue
+            n = len(h.inflight)
+            if 0 < n < best_n:
+                best, best_n = h, n
+        return best
 
     def _build_payload(self, spec: TaskSpec,
                        return_ids: List[ObjectID]) -> Tuple[dict, list]:
         args = tuple(self._resolve_for_ship(a) for a in spec.args)
         kwargs = {k: self._resolve_for_ship(v) for k, v in spec.kwargs.items()}
         args_blob, contained = _dumps_collect_refs((args, kwargs))
-        fn_blob = cloudpickle.dumps(spec.func)
+        fn_blob = spec.serialized_func
+        fn_id = spec.func_id
+        if fn_blob is None:
+            fn_blob = cloudpickle.dumps(spec.func)
+            fn_id = fn_id_of(fn_blob)
         payload = dict(
             task_id=spec.task_id.binary(),
             name=spec.name,
-            fn_id=fn_id_of(fn_blob),
+            fn_id=fn_id,
             fn_blob=fn_blob,
             args_blob=args_blob,
             num_returns=spec.num_returns,
@@ -399,27 +539,27 @@ class ProcessWorkerPool:
     def _assign(self, h: _Handle, pending: PendingTask, payload: dict) -> None:
         spec = pending.spec
         contained = payload.pop("_contained")
-        h.busy = pending
-        h.exec_task_id = spec.task_id
-        h.return_ids = [ObjectID(b) for b in payload["return_ids"]]
-        h.force_cancelled = False
+        inf = _InFlight(pending, [ObjectID(b) for b in payload["return_ids"]])
         h.oom_kill = False   # stale flag must not mislabel later deaths
-        h._started_at = time.monotonic()
         # register borrows for refs crossing into the worker BEFORE the
         # task can observe them
         for oid in contained:
             self._worker.reference_counter.add_borrower(oid, h.worker_id)
-            h.borrows.add(oid)
+            inf.borrows.add(oid)
         with self._lock:
+            h.inflight[spec.task_id] = inf
             self._by_task[spec.task_id] = h
         self._worker.events.record(spec.task_id, spec.name, "started",
                                    self.node_index)
-        if payload["fn_id"] in h.sent_fns:
-            payload = dict(payload, fn_blob=None)
-        else:
-            h.sent_fns.add(payload["fn_id"])
         try:
+            # fn-blob strip decided under the SEND lock: sends to one
+            # handle serialize here, so check-then-strip cannot race a
+            # concurrent sender into shipping fn_blob=None first
             with h.send_lock:
+                if payload["fn_id"] in h.sent_fns:
+                    payload = dict(payload, fn_blob=None)
+                else:
+                    h.sent_fns.add(payload["fn_id"])
                 h.conn.send(("task", payload))
         except (OSError, ValueError) as e:
             self._on_worker_failure(h, e)
@@ -427,14 +567,79 @@ class ProcessWorkerPool:
     # ------------------------------------------------------------------
     # reader: completions + worker-initiated RPC
     # ------------------------------------------------------------------
-    def _reader_loop(self, h: _Handle) -> None:
-        while True:
+    def _demux_loop(self) -> None:
+        """Single reader over all worker pipes. Completions found in one
+        wait cycle batch into one result-store pass + one scheduler
+        wakeup. Blocking worker RPCs (get/wait) jump to their own
+        thread — a worker issuing one is itself blocked, so per-worker
+        ordering holds; everything else is handled inline."""
+        from multiprocessing.connection import wait as _conn_wait
+
+        while not self._shutdown:
+            conns = list(self._demux_conns)
             try:
-                msg = h.conn.recv()
-            except (EOFError, OSError):
-                self._on_worker_failure(h, None)
-                return
-            self._handle_worker_msg(h, msg)
+                ready = _conn_wait([self._wake_r] + conns, timeout=0.5)
+            except OSError:
+                ready = []  # a conn died under wait; next pass drops it
+            dones: List[tuple] = []
+            for c in ready:
+                if c is self._wake_r:
+                    try:
+                        self._wake_r.recv(4096)
+                    except (BlockingIOError, OSError):
+                        pass
+                    continue
+                h = self._demux_conns.get(c)
+                if h is None:
+                    continue
+                while True:
+                    try:
+                        msg = c.recv()
+                    except (EOFError, OSError):
+                        self._demux_conns.pop(c, None)
+                        self._on_worker_failure(h, None)
+                        break
+                    kind = msg[0]
+                    if kind == "many":
+                        # a worker's buffered batch completions
+                        for sub in msg[1]:
+                            if sub[0] == "done" and h.actor_rt is None:
+                                dones.append((h, TaskID(sub[1]), sub[2]))
+                            else:
+                                dones = self._flush_dones_safe(dones)
+                                self._handle_worker_msg(h, sub)
+                    elif kind == "done" and h.actor_rt is None:
+                        dones.append((h, TaskID(msg[1]), msg[2]))
+                    else:
+                        # per-worker message order is a protocol
+                        # invariant (e.g. an rpc_put's borrow attaches
+                        # to the OLDEST inflight lease): flush buffered
+                        # completions before any other message
+                        dones = self._flush_dones_safe(dones)
+                        if kind == "rpc" and msg[2] in ("get", "wait"):
+                            threading.Thread(
+                                target=self._handle_worker_msg,
+                                args=(h, msg), daemon=True,
+                                name=f"ray_tpu_pool_rpc_w{h.worker_num}"
+                            ).start()
+                        else:
+                            self._handle_worker_msg(h, msg)
+                    try:
+                        if not c.poll(0):
+                            break
+                    except (OSError, ValueError):
+                        break
+            self._flush_dones_safe(dones)
+
+    def _flush_dones_safe(self, dones: List[tuple]) -> List[tuple]:
+        """Process buffered completions; the demux thread must survive
+        any single bad completion (a dead demux hangs the whole pool)."""
+        if dones:
+            try:
+                self._on_done_batch(dones)
+            except Exception:
+                logger.exception("batched completion handling failed")
+        return []
 
     def _handle_worker_msg(self, h: _Handle, msg: tuple) -> None:
         """One worker->owner message (shared by the local per-worker
@@ -465,31 +670,33 @@ class ProcessWorkerPool:
             logger.exception("pool reader failed handling %s", kind)
 
     def _mark_idle(self, h: _Handle) -> None:
+        """Worker has pipe room: feed it from the queue or park it."""
         nxt = None
         with self._lock:
             if self._shutdown or h.dead:
                 return
             if self._queue:
                 nxt = self._queue.popleft()
-            else:
+            elif not h.inflight and h not in self._idle:
                 self._idle.append(h)
         if nxt is not None:
             self._assign(h, *nxt)
 
     def _release(self, h: _Handle, task_id: TaskID) -> None:
-        for oid in h.borrows:
-            self._worker.reference_counter.remove_borrower(oid, h.worker_id)
-        h.borrows = set()
-        h.busy = None
-        h.exec_task_id = None
         with self._lock:
+            inf = h.inflight.pop(task_id, None)
             self._by_task.pop(task_id, None)
+        if inf is not None:
+            for oid in inf.borrows:
+                self._worker.reference_counter.remove_borrower(
+                    oid, h.worker_id)
         self._mark_idle(h)
 
-    def store_result_entries(self, return_ids: List[ObjectID],
-                             entries: list) -> None:
+    def _store_entries(self, return_ids: List[ObjectID],
+                       entries: list) -> List[ObjectID]:
         """Seal + register worker-produced result locations under the
-        owner's ids (shm entries resolve lazily; inline deserialized)."""
+        owner's ids (shm entries resolve lazily; inline deserialized).
+        Returns the stored oids; the CALLER notifies the scheduler."""
         for oid, entry in zip(return_ids, entries):
             if entry[0] == "shm":
                 self._shm.seal(oid)
@@ -497,24 +704,79 @@ class ProcessWorkerPool:
             else:
                 value = deserialize(SerializedObject.from_bytes(entry[1]))
                 self._worker.memory_store.put(oid, value)
+        return return_ids
+
+    def store_result_entries(self, return_ids: List[ObjectID],
+                             entries: list) -> None:
+        for oid in self._store_entries(return_ids, entries):
             self._worker.scheduler.notify_object_ready(oid)
 
     def _on_done(self, h: _Handle, task_id: TaskID, entries: list) -> None:
-        pending, spec = h.busy, h.busy.spec
-        self.store_result_entries(h.return_ids, entries)
+        inf = h.inflight.get(task_id)
+        if inf is None:
+            return  # force-cancel raced the completion
+        pending, spec = inf.pending, inf.pending.spec
+        self.store_result_entries(inf.return_ids, entries)
         self._worker.task_manager.complete(spec.task_id)
         self._finish_task(pending, task_id, None)
         self._release(h, task_id)
 
+    def _on_done_batch(self, dones: List[tuple]) -> None:
+        """N completions -> one store pass + ONE scheduler wakeup
+        (object-ready and task-finished events delivered together via
+        notify_batch), then handle release/requeue per worker. The
+        inflight entry is POPPED under the pool lock up front so a
+        concurrent _on_worker_failure (monitor/tick threads) can never
+        double-handle a task as both completed and crashed."""
+        from ray_tpu._private.worker import _top_level_deps
+
+        ready_oids: List[ObjectID] = []
+        finished: List[tuple] = []
+        taken: List[tuple] = []
+        events = self._worker.events
+        with self._lock:
+            for h, task_id, entries in dones:
+                inf = h.inflight.pop(task_id, None)
+                if inf is None:
+                    continue  # force-cancel/failure raced the completion
+                self._by_task.pop(task_id, None)
+                taken.append((h, task_id, entries, inf))
+        for h, task_id, entries, inf in taken:
+            spec = inf.pending.spec
+            try:
+                ready_oids.extend(
+                    self._store_entries(inf.return_ids, entries))
+                self._worker.task_manager.complete(spec.task_id)
+                events.record(task_id, spec.name, "finished",
+                              self.node_index)
+                deps = _top_level_deps(spec.args, spec.kwargs)
+                if deps:
+                    self._worker.reference_counter \
+                        .remove_submitted_task_references(deps)
+            except Exception:
+                logger.exception("completion handling failed for %s",
+                                 spec.name)
+            finished.append((task_id, inf.pending.node_index,
+                             spec.resources))
+        self._worker.scheduler.notify_batch(ready_oids, finished)
+        for h, task_id, _entries, inf in taken:
+            for oid in inf.borrows:
+                self._worker.reference_counter.remove_borrower(
+                    oid, h.worker_id)
+            self._mark_idle(h)
+
     def _on_err(self, h: _Handle, task_id: TaskID, exc_blob: bytes,
                 tb: str) -> None:
-        pending, spec = h.busy, h.busy.spec
+        inf = h.inflight.get(task_id)
+        if inf is None:
+            return  # force-cancel raced the error
+        pending, spec = inf.pending, inf.pending.spec
         try:
             exc = cloudpickle.loads(exc_blob)
         except Exception:
             exc = RuntimeError("worker error (exception undeserializable)")
         exc._ray_tpu_traceback = tb
-        retry = self._worker._handle_task_failure(spec, h.return_ids, exc)
+        retry = self._worker._handle_task_failure(spec, inf.return_ids, exc)
         self._finish_task(pending, task_id, retry)
         self._release(h, task_id)
 
@@ -551,29 +813,36 @@ class ProcessWorkerPool:
             if not shutting_down and not was_dead:
                 h.actor_rt._on_process_died(h, cause)
             return
-        pending = h.busy
-        if pending is not None and not shutting_down:
-            spec = pending.spec
-            if h.force_cancelled:
-                exc: BaseException = rex.TaskCancelledError(h.exec_task_id)
-            elif h.oom_kill:
-                exc = rex.OutOfMemoryError(
-                    f"worker killed by the memory monitor while running "
-                    f"{spec.name} (host memory pressure)")
-            elif self._node_dead:
-                exc = rex.NodeDiedError(
-                    f"node died while running {spec.name}")
-            else:
-                exc = rex.WorkerCrashedError(
-                    f"worker process {h.pid} died while running "
-                    f"{spec.name}: {cause}")
-            retry = self._worker._handle_task_failure(spec, h.return_ids, exc)
-            self._finish_task(pending, h.exec_task_id, retry)
-            for oid in h.borrows:
-                self._worker.reference_counter.remove_borrower(
-                    oid, h.worker_id)
-            with self._lock:
-                self._by_task.pop(h.exec_task_id, None)
+        with self._lock:
+            inflight = list(h.inflight.items())
+            h.inflight.clear()
+        if inflight and not shutting_down:
+            # every task leased onto this worker's pipe dies with it;
+            # only the force-cancel TARGET gets the cancellation error,
+            # innocent pipelined neighbors fail retriably
+            for exec_id, inf in inflight:
+                spec = inf.pending.spec
+                if h.force_cancel_id == exec_id:
+                    exc: BaseException = rex.TaskCancelledError(exec_id)
+                elif h.oom_kill:
+                    exc = rex.OutOfMemoryError(
+                        f"worker killed by the memory monitor while "
+                        f"running {spec.name} (host memory pressure)")
+                elif self._node_dead:
+                    exc = rex.NodeDiedError(
+                        f"node died while running {spec.name}")
+                else:
+                    exc = rex.WorkerCrashedError(
+                        f"worker process {h.pid} died while running "
+                        f"{spec.name}: {cause}")
+                retry = self._worker._handle_task_failure(
+                    spec, inf.return_ids, exc)
+                self._finish_task(inf.pending, exec_id, retry)
+                for oid in inf.borrows:
+                    self._worker.reference_counter.remove_borrower(
+                        oid, h.worker_id)
+                with self._lock:
+                    self._by_task.pop(exec_id, None)
         if not shutting_down and not self._node_dead \
                 and not self._respawn_disabled:
             # replacement worker keeps the pool at capacity
@@ -599,6 +868,15 @@ class ProcessWorkerPool:
     def _rpc_create(self, h: _Handle, oid_bin: bytes, nbytes: int) -> int:
         return self._shm.create(ObjectID(oid_bin), nbytes)
 
+    def _task_borrows(self, h: _Handle) -> Set[ObjectID]:
+        """Borrow set of the task EXECUTING on h right now (= oldest
+        inflight lease; a worker only issues RPCs mid-execution). Falls
+        back to the handle set (dedicated actor workers)."""
+        with self._lock:
+            if h.inflight:
+                return next(iter(h.inflight.values())).borrows
+        return h.borrows
+
     def _rpc_put(self, h: _Handle, oid_bin: bytes, loc: tuple) -> bool:
         oid = ObjectID(oid_bin)
         self._worker.reference_counter.add_owned_object(oid)
@@ -606,7 +884,7 @@ class ProcessWorkerPool:
         # the task completes (driver-side refs appear if the ref is
         # returned, which deserializes and registers locally first)
         self._worker.reference_counter.add_borrower(oid, h.worker_id)
-        h.borrows.add(oid)
+        self._task_borrows(h).add(oid)
         if loc[0] == "shm":
             self._shm.seal(oid)
             self._worker.memory_store.put(oid, _PLACEHOLDER)
@@ -685,10 +963,11 @@ class ProcessWorkerPool:
             placement_group_capture_child_tasks=d.get("pg_capture", False),
         )
         refs = self._worker.submit_task(spec)
+        borrows = self._task_borrows(h)
         for r in refs:
             self._worker.reference_counter.add_borrower(
                 r.object_id(), h.worker_id)
-            h.borrows.add(r.object_id())
+            borrows.add(r.object_id())
         return [r.object_id().binary() for r in refs]
 
     # ------------------------------------------------------------------
@@ -718,7 +997,7 @@ class ProcessWorkerPool:
         if h is None:
             return False
         if force:
-            h.force_cancelled = True
+            h.force_cancel_id = task_id
             self._kill_handle(h)
         elif h.ctrl is not None:
             try:
@@ -757,6 +1036,10 @@ class ProcessWorkerPool:
         try:
             self._listener.close()
         except Exception:
+            pass
+        try:
+            self._wake_w.send(b"q")  # unblock the demux wait promptly
+        except OSError:
             pass
         try:
             os.rmdir(self._sock_dir)

@@ -358,7 +358,7 @@ class RemoteNodePool(ProcessWorkerPool):
         oid = ObjectID(oid_bin)
         self._worker.reference_counter.add_owned_object(oid)
         self._worker.reference_counter.add_borrower(oid, h.worker_id)
-        h.borrows.add(oid)
+        self._task_borrows(h).add(oid)
         self._worker.memory_store.put(oid, RemotePlaceholder(self.node_index))
         self._worker.gcs.object_location_add(oid, self.node_index)
         self._worker.scheduler.notify_object_ready(oid)

@@ -68,6 +68,8 @@ class ProcessWorkerContext:
     process, so user code in tasks can call the public API. Routes
     get/put/submit to the owner over the pipe RPC."""
 
+    needs_serialized_funcs = True  # nested submits ship funcs by value
+
     def __init__(self, runner: "_WorkerRunner"):
         self._runner = runner
         self.alive = True
@@ -162,7 +164,7 @@ def _dump_spec(spec) -> bytes:
     """Ship a TaskSpec for owner-side admission (func by value)."""
     d = dict(
         name=spec.name,
-        func_blob=cloudpickle.dumps(spec.func),
+        func_blob=spec.serialized_func or cloudpickle.dumps(spec.func),
         func_descriptor=spec.func_descriptor,
         args_blob=cloudpickle.dumps((spec.args, spec.kwargs)),
         num_returns=spec.num_returns,
@@ -190,23 +192,86 @@ class _WorkerRunner:
         self.cancelled: set = set()  # task_id binaries
         self._rpc_seq = 0
         self._rpc_lock = threading.RLock()
+        self._inbox: list = []  # tasks that arrived during a blocking rpc
+        self._done_buf: Optional[list] = None  # batch-mode completion buffer
+        # replies that arrived out of order: an OUTER task's get-reply
+        # can land while a NESTED task's rpc is waiting (see _run_nested)
+        self._pending_replies: Dict[int, tuple] = {}
         self._stop = False
+
+    def _emit(self, msg: tuple) -> None:
+        """Completion message: buffered during a leased batch (one pipe
+        write per batch, one owner wakeup), immediate otherwise."""
+        if self._done_buf is not None:
+            self._done_buf.append(msg)
+        else:
+            self.conn.send(msg)
+
+    def _flush_dones(self) -> None:
+        buf = self._done_buf
+        if not buf:
+            return
+        self._done_buf = []
+        if len(buf) == 1:
+            self.conn.send(buf[0])
+        else:
+            self.conn.send(("many", buf))
 
     # -- RPC to the owner --------------------------------------------------
     def rpc(self, op: str, args: tuple):
+        blocking = op in ("get", "wait")
         with self._rpc_lock:
+            # owner-side borrow bookkeeping attributes this rpc to the
+            # OLDEST unfinished lease: completions buffered for batch
+            # send must reach the owner first
+            self._flush_dones()
             self._rpc_seq += 1
             req_id = self._rpc_seq
             self.conn.send(("rpc", req_id, op, args))
             while True:
-                msg = self.conn.recv()
-                if msg[0] == "reply" and msg[1] == req_id:
+                if req_id in self._pending_replies:
+                    msg = self._pending_replies.pop(req_id)
+                else:
+                    msg = self.conn.recv()
+                if msg[0] == "reply":
+                    if msg[1] != req_id:
+                        self._pending_replies[msg[1]] = msg
+                        continue
                     ok, data = msg[2], msg[3]
                     if not ok:
                         raise cloudpickle.loads(data)
                     return data
+                if msg[0] in ("task", "tasks"):
+                    if blocking:
+                        # a pipelined task queued BEHIND a task that is
+                        # blocked waiting (possibly on that very task's
+                        # result) would deadlock the pipe — execute it
+                        # NOW, nested, like the reference's blocked-get
+                        # worker reuse (ray: CPU release during ray.get)
+                        self._run_nested(msg)
+                    else:
+                        self._inbox.append(msg)
+                    continue
+                if msg[0] in ("actor_create", "actor_call", "exit"):
+                    # queue for the main loop (arrival order preserved)
+                    self._inbox.append(msg)
+                    continue
                 # protocol violation — only replies may arrive mid-task
                 raise RuntimeError(f"unexpected message during rpc: {msg[0]}")
+
+    def _run_nested(self, msg: tuple) -> None:
+        """Execute task(s) while an outer task blocks in get/wait.
+        Completions ship immediately (the outer task may be waiting on
+        them); task context saves/restores around each execution."""
+        buf, self._done_buf = self._done_buf, None
+        try:
+            if msg[0] == "task":
+                self.execute(msg[1])
+            else:
+                for p in msg[1]:
+                    self.execute(p)
+        finally:
+            self._done_buf = buf
 
     # -- value movement ----------------------------------------------------
     def store_value(self, oid: ObjectID, value: Any) -> tuple:
@@ -286,6 +351,10 @@ class _WorkerRunner:
         from ray_tpu import exceptions as rex
 
         task_id = TaskID(payload["task_id"])
+        # save/restore: a task may execute NESTED inside another task's
+        # blocking get (see _run_nested)
+        prev_task_id = self.current_task_id
+        prev_put_counter = self.put_counter
         self.current_task_id = task_id
         self.put_counter = 0
         pg_token = None
@@ -327,7 +396,7 @@ class _WorkerRunner:
             return_ids = [ObjectID(b) for b in payload["return_ids"]]
             entries = [self.store_value(oid, v)
                        for oid, v in zip(return_ids, values)]
-            self.conn.send(("done", payload["task_id"], entries))
+            self._emit(("done", payload["task_id"], entries))
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
             try:
@@ -335,7 +404,7 @@ class _WorkerRunner:
             except Exception:
                 blob = cloudpickle.dumps(
                     RuntimeError(f"[unpicklable {type(e).__name__}] {e}"))
-            self.conn.send(("err", payload["task_id"], blob, tb))
+            self._emit(("err", payload["task_id"], blob, tb))
         finally:
             if env_saved is not None:
                 import os as _os
@@ -350,7 +419,8 @@ class _WorkerRunner:
 
                 _current_pg.reset(pg_token)
             self.cancelled.discard(task_id.binary())
-            self.current_task_id = None
+            self.current_task_id = prev_task_id
+            self.put_counter = prev_put_counter
 
     def _resolve(self, v: Any) -> Any:
         if isinstance(v, _ShmValue):
@@ -375,13 +445,32 @@ class _WorkerRunner:
                          name="ray_tpu_worker_ctrl").start()
         self.conn.send(("ready", os.getpid()))
         while not self._stop:
-            try:
-                msg = self.conn.recv()
-            except (EOFError, OSError):
-                return
+            if self._inbox:
+                msg = self._inbox.pop(0)
+            else:
+                try:
+                    msg = self.conn.recv()
+                except (EOFError, OSError):
+                    return
             kind = msg[0]
             if kind == "task":
                 self.execute(msg[1])
+            elif kind == "tasks":
+                # a leased batch: execute in order, completions buffered
+                # and shipped in chunks (an rpc from any task flushes
+                # early to keep owner-side ordering). Chunked — not
+                # end-of-batch — flushing lets the owner process
+                # completions and refill this pipe while the rest of the
+                # batch is still executing.
+                self._done_buf = []
+                try:
+                    for p in msg[1]:
+                        self.execute(p)
+                        if len(self._done_buf) >= 16:
+                            self._flush_dones()
+                finally:
+                    self._flush_dones()
+                    self._done_buf = None
             elif kind == "actor_create":
                 self.actor_create(msg[1])
             elif kind == "actor_call":

@@ -42,6 +42,16 @@ class SchedulerBase:
         """Resources released on the node that ran the task."""
         raise NotImplementedError
 
+    def notify_batch(self, ready_objects: List[ObjectID],
+                     finished: List[tuple]) -> None:
+        """Deliver many object-ready + task-finished events with one
+        wakeup (completion batching on the hot path; ``finished`` rows
+        are (task_id, node_index, resources) tuples). Default: loop."""
+        for oid in ready_objects:
+            self.notify_object_ready(oid)
+        for task_id, node_index, resources in finished:
+            self.notify_task_finished(task_id, node_index, resources)
+
     def cancel(self, task_id: TaskID) -> bool:
         """Remove a queued task. Returns True if it had not started."""
         raise NotImplementedError

@@ -27,11 +27,13 @@ from ray_tpu._private.task_spec import custom_resources, resources_to_vector
 
 class NodeState:
     __slots__ = ("capacity", "available", "node_id", "pg_id", "bundle_index",
-                 "parent", "defunct", "custom", "custom_avail")
+                 "parent", "defunct", "custom", "custom_avail",
+                 "window_factor")
 
     def __init__(self, capacity: Tuple[float, ...], node_id=None,
                  pg_id=None, bundle_index: int = -1, parent: int = -1,
-                 custom_resources: Optional[Dict[str, float]] = None):
+                 custom_resources: Optional[Dict[str, float]] = None,
+                 window_factor: int = 1):
         self.capacity = list(capacity)
         self.available = list(capacity)
         self.node_id = node_id
@@ -51,6 +53,14 @@ class NodeState:
         # removed PG whose in-flight tasks haven't finished: remaining
         # capacity returns to the parent as each task releases
         self.defunct = False
+        # dispatch window (reference: the raylet's local dispatch queue
+        # + ReportWorkerBacklog): simple CPU-only tasks may be leased to
+        # this node up to window_factor x cpu-capacity OUTSTANDING, the
+        # excess queueing at the node's pool; real concurrency stays
+        # bounded by the pool's worker processes. 1 = strict (no
+        # over-dispatch). Only >1 for process-pool nodes on
+        # oversubscribed hosts.
+        self.window_factor = window_factor
 
     @property
     def is_bundle(self) -> bool:

@@ -52,6 +52,10 @@ class TensorScheduler(SchedulerBase):
                  store_contains: Optional[Callable[[ObjectID], bool]] = None,
                  initial_capacity: int = 4096):
         self._dispatch = dispatcher
+        # batch lease-grant path: a dispatcher OBJECT may expose
+        # dispatch_many(list) so one tick's grants ship per-worker in
+        # single pipe messages (plain callables dispatch one at a time)
+        self._dispatch_many = getattr(dispatcher, "dispatch_many", None)
         self._store_contains = store_contains or (lambda oid: False)
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -60,6 +64,12 @@ class TensorScheduler(SchedulerBase):
         self._cap = np.zeros((0, n_res), dtype=np.float32)
         self._avail = np.zeros((0, n_res), dtype=np.float32)
         self._node_states: List[NodeState] = []
+        # dispatch-window bookkeeping (reference: raylet dispatch queue):
+        # outstanding = dispatched-not-finished per node; win_cap > 0
+        # lets simple CPU tasks lease beyond avail up to that many
+        # outstanding, queueing at the node's pool
+        self._outstanding = np.zeros(0, dtype=np.int64)
+        self._win_cap = np.zeros(0, dtype=np.int64)
         for n in nodes:
             self._append_node(n)
 
@@ -68,6 +78,10 @@ class TensorScheduler(SchedulerBase):
         self._indeg = np.zeros(c, dtype=np.int32)
         self._cls = np.zeros(c, dtype=np.int32)
         self._node_of = np.full(c, -1, dtype=np.int32)
+        # True for slots leased through the dispatch window: they hold a
+        # pool-queue position, not node resources, so completion must
+        # not release what was never charged
+        self._windowed = np.zeros(c, dtype=bool)
         self._free: collections.deque = collections.deque(range(c))
 
         self._tasks: Dict[int, PendingTask] = {}       # slot -> task
@@ -87,6 +101,10 @@ class TensorScheduler(SchedulerBase):
         # named custom demands per class (per-name feasibility lives in
         # the eligibility masks; the demand MATRIX keeps a fixed width)
         self._class_custom: List[Dict[str, float]] = []
+        # dispatch-window eligibility per class: plain CPU<=1 demand,
+        # default/spread placement, no named resources — the shape whose
+        # real concurrency bound is "one worker pipe each"
+        self._class_window_ok: List[bool] = []
         self._class_mask = np.zeros((0, 0), dtype=bool)
         self._class_spread = np.zeros(0, dtype=bool)
         self._mask_dirty = False
@@ -129,6 +147,13 @@ class TensorScheduler(SchedulerBase):
         with self._wake:
             self._finish_q.append((task_id, node_index, resources))
             self._num_finished += 1
+            self._wake.notify()
+
+    def notify_batch(self, ready_objects, finished) -> None:
+        with self._wake:
+            self._ready_obj_q.extend(ready_objects)
+            self._finish_q.extend(finished)
+            self._num_finished += len(finished)
             self._wake.notify()
 
     def cancel(self, task_id: TaskID) -> bool:
@@ -192,13 +217,20 @@ class TensorScheduler(SchedulerBase):
             self._wake.notify()
         self._tick_thread.join(timeout=2.0)
 
-    def pending_entries(self) -> List[Tuple[Any, List[ObjectID]]]:
+    def pending_entries(self, started=None) -> List[Tuple[Any, List[ObjectID]]]:
         """(spec, unresolved deps) for every not-yet-dispatched task —
-        the resubmittable half of a control-plane snapshot."""
+        the resubmittable half of a control-plane snapshot. ``started``
+        (task_id -> bool) lets the caller also reclaim window-leased
+        slots that are still queued behind a worker (leased != running
+        for a dispatch-window grant)."""
         with self._lock:
             out = []
             for slot, task in self._tasks.items():
                 if self._state[slot] == WAITING:
+                    out.append((task.spec, list(task.deps)))
+                elif (self._windowed[slot] and started is not None
+                      and self._state[slot] == RUNNING
+                      and not started(task.spec.task_id)):
                     out.append((task.spec, list(task.deps)))
             out.extend((t.spec, list(t.deps)) for t in self._submit_q)
             return out
@@ -320,6 +352,13 @@ class TensorScheduler(SchedulerBase):
             av[0, i] = v
         self._avail = np.concatenate([self._avail, av], axis=0)
         self._node_states.append(node)
+        self._outstanding = np.concatenate(
+            [self._outstanding, np.zeros(1, dtype=np.int64)])
+        win = 0
+        if node.window_factor > 1 and not node.is_bundle:
+            win = int(node.window_factor * max(vec[0, 0], 1.0))
+        self._win_cap = np.concatenate(
+            [self._win_cap, np.asarray([win], dtype=np.int64)])
         self._mask_dirty = True
         return len(self._node_states) - 1
 
@@ -454,12 +493,18 @@ class TensorScheduler(SchedulerBase):
                                 ready_idx, decisions)
                 except Exception:
                     logger.exception("scheduler assignment failed")
-            for task in to_dispatch:
+            if to_dispatch and self._dispatch_many is not None:
                 try:
-                    self._dispatch(task)
+                    self._dispatch_many(to_dispatch)
                 except Exception:
-                    logger.exception("dispatch failed for %s",
-                                     task.spec.task_id)
+                    logger.exception("batch dispatch failed")
+            else:
+                for task in to_dispatch:
+                    try:
+                        self._dispatch(task)
+                    except Exception:
+                        logger.exception("dispatch failed for %s",
+                                         task.spec.task_id)
 
     def _drain_events_locked(self):
         self._num_ticks += 1
@@ -486,6 +531,11 @@ class TensorScheduler(SchedulerBase):
                 custom = custom_resources(spec.resources)
                 self._class_place.append(place)
                 self._class_custom.append(custom)
+                self._class_window_ok.append(
+                    not custom
+                    and place in (("default",), ("spread",))
+                    and d[0, 0] <= 1.0
+                    and not d[0, 1:].any())
                 self._append_class_mask_locked(place, custom)
             self._cls[slot] = cidx
             pending_deps = []
@@ -511,8 +561,15 @@ class TensorScheduler(SchedulerBase):
         while self._finish_q:
             task_id, node_index, resources = self._finish_q.popleft()
             slot = self._slot_of.get(task_id)
+            was_windowed = False
             if slot is not None and self._state[slot] == RUNNING:
+                was_windowed = bool(self._windowed[slot])
+                if 0 <= node_index < len(self._node_states):
+                    self._outstanding[node_index] = max(
+                        self._outstanding[node_index] - 1, 0)
                 self._release_slot(slot)
+            if was_windowed:
+                continue  # a window lease held no node resources
             if 0 <= node_index < len(self._node_states):
                 vec = np.asarray(resources_to_vector(resources),
                                  dtype=np.float32)[:self._cap.shape[1]]
@@ -736,12 +793,62 @@ class TensorScheduler(SchedulerBase):
             self._state[slot] = RUNNING
             self._node_of[slot] = node
             self._avail[node] -= demand
+            self._outstanding[node] += 1
             task.node_index = node
             ns.allocate(tuple(demand.tolist()))
             ns.allocate_custom(custom)
             self._num_dispatched += 1
             out.append(task)
+        self._window_pass(ready_idx, node_of_ready, out)
         return out
+
+    def _window_pass(self, ready_idx, node_of_ready,
+                     out: List[PendingTask]) -> None:
+        """Dispatch-window leases (reference: the raylet's dispatch
+        queue + worker backlog): ready tasks of simple CPU classes that
+        found no free capacity may still lease onto a node whose
+        OUTSTANDING count is under its window, queueing at the node's
+        pool. No resources are charged (the pool's worker processes
+        bound real concurrency); the slot is flagged so completion
+        releases nothing."""
+        if not self._win_cap.any():
+            return
+        room = self._win_cap - self._outstanding
+        alive = self._cap[:, 0] > 0
+        for i, ns in enumerate(self._node_states):
+            if ns.defunct or ns.is_bundle:
+                alive[i] = False
+        room = np.where(alive, room, 0)
+        total_room = int(room.sum())
+        if total_room <= 0:
+            return
+        unassigned = np.flatnonzero(np.asarray(node_of_ready) < 0)
+        if len(unassigned) == 0:
+            return
+        # node sequence with one entry per open window position
+        nodes_seq = np.repeat(np.arange(len(room)), np.maximum(room, 0))
+        taken = 0
+        for pos in unassigned:
+            if taken >= total_room:
+                break
+            slot = int(ready_idx[pos])
+            if self._state[slot] != WAITING:
+                continue
+            if not self._class_window_ok[self._cls[slot]]:
+                continue
+            task = self._tasks.get(slot)
+            if task is None or task.cancelled:
+                self._release_slot(slot)
+                continue
+            node = int(nodes_seq[taken])
+            taken += 1
+            self._state[slot] = RUNNING
+            self._node_of[slot] = node
+            self._windowed[slot] = True
+            self._outstanding[node] += 1
+            task.node_index = node
+            self._num_dispatched += 1
+            out.append(task)
 
     # -- slot lifecycle ----------------------------------------------------
     def _alloc_slot(self) -> int:
@@ -756,10 +863,13 @@ class TensorScheduler(SchedulerBase):
                 [self._cls, np.zeros(old, dtype=np.int32)])
             self._node_of = np.concatenate(
                 [self._node_of, np.full(old, -1, dtype=np.int32)])
+            self._windowed = np.concatenate(
+                [self._windowed, np.zeros(old, dtype=bool)])
             self._free.extend(range(old, new))
         return self._free.popleft()
 
     def _release_slot(self, slot: int) -> None:
+        self._windowed[slot] = False
         self._tasks.pop(slot, None)
         tid = self._tid_of.pop(slot, None)
         if tid is not None and self._slot_of.get(tid) == slot:

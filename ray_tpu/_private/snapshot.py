@@ -22,7 +22,24 @@ FORMAT_VERSION = 1
 def save_cluster_state(worker, path: str) -> Dict[str, Any]:
     """Snapshot control-plane tables + scheduler state to ``path``."""
     gcs = worker.gcs
-    pending = worker.scheduler.pending_entries()
+
+    def _started(task_id) -> bool:
+        """Window-leased tasks queued behind a worker are resubmittable;
+        anything observed executing (thread registry or leased onto a
+        worker pipe) is not."""
+        with worker._running_lock:
+            if task_id in worker._running_tasks:
+                return True
+        for pool in list(worker._node_pools.values()):
+            with pool._lock:
+                if task_id in pool._by_task:
+                    return True
+        return False
+
+    try:
+        pending = worker.scheduler.pending_entries(_started)
+    except TypeError:  # EventScheduler: no window leases exist
+        pending = worker.scheduler.pending_entries()
     snap = {
         "version": FORMAT_VERSION,
         "time": time.time(),

@@ -79,8 +79,10 @@ class TaskSpec:
     placement_group_capture_child_tasks: bool = False
     runtime_env: Optional[dict] = None
     serialized_func: Optional[bytes] = None  # for process workers
+    func_id: Optional[bytes] = None  # sha1 of serialized_func (cached)
     attempt_number: int = 0
     generator: bool = False  # streaming generator task
+    class_key: Optional[Tuple] = None  # precomputed scheduling_class()
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i)
@@ -115,6 +117,8 @@ class TaskSpec:
         """Tasks in the same class can reuse leases / batch together.
         Placement is part of the class: tasks differing only in strategy
         or bundle must not share one batched assignment row."""
+        if self.class_key is not None:
+            return self.class_key
         return (self.func_descriptor, tuple(sorted(self.resources.items())),
                 self.placement())
 

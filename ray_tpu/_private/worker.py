@@ -144,6 +144,24 @@ class TaskManager:
             return len(self._pending)
 
 
+class _Dispatcher:
+    """Scheduler -> execution boundary. Callable for one task (every
+    scheduler supports this); dispatch_many lets batch-aware schedulers
+    hand a whole tick's grants over at once (per-worker message
+    coalescing in the process pools)."""
+
+    __slots__ = ("_worker",)
+
+    def __init__(self, worker: "Worker"):
+        self._worker = worker
+
+    def __call__(self, pending) -> None:
+        self._worker._dispatch(pending)
+
+    def dispatch_many(self, pendings) -> None:
+        self._worker._dispatch_many(pendings)
+
+
 class Worker:
     """The in-process runtime: one per driver/worker process."""
 
@@ -187,16 +205,26 @@ class Worker:
         # until a node providing it joins (reference semantics).
         self.node_id = NodeID.from_random()
         head_custom = dict(resources or {})
+        # thread mode gets a dispatch window too: the bounded executor
+        # (max_workers=n) queues over-dispatched tasks while running at
+        # most n concurrently — the same guarantee the process pool's
+        # worker pipes give
+        from ray_tpu._private.runtime.process_pool import auto_pipeline_depth
+        win = (self.process_pool._pipeline_depth
+               if self.process_pool is not None
+               else auto_pipeline_depth(nworkers))
         node = NodeState((capacity_cpu, _detect_tpu_count(), 1e18,
                           sum(head_custom.values())),
                          node_id=self.node_id,
-                         custom_resources=head_custom)
+                         custom_resources=head_custom,
+                         window_factor=win)
         contains = self.memory_store.contains
+        dispatcher = _Dispatcher(self)
         if scheduler_factory is not None:
             self.scheduler: SchedulerBase = scheduler_factory(
-                [node], self._dispatch, contains)
+                [node], dispatcher, contains)
         else:
-            self.scheduler = EventScheduler([node], self._dispatch, contains)
+            self.scheduler = EventScheduler([node], dispatcher, contains)
 
         # control plane (node/actor/job tables, KV, pubsub, health checks)
         from ray_tpu._private.gcs import GcsService
@@ -248,6 +276,9 @@ class Worker:
         self._actors_lock = threading.Lock()
 
         self._running_tasks: Dict[TaskID, threading.Event] = {}
+        # cancelled while window-leased but not yet executing (queued in
+        # the executor): flagged here, honored at execution start
+        self._precancelled: set = set()
         self._running_lock = threading.Lock()
 
         # deferred unref queue: ObjectRef.__del__ may fire during GC while
@@ -268,6 +299,13 @@ class Worker:
     # ------------------------------------------------------------------
     # Context helpers
     # ------------------------------------------------------------------
+    @property
+    def needs_serialized_funcs(self) -> bool:
+        """True when tasks may cross a process boundary, so
+        RemoteFunction should attach its cached pickled-function blob
+        to specs (thread-only mode skips the pickle entirely)."""
+        return self.process_pool is not None or bool(self._node_pools)
+
     @property
     def current_task_id(self) -> TaskID:
         return self._context.task_id or self._driver_task_id
@@ -426,6 +464,11 @@ class Worker:
             return  # running in a worker process: flagged or killed there
         with self._running_lock:
             ev = self._running_tasks.get(task_id)
+            if ev is None and \
+                    self.task_manager.get_pending_spec(task_id) is not None:
+                # leased through the dispatch window but still queued in
+                # the executor: mark for cancellation at execution start
+                self._precancelled.add(task_id)
         if ev is not None:
             ev.set()  # cooperative flag checked via was_current_task_cancelled
             if force:
@@ -463,11 +506,40 @@ class Worker:
         elif (pool is not None
               and pending.spec.task_type == TaskType.NORMAL_TASK):
             # lease grant: the decision becomes a payload shipped to a
-            # worker process on the ASSIGNED node (payload build runs off
-            # the tick thread)
+            # worker process on the ASSIGNED node (payload build + pipe
+            # send run OFF the tick thread: a full pipe buffer blocks
+            # the send, and a blocked tick thread would stall all
+            # scheduling — the batch path amortizes the executor hop)
             self._pool.submit(pool.run_task, pending)
         else:
             self._pool.submit(self._execute_task, pending)
+
+    def _dispatch_many(self, pendings: List[PendingTask]) -> None:
+        """One tick's grants: normal tasks bound for local process
+        pools batch into per-pool lease grants (one executor hop and
+        one pipe message per worker per tick, instead of per task);
+        everything else takes the per-task path."""
+        groups: Dict[Any, List[PendingTask]] = {}
+        for pending in pendings:
+            spec = pending.spec
+            pool = self.pool_for_node(pending.node_index)
+            if (pool is not None and not pool.is_remote
+                    and getattr(spec, "_actor_boot", None) is None
+                    and spec.task_type == TaskType.NORMAL_TASK):
+                self.events.record(spec.task_id, spec.name, "dispatched",
+                                   pending.node_index)
+                groups.setdefault(pool, []).append(pending)
+            else:
+                self._dispatch(pending)
+        for pool, batch in groups.items():
+            self._pool.submit(self._run_pool_batch, pool, batch)
+
+    def _run_pool_batch(self, pool, batch: List[PendingTask]) -> None:
+        try:
+            pool.run_task_batch(batch)
+        except Exception:
+            logger.exception("batch dispatch failed on node %d",
+                             batch[0].node_index)
 
     def _boot_actor(self, pending: PendingTask, boot) -> None:
         try:
@@ -492,10 +564,13 @@ class Worker:
             self.shm_store = ShmObjectStore(GLOBAL_CONFIG.object_store_memory)
         custom = sum((resources or {}).values())
         node_id = NodeID.from_random()
+        from ray_tpu._private.runtime.process_pool import auto_pipeline_depth
+        nw = num_workers or max(int(num_cpus), 1)
         state = NodeState((num_cpus, num_tpus, 1e18, custom),
-                          node_id=node_id, custom_resources=resources)
+                          node_id=node_id, custom_resources=resources,
+                          window_factor=auto_pipeline_depth(nw))
         row = self.scheduler.add_node(state)
-        pool = ProcessWorkerPool(self, num_workers or max(int(num_cpus), 1),
+        pool = ProcessWorkerPool(self, nw,
                                  self.shm_store, node_index=row)
         self._node_pools[row] = pool
         entry = self.gcs.register_node(
@@ -671,6 +746,10 @@ class Worker:
         cancel_ev = threading.Event()
         with self._running_lock:
             self._running_tasks[exec_task_id] = cancel_ev
+            if self._precancelled:
+                if exec_task_id in self._precancelled:
+                    self._precancelled.discard(exec_task_id)
+                    cancel_ev.set()
 
         prev_task = self._context.task_id
         prev_put = self._context.put_counter
@@ -679,6 +758,7 @@ class Worker:
         self.events.record(exec_task_id, spec.name, "started",
                            pending.node_index)
         retry_task: Optional[PendingTask] = None
+        ready_oids: List[ObjectID] = []
         pg_token = None
         if spec.placement_group_id is not None \
                 and spec.placement_group_capture_child_tasks:
@@ -716,7 +796,7 @@ class Worker:
             except BaseException as e:  # noqa: BLE001
                 retry_task = self._handle_task_failure(spec, return_ids, e)
                 return
-            self._store_returns(spec, return_ids, result)
+            ready_oids = self._store_returns(spec, return_ids, result)
         finally:
             if env_vars:
                 env_vars_pop(env_vars)
@@ -730,9 +810,12 @@ class Worker:
             self.events.record(exec_task_id, spec.name, "finished",
                                pending.node_index)
             deps = _top_level_deps(spec.args, spec.kwargs)
-            self.reference_counter.remove_submitted_task_references(deps)
-            self.scheduler.notify_task_finished(
-                exec_task_id, pending.node_index, spec.resources)
+            if deps:
+                self.reference_counter.remove_submitted_task_references(deps)
+            # object-ready + task-finished in ONE scheduler wakeup
+            self.scheduler.notify_batch(
+                ready_oids,
+                [(exec_task_id, pending.node_index, spec.resources)])
             self.placement_groups.poke()
             # resubmit AFTER the finished notification so the scheduler
             # releases this execution's slot before seeing the retry
@@ -776,7 +859,10 @@ class Worker:
         kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
         return args, kwargs, dep_error, requeue_deps
 
-    def _store_returns(self, spec: TaskSpec, return_ids: List[ObjectID], result):
+    def _store_returns(self, spec: TaskSpec, return_ids: List[ObjectID],
+                       result) -> List[ObjectID]:
+        """Store results; returns the stored oids — the CALLER delivers
+        the object-ready notifications (batched with task-finished)."""
         if spec.num_returns == 1:
             values = [result]
         else:
@@ -786,11 +872,11 @@ class Worker:
                     f"task {spec.name} declared num_returns={spec.num_returns} "
                     f"but returned {len(values)} values")
                 self._store_error(spec, return_ids, err)
-                return
+                return []
         for oid, v in zip(return_ids, values):
             self.memory_store.put(oid, v)
-            self.scheduler.notify_object_ready(oid)
         self.task_manager.complete(spec.task_id)
+        return return_ids
 
     def _handle_task_failure(self, spec: TaskSpec, return_ids,
                              exc: BaseException) -> Optional[PendingTask]:

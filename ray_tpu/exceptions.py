@@ -34,6 +34,13 @@ class TaskError(RayTpuError):
             f"task {function_name} failed:\n{self.traceback_str}"
         )
 
+    def __reduce__(self):
+        # default Exception pickling replays __init__ with self.args
+        # (just the message) and breaks on the required ``cause`` —
+        # carry the real constructor arguments across the wire
+        return (TaskError,
+                (self.function_name, self.cause, self.traceback_str))
+
     def as_instanceof_cause(self) -> BaseException:
         cause_cls = type(self.cause)
         if issubclass(cause_cls, TaskError):

@@ -51,6 +51,27 @@ class RemoteFunction:
         if options:
             self._options.update(options)
         self._is_generator = inspect.isgeneratorfunction(func)
+        # function blob pickled ONCE per RemoteFunction, like the
+        # reference's pickled_function export (ray:
+        # python/ray/remote_function.py) — per-call cloudpickle of the
+        # same function was the single largest task-submission cost.
+        # NOTE closure values are captured at first .remote(), matching
+        # the reference's freeze-at-export semantics.
+        self._fn_blob: Optional[bytes] = None
+        self._fn_id: Optional[bytes] = None
+        self._exec_func: Optional[Callable] = None
+        # default-placement scheduling class, computed once per
+        # RemoteFunction: scheduling_class() on the admission hot path
+        # re-sorted the resources dict per task otherwise
+        res = _build_resources(self._options)
+        strat = self._options["scheduling_strategy"]
+        place = ("spread",) if strat == "SPREAD" else ("default",)
+        self._class_key = (
+            (f"{self._module}.{self._name}",
+             tuple(sorted(res.items())), place)
+            if (self._options["placement_group"] is None
+                and (strat is None or isinstance(strat, str)))
+            else None)
         functools.update_wrapper(self, func)
 
     def bind(self, *args, **kwargs):
@@ -117,9 +138,18 @@ class RemoteFunction:
                                  _build_resources(opts))
         _validate_runtime_env(opts["runtime_env"])
 
-        func = self._function
-        if generator:
-            func = _collect_generator(func)
+        func = self._exec_func
+        if func is None:
+            func = self._function
+            if generator:
+                func = _collect_generator(func)
+            self._exec_func = func
+        if self._fn_blob is None and worker.needs_serialized_funcs:
+            import hashlib
+
+            import cloudpickle
+            self._fn_blob = cloudpickle.dumps(func)
+            self._fn_id = hashlib.sha1(self._fn_blob).digest()
 
         spec = TaskSpec(
             task_id=worker.next_task_id(),
@@ -138,7 +168,12 @@ class RemoteFunction:
             placement_group_bundle_index=bundle_index,
             placement_group_capture_child_tasks=capture,
             runtime_env=opts["runtime_env"],
+            serialized_func=self._fn_blob,
+            func_id=self._fn_id,
             generator=generator,
+            # the precomputed key only describes the no-group case; an
+            # inherited/explicit placement group changes the class
+            class_key=self._class_key if pg_id is None else None,
         )
         refs = worker.submit_task(spec)
         return refs[0] if spec.num_returns == 1 else refs

@@ -84,19 +84,19 @@ class TestMonitor:
             deadline = time.monotonic() + 20
             while time.monotonic() < deadline:
                 with pool._lock:
-                    busy = sum(1 for h in pool._handles
-                               if h.busy is not None)
+                    busy = sum(1 for h in pool._handles if h.inflight)
                 if busy >= 2:
                     break
                 time.sleep(0.05)
             victim = mon._pick_victim()
             assert victim is not None
-            # the newest running task is chosen (last-in-first-killed)
+            # the newest leased task is chosen (last-in-first-killed)
             with pool._lock:
-                newest = max((h for h in pool._handles
-                              if h.busy is not None),
-                             key=lambda h: h._started_at)
-            assert victim[0] == newest.exec_task_id
+                newest_id, newest_inf = max(
+                    ((tid, inf) for h in pool._handles
+                     for tid, inf in h.inflight.items()),
+                    key=lambda kv: kv[1].started_at)
+            assert victim[0] == newest_id
             ray_tpu.get([r1, r2], timeout=30)
         finally:
             ray_tpu.shutdown()
