@@ -124,6 +124,14 @@ def main() -> int:
         traceback.print_exc()
         out["mfu"] = None
 
+    # top device-op time sinks of one train step (profiler-derived)
+    try:
+        out["model_time_sinks"] = perf.model_time_sinks(smoke=smoke)
+        print(f"  time sinks: {out['model_time_sinks']}", file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
+        out["model_time_sinks"] = None
+
     # --- LLM serving: paged-attention decode throughput ----------------
     try:
         d = perf.llm_decode_throughput(smoke=smoke)

@@ -60,6 +60,11 @@ class TransformerConfig:
     moe: bool = False
     moe_num_experts: int = 8
     moe_capacity_factor: float = 1.25
+    # fused flash attention (Pallas, jax.experimental.pallas.ops.tpu):
+    # never materializes the [S,S] score matrix — the HBM-traffic fix
+    # for the single-chip train path. "auto" = on TPU backends for the
+    # causal/unmasked/no-ring case; "off" forces the einsum path.
+    flash_attention: str = "auto"   # "auto" | "off"
 
     @property
     def head_dim(self) -> int:
@@ -70,6 +75,14 @@ class TransformerConfig:
         return TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
                                  n_heads=4, n_kv_heads=2, d_ff=128,
                                  max_seq_len=128)
+
+
+def _flash_supported(head_dim: int) -> bool:
+    """The fused kernel wants TPU backends and lane-aligned head_dim;
+    ragged sequence lengths pad inside the wrapper (ops/flash.py)."""
+    import jax
+
+    return jax.default_backend() == "tpu" and head_dim % 128 == 0
 
 
 def _rope(x: jnp.ndarray, positions: jnp.ndarray,
@@ -149,6 +162,14 @@ class Attention(nn.Module):
             from ray_tpu.ops.ring_attention import ring_attention_sharded
 
             out = ring_attention_sharded(q, k, v, ring_mesh, causal=True)
+        elif (mask is None and cfg.flash_attention != "off"
+              and _flash_supported(hd)):
+            from ray_tpu.ops.flash import flash_attention_bshk
+
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            out = flash_attention_bshk(q, k, v, causal=True)
         else:
             # GQA: repeat kv heads up to query heads
             rep = cfg.n_heads // cfg.n_kv_heads
@@ -279,15 +300,25 @@ class Transformer(nn.Module):
             x = block(cfg, name=f"layer_{i}")(x, positions, mask)
 
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
-        logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
-        return logits.astype(jnp.float32)
+        # logits stay in compute dtype: an f32 [B,S,V] copy costs ~2x
+        # the HBM traffic of the lm-head matmul itself; the loss casts
+        # inside its reductions (XLA fuses the cast into them)
+        return jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
 
 
 def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray,
                        mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Mean next-token cross entropy. logits [B,S,V], targets [B,S]."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    """Mean next-token cross entropy. logits [B,S,V], targets [B,S].
+
+    logsumexp formulation: nll = lse(logits) - logits[target]. Unlike
+    log_softmax, this never materializes a full [B,S,V] f32 result —
+    the cast fuses into the reduction, and backward recomputes softmax
+    from the (bf16) logits.
+    """
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None],
+                                 axis=-1)[..., 0].astype(jnp.float32)
+    nll = lse - picked
     if mask is not None:
         return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
     return nll.mean()
