@@ -354,7 +354,7 @@ def llm_decode_throughput(smoke: bool = False) -> dict:
         mcfg = TransformerConfig(vocab_size=32000, d_model=1024,
                                  n_layers=8, n_heads=8, n_kv_heads=4,
                                  d_ff=2816, max_seq_len=2048)
-        batch, new_tokens, pages = 8, 64, 512
+        batch, new_tokens, pages = 16, 64, 512
     model = Transformer(mcfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
@@ -363,11 +363,13 @@ def llm_decode_throughput(smoke: bool = False) -> dict:
                            prefill_buckets=(16,), max_new_tokens=new_tokens)
     engine = InferenceEngine(params, mcfg, icfg)
     try:
-        prompt = [1, 2, 3, 4]
-        # warm compiles (prefill + EVERY decode-chunk program the timed
-        # run will pick): same max_new as the measurement, or chunk
-        # programs compile inside the timing window
-        engine.generate(prompt, new_tokens, timeout=900.0)
+        # warm compiles with the SAME admission/chunk pattern as the
+        # timed run (the batched prefill specializes on group size, the
+        # decode programs on chunk size)
+        warm = [engine.submit([i + 1] * 4, new_tokens)
+                for i in range(batch)]
+        for f in warm:
+            f.result(timeout=900)
         t0 = time.perf_counter()
         futs = [engine.submit([i + 1] * 4, new_tokens)
                 for i in range(batch)]

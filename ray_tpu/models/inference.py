@@ -22,6 +22,7 @@ parity test pins this functional forward to the flax module's output.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import queue
 import threading
 from concurrent.futures import Future
@@ -76,8 +77,8 @@ def _mlp(p, x, dtype):
 
 
 def _prefill_layer(p, cfg: TransformerConfig, x, positions):
-    """Full-attention prefill for one layer over [1,S,Dm]; returns
-    (x_out, k [S,KV,D], v [S,KV,D])."""
+    """Full-attention prefill for one layer over [N,S,Dm]; returns
+    (x_out, k [N,S,KV,D], v [N,S,KV,D])."""
     a = p["Attention_0"]
     h = _rms(x, p["RMSNorm_0"]["scale"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, a["wq"].astype(cfg.dtype))
@@ -97,7 +98,7 @@ def _prefill_layer(p, cfg: TransformerConfig, x, positions):
     x = x + jnp.einsum("bshk,hkd->bsd", attn, a["wo"].astype(cfg.dtype))
     x = x + _mlp(p["MLP_0"], _rms(x, p["RMSNorm_1"]["scale"],
                                   cfg.norm_eps), cfg.dtype)
-    return x, k[0], v[0]
+    return x, k, v
 
 
 def _decode_layer(p, cfg: TransformerConfig, x, positions, k_pages,
@@ -124,10 +125,10 @@ def _decode_layer(p, cfg: TransformerConfig, x, positions, k_pages,
     return x, k_pages, v_pages
 
 
-def prefill(params: Dict[str, Any], cfg: TransformerConfig,
-            tokens: jnp.ndarray):
-    """tokens [1,S] (padded to a bucket) -> (logits [S,V] f32,
-    k_seq/v_seq [L,S,KV,D])."""
+def prefill_batch(params: Dict[str, Any], cfg: TransformerConfig,
+                  tokens: jnp.ndarray):
+    """tokens [N,S] (padded to a bucket) -> (logits [N,S,V] f32,
+    k_seq/v_seq [L,N,S,KV,D]) — N prompts prefill in one program."""
     embed = params["embedding"]
     x = embed.astype(cfg.dtype)[tokens]
     s = tokens.shape[1]
@@ -139,7 +140,15 @@ def prefill(params: Dict[str, Any], cfg: TransformerConfig,
         vs.append(v)
     x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
-    return (logits[0].astype(jnp.float32), jnp.stack(ks), jnp.stack(vs))
+    return (logits.astype(jnp.float32), jnp.stack(ks), jnp.stack(vs))
+
+
+def prefill(params: Dict[str, Any], cfg: TransformerConfig,
+            tokens: jnp.ndarray):
+    """tokens [1,S] (padded to a bucket) -> (logits [S,V] f32,
+    k_seq/v_seq [L,S,KV,D])."""
+    logits, ks, vs = prefill_batch(params, cfg, tokens)
+    return logits[0], ks[:, 0], vs[:, 0]
 
 
 def decode_step(params: Dict[str, Any], cfg: TransformerConfig,
@@ -197,24 +206,51 @@ def decode_chunk(params: Dict[str, Any], cfg: TransformerConfig,
 # the engine
 # ----------------------------------------------------------------------
 
+_STREAM_END = object()
+
+
+class TokenStream:
+    """Iterator over tokens as the engine produces them (per sync
+    burst), plus the final-list future for callers that want both."""
+
+    def __init__(self, future: Future):
+        self._q: "queue.Queue" = queue.Queue()
+        self.future = future
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _STREAM_END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield from item  # one burst's new tokens
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        return self.future.result(timeout)
+
+
 class _Request:
-    __slots__ = ("prompt", "max_new", "future", "out")
+    __slots__ = ("prompt", "max_new", "future", "out", "emitted", "stream",
+                 "streamed")
 
     def __init__(self, prompt: List[int], max_new: int):
         self.prompt = prompt
         self.max_new = max_new
         self.future: Future = Future()
-        self.out: List[int] = []
+        self.out: List[int] = []   # tokens synced to host
+        self.emitted = 0           # tokens produced on device (>= len(out))
+        self.stream: Optional[TokenStream] = None
+        self.streamed = 0          # tokens already pushed to the stream
 
 
 class _Slot:
-    __slots__ = ("req", "pages", "seq_len", "last_token")
+    __slots__ = ("req", "pages", "seq_len")
 
     def __init__(self):
         self.req: Optional[_Request] = None
         self.pages: List[int] = []
         self.seq_len = 0
-        self.last_token = 0
 
 
 class InferenceEngine:
@@ -270,34 +306,59 @@ class InferenceEngine:
                 donate_argnums=(2, 3))
             self._decode_chunks[steps] = \
                 (lambda *a, _f=fn: _f(self.params, *a))
-        # one jitted program per bucket: forward + ALL cache-page writes
-        # + next-token argmax in a single dispatch (eager per-layer
-        # writes would cost a dispatch each — dominating admission)
-        def prefill_write(p, toks, kp, vp, pages, plen):
-            logits, k_seq, v_seq = prefill(p, mcfg, toks)
-            new_k, new_v = [], []
-            for i in range(mcfg.n_layers):
-                ki, vi = write_prefill_kv(kp[i], vp[i], k_seq[i],
-                                          v_seq[i], pages)
-                new_k.append(ki)
-                new_v.append(vi)
-            nxt = jnp.argmax(logits[plen - 1]).astype(jnp.int32)
-            return nxt, tuple(new_k), tuple(new_v)
+        # burst state rides ONE packed upload [B, 1 + max_pages]
+        # (column 0 = seq_lens, rest = page table — each small upload
+        # costs ~10-20 ms through a tunneled chip); lens then EVOLVES
+        # on device across the burst's chained chunks while the table
+        # stays fixed
+        self._split_packed = jax.jit(
+            lambda packed: (packed[:, 1:], packed[:, 0]))
 
-        prefill_fn = jax.jit(prefill_write, donate_argnums=(2, 3))
-        self._prefills = {
-            b: (lambda toks, kp, vp, pages, plen, _f=prefill_fn:
-                _f(self.params, toks, kp, vp, pages, plen))
+        # BATCHED prefill: N admissions in one program behind ONE packed
+        # upload. packed [N, 2 + bucket + n_prog] int32 rows of
+        # [slot_idx, plen, tokens(bucket), pages(n_prog)]; dummy pad
+        # rows carry slot_idx == batch_size, whose scatter is dropped
+        # (out-of-bounds scatters drop) and whose pages point at the
+        # parking page. jit re-specializes per (N, bucket) shape.
+        def prefill_write_many(p, packed, kp, vp, toks_vec, *, bucket):
+            n_prog = -(-bucket // cfg.page_size)
+            slots = packed[:, 0]
+            plens = packed[:, 1]
+            toks = packed[:, 2:2 + bucket]
+            pages = packed[:, 2 + bucket:2 + bucket + n_prog]
+            logits, k_seq, v_seq = prefill_batch(p, mcfg, toks)
+            new_k, new_v = list(kp), list(vp)
+            n = packed.shape[0]
+            for i in range(mcfg.n_layers):
+                ki, vi = new_k[i], new_v[i]
+                for r in range(n):
+                    ki, vi = write_prefill_kv(ki, vi, k_seq[i, r],
+                                              v_seq[i, r], pages[r])
+                new_k[i], new_v[i] = ki, vi
+            row_logits = logits[jnp.arange(n), plens - 1]       # [N,V]
+            nxt = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
+            toks_vec = toks_vec.at[slots].set(nxt)
+            return nxt, toks_vec, tuple(new_k), tuple(new_v)
+
+        self._prefill_many = {
+            b: jax.jit(functools.partial(prefill_write_many, bucket=b),
+                       donate_argnums=(2, 3, 4))
             for b in cfg.prefill_buckets
         }
+        # persistent device-resident feedback state: admission scatters
+        # the prefill's next-token in WITHOUT a host read (on tunneled
+        # chips a sync costs ~90 ms; a dispatch ~2 ms)
+        self._dev_toks = jnp.zeros(cfg.batch_size, jnp.int32)
+        # prefill next-tokens awaiting the next burst's combined fetch:
+        # (device array [N], [(slot, row)])
+        self._pending_firsts: List[Tuple[Any, List[Tuple[_Slot, int]]]] = []
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="ray_tpu_llm_engine")
         self._thread.start()
 
     # -- API -----------------------------------------------------------
-    def submit(self, prompt: Sequence[int],
-               max_new_tokens: Optional[int] = None) -> Future:
-        """Returns a Future resolving to the GENERATED token list."""
+    def _validate(self, prompt: Sequence[int],
+                  max_new_tokens: Optional[int]) -> int:
         if not prompt:
             raise ValueError("empty prompt")
         max_new = (self.cfg.max_new_tokens if max_new_tokens is None
@@ -312,10 +373,29 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt longer than the largest prefill bucket "
                 f"{max(self.cfg.prefill_buckets)}")
+        return max_new
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None) -> Future:
+        """Returns a Future resolving to the GENERATED token list."""
+        max_new = self._validate(prompt, max_new_tokens)
         req = _Request(list(prompt), max_new)
         self._queue.put(req)
         self._wake.set()
         return req.future
+
+    def submit_stream(self, prompt: Sequence[int],
+                      max_new_tokens: Optional[int] = None) -> TokenStream:
+        """Streaming variant: tokens arrive on the returned iterator as
+        each device sync lands (chunk granularity), ending at EOS /
+        budget; .result() still yields the final list."""
+        max_new = self._validate(prompt, max_new_tokens)
+        req = _Request(list(prompt), max_new)
+        stream = TokenStream(req.future)
+        req.stream = stream
+        self._queue.put(req)
+        self._wake.set()
+        return stream
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: Optional[int] = None,
@@ -341,6 +421,13 @@ class InferenceEngine:
     def _fail_outstanding(self, exc: BaseException) -> None:
         """Resolve every in-flight and queued Future exceptionally —
         a dead engine must never leave callers blocking to timeout."""
+        def _fail(req: _Request) -> None:
+            if not req.future.done():
+                req.future.set_exception(exc)
+            if req.stream is not None:
+                req.stream._q.put(exc)
+
+        self._pending_firsts = []
         for s in self._slots:
             req, s.req = s.req, None
             if req is not None:
@@ -348,69 +435,103 @@ class InferenceEngine:
                     self._free_pages.extend(s.pages)
                 s.pages = []
                 s.seq_len = 0
-                if not req.future.done():
-                    req.future.set_exception(exc)
+                _fail(req)
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if not req.future.done():
-                req.future.set_exception(exc)
+            _fail(req)
 
     # -- internals ------------------------------------------------------
     def _pages_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.cfg.page_size)
 
     def _try_admit(self) -> None:
+        """Admit every admissible queued request, then prefill them in
+        BATCHED programs (grouped per prompt bucket): one packed upload
+        + one dispatch per group, fully asynchronous — the next tokens
+        scatter into the device feedback vector and sync with the next
+        burst's combined fetch."""
+        admits: List[Tuple[_Slot, _Request, List[int]]] = []
         while True:
             free_slot = next((s for s in self._slots if s.req is None),
                              None)
             if free_slot is None or self._queue.empty():
-                return
+                break
             req = self._queue.queue[0]
             total = len(req.prompt) + req.max_new
             need = self._pages_needed(total)
             with self._lock:
                 if need > len(self._free_pages):
-                    return  # head-of-line blocks until pages free
+                    break  # head-of-line blocks until pages free
                 self._queue.get_nowait()
                 pages = [self._free_pages.pop() for _ in range(need)]
-            self._prefill_into(free_slot, req, pages)
+            plen = len(req.prompt)
+            free_slot.req = req
+            free_slot.pages = pages
+            free_slot.seq_len = plen
+            req.emitted = 1
+            admits.append((free_slot, req, pages))
+        if not admits:
+            return
+        by_bucket: Dict[int, List[Tuple[_Slot, _Request, List[int]]]] = {}
+        for slot, req, pages in admits:
+            bucket = next(b for b in sorted(self.cfg.prefill_buckets)
+                          if b >= len(req.prompt))
+            by_bucket.setdefault(bucket, []).append((slot, req, pages))
+        for bucket, group in by_bucket.items():
+            self._prefill_group(bucket, group)
 
-    def _prefill_into(self, slot: _Slot, req: _Request,
-                      pages: List[int]) -> None:
-        plen = len(req.prompt)
-        bucket = next(b for b in sorted(self.cfg.prefill_buckets)
-                      if b >= plen)
-        padded = req.prompt + [0] * (bucket - plen)
-        # the program writes bucket//page_size pages: the sequence's own
-        # pages where allocated (pad rows beyond the prompt are
-        # DON'T-CARE — appends overwrite them slot by slot, attention
-        # masks by seq_len), the parking page past its allocation
-        n_prog_pages = -(-bucket // self.cfg.page_size)
-        page_list = (pages + [self._parking_page] * n_prog_pages)[
-            :n_prog_pages]
-        nxt, self._k_pages, self._v_pages = self._prefills[bucket](
-            jnp.asarray([padded], jnp.int32), self._k_pages,
-            self._v_pages, jnp.asarray(page_list, jnp.int32),
-            jnp.asarray(plen, jnp.int32))
-        slot.req = req
-        slot.pages = pages
-        slot.seq_len = plen
-        slot.last_token = int(nxt)
-        req.out.append(slot.last_token)
-        self._maybe_finish(slot)
+    def _prefill_group(self, bucket: int, group: List[tuple]) -> None:
+        n_prog = -(-bucket // self.cfg.page_size)
+        width = 2 + bucket + n_prog
+        # FIXED program shape: always batch_size rows (dummies padded).
+        # Admission arrival order races the submitter, so group sizes
+        # are nondeterministic — shape-per-size would compile at
+        # unpredictable moments; one shape per bucket compiles once,
+        # and dummy-row prefill compute is negligible
+        n = self.cfg.batch_size
+        packed = np.zeros((n, width), np.int32)
+        # dummy pad rows: scatter target out of bounds (dropped), pages
+        # at the parking page, plen 1
+        packed[:, 0] = self.cfg.batch_size
+        packed[:, 1] = 1
+        packed[:, 2 + bucket:] = self._parking_page
+        rows: List[Tuple[_Slot, int]] = []
+        for r, (slot, req, pages) in enumerate(group):
+            plen = len(req.prompt)
+            packed[r, 0] = self._slots.index(slot)
+            packed[r, 1] = plen
+            packed[r, 2:2 + plen] = req.prompt
+            # the program writes n_prog pages: the sequence's own where
+            # allocated (pad rows beyond the prompt are DON'T-CARE —
+            # appends overwrite them, attention masks by seq_len), the
+            # parking page past its allocation
+            page_list = (pages + [self._parking_page] * n_prog)[:n_prog]
+            packed[r, 2 + bucket:] = page_list
+            rows.append((slot, r))
+        nxt, self._dev_toks, self._k_pages, self._v_pages = \
+            self._prefill_many[bucket](
+                self.params, jnp.asarray(packed), self._k_pages,
+                self._v_pages, self._dev_toks)
+        self._pending_firsts.append((nxt, rows))
 
     def _maybe_finish(self, slot: _Slot) -> None:
         req = slot.req
-        if self.cfg.eos_id is not None and self.cfg.eos_id in req.out:
+        # budget first: covering-chunk overshoot may have produced
+        # tokens past max_new, and an EOS in that overrun region must
+        # not be honored (the caller asked for at most max_new)
+        budget = req.out[:req.max_new]
+        if self.cfg.eos_id is not None and self.cfg.eos_id in budget:
             # EOS may land mid-chunk: trim the overrun (its KV appends
             # stayed within the pages reserved for max_new)
-            req.out = req.out[:req.out.index(self.cfg.eos_id) + 1]
+            req.out = budget[:budget.index(self.cfg.eos_id) + 1]
             done = True
         else:
             done = len(req.out) >= req.max_new
+            if done:
+                req.out = budget
         if done:
             with self._lock:
                 self._free_pages.extend(slot.pages)
@@ -418,14 +539,6 @@ class InferenceEngine:
             slot.pages = []
             slot.seq_len = 0
             req.future.set_result(req.out)
-
-    def _page_table(self) -> np.ndarray:
-        table = np.zeros((self.cfg.batch_size, self.cfg.max_pages_per_seq),
-                         np.int32)
-        for i, s in enumerate(self._slots):
-            for j, p in enumerate(s.pages):
-                table[i, j] = p
-        return table
 
     def _loop(self) -> None:
         while not self._shutdown:
@@ -448,41 +561,50 @@ class InferenceEngine:
                 self._wake.clear()
                 return
             self.max_concurrent = max(self.max_concurrent, len(active))
-            # upload the decode state once per BURST (admission and
-            # completion both sync, so host bookkeeping is authoritative
-            # here); within the burst the feedback state stays on device
-            tokens = np.zeros(self.cfg.batch_size, np.int32)
-            lens = np.zeros(self.cfg.batch_size, np.int32)
+            # ONE packed upload per burst carries lens + page table
+            # (host bookkeeping is authoritative for both); the TOKEN
+            # feedback vector lives on device across bursts — prefill
+            # results scatter in without ever being read to host first
+            packed = np.zeros(
+                (self.cfg.batch_size, 1 + self.cfg.max_pages_per_seq),
+                np.int32)
+            # idle slots decode dummy tokens whose K/V appends land in
+            # the reserved parking page; their outputs are discarded.
+            # UNALLOCATED table entries also point at the parking page:
+            # budget-overrun appends (chunk overshoot, finished slots
+            # decoding out a burst) land there instead of page 0.
+            packed[:, 1:] = self._parking_page
             for i, s in enumerate(self._slots):
                 if s.req is not None:
-                    tokens[i] = s.last_token
-                    lens[i] = s.seq_len
-            # idle slots decode dummy tokens whose K/V appends land in
-            # the reserved parking page; their outputs are discarded
-            table = self._page_table()
-            for i, s in enumerate(self._slots):
-                if s.req is None:
-                    table[i, :] = self._parking_page
-            dev_toks = jnp.asarray(tokens)
-            dev_lens = jnp.asarray(lens)
-            dev_table = jnp.asarray(table)
+                    packed[i, 0] = s.seq_len
+                    for j, p in enumerate(s.pages):
+                        packed[i, 1 + j] = p
+            dev_toks = self._dev_toks
+            dev_table, dev_lens = self._split_packed(jnp.asarray(packed))
 
             # async burst: dispatch chunks back-to-back WITHOUT reading
             # results (jax dispatch is async; on a remote chip the
             # round-trip dwarfs the 0.2 ms of device work per chunk).
-            # The host materializes tokens only when some request's
-            # budget is exhausted — or per-chunk when EOS detection is
+            # The host materializes tokens ONCE per burst in a single
+            # combined fetch — or per-chunk when EOS detection is
             # configured (early exit needs the values).
             inflight = {id(s): 0 for s in active}
             pending: List[Tuple[Any, int]] = []
             while True:
                 remaining = min(
-                    s.req.max_new - len(s.req.out) - inflight[id(s)]
+                    s.req.max_new - s.req.emitted - inflight[id(s)]
                     for s in active)
                 if remaining <= 0 or len(pending) >= 4:
                     break
-                chunk = max(c for c in self._chunk_sizes
-                            if c <= remaining)
+                # smallest chunk COVERING the remaining budget when one
+                # exists: a 63-token budget runs one 64-step program
+                # (the 1-token overrun trims at finish; its KV appends
+                # land in parking-paged table slots) instead of
+                # 32+16+8+4+2+1 separate dispatches
+                covering = [c for c in self._chunk_sizes
+                            if c >= remaining]
+                chunk = (min(covering) if covering
+                         else self._chunk_sizes[-1])
                 (outs, dev_toks, dev_lens, self._k_pages,
                  self._v_pages) = self._decode_chunks[chunk](
                      dev_toks, self._k_pages, self._v_pages, dev_table,
@@ -494,17 +616,48 @@ class InferenceEngine:
                     s.seq_len += chunk
                 if self.cfg.eos_id is not None:
                     break  # EOS needs the values: one chunk per burst
+            self._dev_toks = dev_toks
 
+            # ONE fetch per burst: chunk outputs + any pending prefill
+            # first-tokens, concatenated on device, read together
+            firsts, self._pending_firsts = self._pending_firsts, []
+            parts = [outs.reshape(-1) for outs, _ in pending]
+            parts.extend(arr for arr, _rows in firsts)
+            if not parts:
+                return
+            flat = np.asarray(jnp.concatenate(parts)
+                              if len(parts) > 1 else parts[0])
+            # distribute: first-tokens sit after this burst's chunk rows
+            off = sum(c * self.cfg.batch_size for _, c in pending)
+            for arr, rows in firsts:
+                for slot, r in rows:
+                    if slot.req is not None:
+                        slot.req.out.insert(0, int(flat[off + r]))
+                off += len(arr)
+            pos = 0
             for outs, chunk in pending:
-                arr = np.asarray(outs)         # [chunk, B] (sync point)
+                arr = flat[pos:pos + chunk * self.cfg.batch_size].reshape(
+                    chunk, self.cfg.batch_size)
+                pos += chunk * self.cfg.batch_size
                 for i, s in enumerate(self._slots):
                     if s.req is None or id(s) not in inflight:
                         continue
                     s.req.out.extend(int(t) for t in arr[:, i])
-                    s.last_token = int(arr[-1, i])
             for s in active:
                 if s.req is not None:
-                    self._maybe_finish(s)
+                    s.req.emitted = len(s.req.out)
+            for s in active:
+                req = s.req
+                if req is None:
+                    continue
+                self._maybe_finish(s)   # may trim EOS overrun + finish
+                if req.stream is not None:
+                    new = req.out[req.streamed:]
+                    if new:
+                        req.stream._q.put(new)
+                    req.streamed += len(new)
+                    if req.future.done():
+                        req.stream._q.put(_STREAM_END)
 
     @property
     def _parking_page(self) -> int:

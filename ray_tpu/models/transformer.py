@@ -78,11 +78,20 @@ class TransformerConfig:
 
 
 def _flash_supported(head_dim: int) -> bool:
-    """The fused kernel wants TPU backends and lane-aligned head_dim;
-    ragged sequence lengths pad inside the wrapper (ops/flash.py)."""
+    """The fused kernel covers the SINGLE-CHIP causal path: TPU
+    backend, lane-aligned head_dim, and no multi-device mesh active —
+    pallas_call carries no GSPMD partitioning rule, so sharded
+    activations must take the einsum path (XLA partitions it) or the
+    ring path (which owns seq parallelism explicitly). Ragged sequence
+    lengths pad inside the wrapper (ops/flash.py)."""
     import jax
 
-    return jax.default_backend() == "tpu" and head_dim % 128 == 0
+    if jax.default_backend() != "tpu" or head_dim % 128 != 0:
+        return False
+    from ray_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.current_mesh()
+    return m is None or all(v <= 1 for v in m.shape.values())
 
 
 def _rope(x: jnp.ndarray, positions: jnp.ndarray,

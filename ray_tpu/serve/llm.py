@@ -33,6 +33,7 @@ class LLMDeployment:
                  engine_cfg: Optional[InferenceConfig] = None):
         self._engine = InferenceEngine(params, model_cfg,
                                        engine_cfg or InferenceConfig())
+        self._streams: Dict[str, Any] = {}
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: Optional[int] = None,
@@ -42,6 +43,54 @@ class LLMDeployment:
         frees)."""
         return self._engine.generate(list(prompt), max_new_tokens,
                                      timeout=timeout)
+
+    # -- token streaming ------------------------------------------------
+    # Across the replica boundary (actor calls return by value), the
+    # stream surfaces as a poll protocol: start_stream() opens one,
+    # next_tokens() drains whatever has arrived since the last poll —
+    # the SSE-emission shape of the reference's serve.llm streaming.
+    # In-process callers can take the engine's TokenStream directly.
+
+    def start_stream(self, prompt: Sequence[int],
+                     max_new_tokens: Optional[int] = None) -> str:
+        import uuid
+
+        stream = self._engine.submit_stream(list(prompt), max_new_tokens)
+        sid = uuid.uuid4().hex
+        self._streams[sid] = stream
+        return sid
+
+    def next_tokens(self, stream_id: str,
+                    timeout: float = 60.0) -> Dict[str, Any]:
+        """Block until at least one token (or completion) is available,
+        then drain everything currently buffered. Returns
+        {"tokens": [...], "done": bool}."""
+        import queue as _q
+
+        stream = self._streams.get(stream_id)
+        if stream is None:
+            raise KeyError(f"unknown stream {stream_id!r}")
+        from ray_tpu.models.inference import _STREAM_END
+
+        tokens: List[int] = []
+        done = False
+        try:
+            item = stream._q.get(timeout=timeout)
+            while True:
+                if isinstance(item, BaseException):
+                    # a dead stream must not keep polling as alive
+                    self._streams.pop(stream_id, None)
+                    raise item
+                if item is None or item is _STREAM_END:
+                    done = True
+                    break
+                tokens.extend(item)
+                item = stream._q.get_nowait()
+        except _q.Empty:
+            pass
+        if done:
+            self._streams.pop(stream_id, None)
+        return {"tokens": tokens, "done": done}
 
     def engine_stats(self) -> Dict[str, Any]:
         return self._engine.stats()
