@@ -53,6 +53,17 @@ def _cmd_start(args) -> int:
     import os
     import signal
 
+    # multi-host device runtime: join the jax.distributed coordinator
+    # BEFORE any jax use, so this process's chips enter the global mesh
+    # (reference analog: the NCCL/MPI process-group bootstrap)
+    if args.jax_coordinator:
+        from ray_tpu.parallel.distributed import init_multihost
+
+        init_multihost(
+            args.jax_coordinator,
+            args.jax_num_processes or None,
+            args.jax_process_id if args.jax_process_id >= 0 else None)
+
     if args.head:
         import ray_tpu
         from ray_tpu._private import worker as worker_mod
@@ -187,6 +198,12 @@ def main(argv=None) -> int:
                    help='JSON dict of named resources, e.g. \'{"a": 2}\'')
     p.add_argument("--worker-mode", default="",
                    choices=["", "thread", "process"])
+    p.add_argument("--jax-coordinator", default="",
+                   help="host:port of the jax.distributed coordinator — "
+                   "joins this process into the multi-host (DCN) device "
+                   "runtime so meshes can span hosts")
+    p.add_argument("--jax-num-processes", type=int, default=0)
+    p.add_argument("--jax-process-id", type=int, default=-1)
     p.set_defaults(fn=_cmd_start)
 
     p = sub.add_parser("status", help="show node/cluster resources")

@@ -99,18 +99,50 @@ class NodeDaemon:
             address=os.path.join(self._sock_dir, "node.sock"),
             family="AF_UNIX", authkey=self._authkey)
 
+        # peer transfer plane (reference: the object manager's
+        # node-to-node Pull/Push protocol, ray: src/ray/object_manager/
+        # — bytes move DIRECTLY between the producing and consuming
+        # nodes; the head only answers "who has it"). The cluster
+        # secret (head authkey) guards peer connections too.
+        import socket
+
+        self._peer_authkey = head_authkey
+        self._peer_listener = Listener(("0.0.0.0", 0),
+                                       authkey=head_authkey)
+        # advertise the address peers can reach: the local IP of our
+        # route to the head (localhost clusters advertise 127.0.0.1).
+        # UDP connect: routes without sending a packet — a TCP probe
+        # would hit the head's authenticated listener and poison its
+        # accept loop with a failed HMAC challenge
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect(tuple(head_address))
+            local_ip = probe.getsockname()[0]
+        except OSError:
+            local_ip = "127.0.0.1"
+        finally:
+            probe.close()
+        self.peer_address = (local_ip, self._peer_listener.address[1])
+        self._peer_conns: Dict[tuple, Any] = {}
+        self._peer_lock = threading.Lock()
+        threading.Thread(target=self._peer_accept_loop, daemon=True,
+                         name="ray_tpu_node_peer_accept").start()
+
         self._head = Client(head_address, authkey=head_authkey)
         self._head_lock = threading.Lock()
         # arena name travels in the hello so the head can reap the
         # segment if this daemon is SIGKILLed (machine-death chaos).
         # token "join" = self-started daemon (ray_tpu start --address):
         # declared resources travel too and the head ADOPTS the node.
+        # The peer transfer address rides at the tuple tail.
         if node_token == "join":
             self._head.send(("hello", "join", os.getpid(),
-                             self.store.arena.name, dict(join_info or {})))
+                             self.store.arena.name, dict(join_info or {}),
+                             tuple(self.peer_address)))
         else:
             self._head.send(("hello", node_token, os.getpid(),
-                             self.store.arena.name))
+                             self.store.arena.name,
+                             tuple(self.peer_address)))
 
     # ------------------------------------------------------------------
     def _send_head(self, msg: tuple) -> None:
@@ -150,9 +182,13 @@ class NodeDaemon:
                              slot.proc.returncode))
 
     def _accept_loop(self) -> None:
+        from multiprocessing import AuthenticationError
+
         while not self._shutdown:
             try:
                 conn = self._listener.accept()
+            except AuthenticationError:
+                continue  # a stale/foreign dialer must not kill accepts
             except (OSError, EOFError):
                 return
             try:
@@ -253,11 +289,101 @@ class NodeDaemon:
         else:
             self._send_head(("fetched", fid, True, sobj.to_bytes()))
 
+    # ------------------------------------------------------------------
+    # peer transfer plane (direct node-to-node pulls)
+    # ------------------------------------------------------------------
+    def _peer_accept_loop(self) -> None:
+        from multiprocessing import AuthenticationError
+
+        while not self._shutdown:
+            try:
+                conn = self._peer_listener.accept()
+            except AuthenticationError:
+                continue  # bad-key dial must not kill the peer plane
+            except (OSError, EOFError):
+                return
+            threading.Thread(target=self._peer_serve, args=(conn,),
+                             daemon=True,
+                             name="ray_tpu_node_peer_serve").start()
+
+    def _peer_serve(self, conn) -> None:
+        """One persistent connection per consuming peer: serve get
+        requests out of the local arena/spill tier."""
+        try:
+            while not self._shutdown:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                if not (isinstance(msg, tuple) and msg
+                        and msg[0] == "get"):
+                    return
+                sobj = self.store.get_serialized(ObjectID(msg[1]))
+                try:
+                    conn.send((True, sobj.to_bytes()) if sobj is not None
+                              else (False, None))
+                except (OSError, ValueError):
+                    return
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def pull_from_peer(self, address: tuple,
+                       oid_bin: bytes) -> Optional[bytes]:
+        """Pull an object's framed bytes straight from the producing
+        node's daemon. Connections cache per peer with a per-peer lock
+        (a stalled peer must not wedge pulls from OTHER peers), replies
+        are awaited under the transfer timeout, and a dead cached
+        connection gets ONE fresh redial — after that the producer is
+        treated as unreachable (the head-relay path would be talking to
+        the same dead daemon)."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        address = tuple(address)
+        timeout = GLOBAL_CONFIG.object_transfer_timeout_s
+        with self._peer_lock:
+            entry = self._peer_conns.get(address)
+            if entry is None:
+                entry = [None, threading.Lock()]
+                self._peer_conns[address] = entry
+        for _attempt in (0, 1):
+            with entry[1]:
+                try:
+                    if entry[0] is None:
+                        entry[0] = Client(address,
+                                          authkey=self._peer_authkey)
+                    conn = entry[0]
+                    conn.send(("get", oid_bin))
+                    if not conn.poll(timeout):
+                        raise OSError("peer reply timed out")
+                    ok, data = conn.recv()
+                    return data if ok else None
+                except (OSError, EOFError, ValueError):
+                    # drop the (possibly dead) connection; the second
+                    # attempt dials fresh
+                    try:
+                        if entry[0] is not None:
+                            entry[0].close()
+                    except Exception:
+                        pass
+                    entry[0] = None
+        return None
+
     def _localize(self, loc: tuple) -> tuple:
-        """Rewrite a head get-reply entry pointing at THIS node's store
-        (("node_shm", oid)) into a zero-copy arena location, restoring
-        from the spill tier when evicted."""
-        if not (isinstance(loc, tuple) and loc and loc[0] == "node_shm"):
+        """Rewrite a head get-reply entry: ("node_shm", oid) points at
+        THIS node's store (zero-copy arena location / spill restore);
+        ("peer", oid, address) directs a DIRECT pull from the producing
+        node's daemon — the bytes never touch the head."""
+        if not (isinstance(loc, tuple) and loc):
+            return loc
+        if loc[0] == "peer":
+            data = self.pull_from_peer(loc[2], loc[1])
+            if data is not None:
+                return ("inline", data)
+            return self._lost(ObjectID(loc[1]))
+        if loc[0] != "node_shm":
             return loc
         oid = ObjectID(loc[1])
         arena_loc = self.store.locate(oid)
@@ -266,6 +392,9 @@ class NodeDaemon:
         sobj = self.store.get_serialized(oid)  # spilled -> restore
         if sobj is not None:
             return ("inline", sobj.to_bytes())
+        return self._lost(oid)
+
+    def _lost(self, oid: ObjectID) -> tuple:
         import cloudpickle
 
         from ray_tpu import exceptions as rex
@@ -365,6 +494,18 @@ class NodeDaemon:
             self._listener.close()
         except Exception:
             pass
+        try:
+            self._peer_listener.close()
+        except Exception:
+            pass
+        with self._peer_lock:
+            entries, self._peer_conns = list(self._peer_conns.values()), {}
+        for entry in entries:
+            try:
+                if entry[0] is not None:
+                    entry[0].close()
+            except Exception:
+                pass
         try:
             os.rmdir(self._sock_dir)
         except OSError:

@@ -233,9 +233,13 @@ class ProcessWorkerPool:
         self._on_worker_failure(h, f"exit code {h.proc.returncode}")
 
     def _accept_loop(self) -> None:
+        from multiprocessing import AuthenticationError
+
         while not self._shutdown:
             try:
                 conn = self._listener.accept()
+            except AuthenticationError:
+                continue  # a stale/foreign dialer must not kill accepts
             except (OSError, EOFError):
                 return
             try:

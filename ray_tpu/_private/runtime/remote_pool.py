@@ -80,8 +80,12 @@ class RemoteNodePool(ProcessWorkerPool):
 
     def __init__(self, worker, num_workers: int, node_index: int, conn,
                  node_id, daemon_proc: Optional[subprocess.Popen] = None,
-                 arena_name: Optional[str] = None):
+                 arena_name: Optional[str] = None,
+                 peer_address: Optional[tuple] = None):
         self._arena_name = arena_name
+        # daemon's direct-transfer endpoint (object manager peer plane):
+        # other nodes pull object bytes straight from it, head-free
+        self.peer_address = tuple(peer_address) if peer_address else None
         self._conn = conn
         self._conn_lock = threading.Lock()
         self._conn_dead = False
@@ -323,6 +327,13 @@ class RemoteNodePool(ProcessWorkerPool):
             # already resident in the target node's arena: the worker
             # reads it zero-copy through its daemon (no wire bytes)
             return _PullValue(oid.binary())
+        if loc is not None and loc != self.node_index \
+                and self._worker.peer_address_of(loc) is not None:
+            # resident on a THIRD node with a peer endpoint: ship the
+            # pull marker — the worker's get flows daemon -> head,
+            # whose reply directs a direct peer pull (bytes travel
+            # producer node -> consumer node, never through the head)
+            return _PullValue(oid.binary())
         entry = self._worker.memory_store.get_entry(oid)
         if entry is None:
             if self._worker.object_recovery.maybe_recover(oid):
@@ -382,6 +393,14 @@ class RemoteNodePool(ProcessWorkerPool):
                     # resident on the REQUESTING node: daemon rewrites
                     # this to a zero-copy arena location
                     out.append(("node_shm", oid.binary()))
+                    continue
+                peer = self._worker.peer_address_of(value.node_index)
+                if peer is not None:
+                    # DIRECT node-to-node pull: reply with the
+                    # producer's peer endpoint; the consuming daemon
+                    # fetches the bytes itself — they never cross the
+                    # head (reference: ObjectManager pull protocol)
+                    out.append(("peer", oid.binary(), peer))
                     continue
                 data = self._worker.fetch_object_bytes(oid,
                                                        value.node_index)
@@ -456,9 +475,13 @@ class HeadServer:
         return uuid.uuid4().hex
 
     def _accept_loop(self) -> None:
+        from multiprocessing import AuthenticationError
+
         while not self._closed:
             try:
                 conn = self._listener.accept()
+            except AuthenticationError:
+                continue  # port-scan / bad-key dial must not kill accepts
             except (OSError, EOFError):
                 return
             try:

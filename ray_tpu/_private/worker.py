@@ -246,6 +246,11 @@ class Worker:
         self._head_server = None
         self.client_server = None
 
+        # cross-node transfer accounting (tests assert the head's relay
+        # stays flat when a direct peer path exists)
+        self.transfer_stats: Dict[str, int] = {"head_relayed_bytes": 0,
+                                               "head_relayed_objects": 0}
+
         # placement groups (bundle reservation over the scheduler)
         from ray_tpu._private.placement_groups import PlacementGroupManager
         self.placement_groups = PlacementGroupManager(self)
@@ -378,11 +383,25 @@ class Worker:
     def fetch_object_bytes(self, object_id: ObjectID,
                            node_index: int) -> Optional[bytes]:
         """Framed bytes of an object primary-resident on a remote node
-        (None if the node or object is gone)."""
+        (None if the node or object is gone). Every byte returned here
+        crossed the HEAD's link — the relay counter lets tests assert
+        that peer-capable transfers bypass it."""
         pool = self._node_pools.get(node_index)
         if pool is None or not getattr(pool, "is_remote", False):
             return None
-        return pool.fetch_object(object_id)
+        data = pool.fetch_object(object_id)
+        if data is not None:
+            self.transfer_stats["head_relayed_bytes"] += len(data)
+            self.transfer_stats["head_relayed_objects"] += 1
+        return data
+
+    def peer_address_of(self, node_index: int) -> Optional[tuple]:
+        """The direct-transfer endpoint of a remote node's daemon, or
+        None (head-local nodes / daemons predating the peer plane)."""
+        pool = self._node_pools.get(node_index)
+        if pool is not None and getattr(pool, "is_remote", False):
+            return getattr(pool, "peer_address", None)
+        return None
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         ids = [r.object_id() for r in refs]
@@ -617,6 +636,7 @@ class Worker:
                                "head within 30s")
         conn, hello = slot[0], slot[1]
         arena_name = hello[3] if len(hello) > 3 else None
+        peer_address = hello[4] if len(hello) > 4 else None
         custom = sum((resources or {}).values())
         node_id = NodeID.from_random()
         state = NodeState((num_cpus, num_tpus, 1e18, custom),
@@ -624,7 +644,8 @@ class Worker:
         row = self.scheduler.add_node(state)
         pool = RemoteNodePool(self, num_workers or max(int(num_cpus), 1),
                               row, conn, node_id, daemon_proc=proc,
-                              arena_name=arena_name)
+                              arena_name=arena_name,
+                              peer_address=peer_address)
         self._node_pools[row] = pool
         entry = self.gcs.register_node(
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus,
@@ -674,6 +695,7 @@ class Worker:
         from ray_tpu._private.runtime.remote_pool import RemoteNodePool
 
         arena_name, info = hello[3], hello[4]
+        peer_address = hello[5] if len(hello) > 5 else None
         num_cpus = float(info.get("num_cpus", 4.0))
         num_tpus = float(info.get("num_tpus", 0.0))
         resources = dict(info.get("resources") or {})
@@ -687,7 +709,8 @@ class Worker:
         # be reaped after death (on another host the name matches
         # nothing here and the reap is a no-op)
         pool = RemoteNodePool(self, num_workers, row, conn, node_id,
-                              daemon_proc=None, arena_name=arena_name)
+                              daemon_proc=None, arena_name=arena_name,
+                              peer_address=peer_address)
         self._node_pools[row] = pool
         entry = self.gcs.register_node(
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus, **resources},

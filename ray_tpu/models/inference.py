@@ -488,9 +488,13 @@ class InferenceEngine:
         width = 2 + bucket + n_prog
         # FIXED program shape: always batch_size rows (dummies padded).
         # Admission arrival order races the submitter, so group sizes
-        # are nondeterministic — shape-per-size would compile at
-        # unpredictable moments; one shape per bucket compiles once,
-        # and dummy-row prefill compute is negligible
+        # are nondeterministic — shape-per-size programs would compile
+        # at unpredictable moments mid-serving (measured as multi-second
+        # stalls); one shape per bucket compiles exactly once. The cost
+        # is dummy rows running the full prefill forward, which is
+        # bounded by bucket length (say 16 rows x 128 tokens on a small
+        # model ~ well under a millisecond of device time) and is paid
+        # only at admission, never per decode step.
         n = self.cfg.batch_size
         packed = np.zeros((n, width), np.int32)
         # dummy pad rows: scatter target out of bounds (dropped), pages

@@ -51,13 +51,28 @@ class LLMDeployment:
     # the SSE-emission shape of the reference's serve.llm streaming.
     # In-process callers can take the engine's TokenStream directly.
 
+    _STREAM_TTL_S = 600.0
+
+    def _sweep_streams(self) -> None:
+        """Drop streams nobody has polled within the TTL — a client
+        that started a stream and disconnected must not pin its
+        TokenStream (and buffered tokens) for the replica's lifetime."""
+        import time
+
+        now = time.monotonic()
+        for sid, (stream, last) in list(self._streams.items()):
+            if now - last > self._STREAM_TTL_S:
+                self._streams.pop(sid, None)
+
     def start_stream(self, prompt: Sequence[int],
                      max_new_tokens: Optional[int] = None) -> str:
+        import time
         import uuid
 
+        self._sweep_streams()
         stream = self._engine.submit_stream(list(prompt), max_new_tokens)
         sid = uuid.uuid4().hex
-        self._streams[sid] = stream
+        self._streams[sid] = (stream, time.monotonic())
         return sid
 
     def next_tokens(self, stream_id: str,
@@ -66,10 +81,13 @@ class LLMDeployment:
         then drain everything currently buffered. Returns
         {"tokens": [...], "done": bool}."""
         import queue as _q
+        import time
 
-        stream = self._streams.get(stream_id)
-        if stream is None:
+        entry = self._streams.get(stream_id)
+        if entry is None:
             raise KeyError(f"unknown stream {stream_id!r}")
+        stream = entry[0]
+        self._streams[stream_id] = (stream, time.monotonic())
         from ray_tpu.models.inference import _STREAM_END
 
         tokens: List[int] = []

@@ -113,8 +113,18 @@ class TestRemoteNodeBasics:
         def consume(x):
             return float(x[0] + x[-1])
 
-        # produced on node a, consumed on node b: head-mediated transfer
+        # produced on node a, consumed on node b: DIRECT node-to-node
+        # pull over the daemons' peer transfer plane — the bytes never
+        # cross the head's link (reference: ObjectManager pull/push,
+        # ray: src/ray/object_manager/)
+        w = worker_mod.get_worker()
+        relayed0 = w.transfer_stats["head_relayed_bytes"]
         assert ray_tpu.get(consume.remote(produce.remote())) == 6.0
+        assert w.transfer_stats["head_relayed_bytes"] == relayed0, \
+            "B->C transfer routed bytes through the head"
+        # the peer plane is really wired, not skipped
+        assert all(w.peer_address_of(e.index) is not None
+                   for e in w.gcs.node_table() if e.kind == "remote")
 
     def test_head_task_consumes_remote_object(self, cluster):
         cluster.add_node(num_cpus=2, remote=True, resources={"away": 2.0})
