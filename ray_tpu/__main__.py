@@ -74,8 +74,15 @@ def _cmd_start(args) -> int:
             kw["num_cpus"] = args.num_cpus
         if args.num_workers:
             kw["num_workers"] = args.num_workers
+        sys_cfg = {}
         if args.worker_mode:
-            kw["_system_config"] = {"worker_mode": args.worker_mode}
+            sys_cfg["worker_mode"] = args.worker_mode
+        if args.gcs_journal:
+            # control-plane FT: journal GCS mutations; a restarted head
+            # replays them and re-adopts rejoining node daemons
+            sys_cfg["gcs_journal_path"] = args.gcs_journal
+        if sys_cfg:
+            kw["_system_config"] = sys_cfg
         ray_tpu.init(**kw)
         w = worker_mod.get_worker()
         hs = w.enable_head_endpoint(host=args.host, port=args.port)
@@ -115,7 +122,9 @@ def _cmd_start(args) -> int:
     daemon = NodeDaemon((host, port), key, "join",
                         GLOBAL_CONFIG.object_store_memory,
                         GLOBAL_CONFIG.inline_object_max_bytes,
-                        join_info=info)
+                        join_info=info,
+                        rejoin_timeout_s=GLOBAL_CONFIG
+                        .daemon_rejoin_timeout_s)
     print(f"ray_tpu node joined head at {host}:{port} "
           f"(pid {os.getpid()})", flush=True)
     daemon.run()
@@ -198,6 +207,10 @@ def main(argv=None) -> int:
                    help='JSON dict of named resources, e.g. \'{"a": 2}\'')
     p.add_argument("--worker-mode", default="",
                    choices=["", "thread", "process"])
+    p.add_argument("--gcs-journal", default="",
+                   help="GCS write-ahead journal path; restarting the "
+                   "head with the same path restores its tables and "
+                   "re-adopts surviving node daemons")
     p.add_argument("--jax-coordinator", default="",
                    help="host:port of the jax.distributed coordinator — "
                    "joins this process into the multi-host (DCN) device "

@@ -115,6 +115,14 @@ _d = GLOBAL_CONFIG.define
 # -- core ------------------------------------------------------------------
 _d("num_workers", int, 0, "worker threads/processes; 0 = os.cpu_count()")
 _d("worker_mode", str, "thread", "worker execution backend: thread | process")
+_d("gcs_journal_path", str, "",
+   "write-ahead journal for GCS table mutations (reference: Redis "
+   "persistence); a restarted head replays it and re-adopts rejoining "
+   "node daemons. Empty = no persistence (head is a SPOF)")
+_d("daemon_rejoin_timeout_s", float, 20.0,
+   "how long an orphaned node daemon (head connection lost without an "
+   "exit) retries reconnecting to the head address before giving up "
+   "and dying; 0 = die immediately (pre-FT behavior)")
 _d("worker_tpu_access", bool, False,
    "give process workers the TPU plugin bootstrap (default: the head "
    "owns the chip; workers run CPU jax, starting seconds faster)")

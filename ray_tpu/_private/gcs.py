@@ -61,10 +61,83 @@ class ActorEntry:
         self.job_id = job_id
 
 
+class GcsJournal:
+    """Write-ahead journal of GCS table mutations (reference: the GCS's
+    Redis persistence, ray: src/ray/gcs/store_client/ — the control
+    plane replays its tables after a restart while raylets keep
+    running). Append-only pickled tuples, flushed per record."""
+
+    def __init__(self, path: str):
+        import os
+
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # truncate any torn tail record (crash mid-append) BEFORE
+        # appending: writing after torn bytes would make every later op
+        # unreachable to the next replay
+        intact = self._intact_size(path)
+        self._f = open(path, "ab")
+        if intact is not None and self._f.tell() > intact:
+            self._f.truncate(intact)
+            self._f.seek(intact)
+        self._wlock = threading.Lock()
+
+    @staticmethod
+    def _intact_size(path: str) -> Optional[int]:
+        import os
+        import pickle
+
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            offset = 0
+            while True:
+                try:
+                    pickle.load(f)
+                    offset = f.tell()
+                except EOFError:
+                    return offset
+                except Exception:
+                    return offset
+
+    def append(self, op: Tuple) -> None:
+        import pickle
+
+        with self._wlock:
+            pickle.dump(op, self._f)
+            self._f.flush()
+
+    @staticmethod
+    def replay(path: str) -> List[Tuple]:
+        import os
+        import pickle
+
+        if not os.path.exists(path):
+            return []
+        ops: List[Tuple] = []
+        with open(path, "rb") as f:
+            while True:
+                try:
+                    ops.append(pickle.load(f))
+                except EOFError:
+                    break
+                except Exception:
+                    # torn tail write (crash mid-append): replay what is
+                    # intact, drop the rest
+                    break
+        return ops
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+
 class GcsService:
     """Node/actor/job tables + KV + pubsub + health checks."""
 
-    def __init__(self, worker):
+    def __init__(self, worker, journal: Optional[GcsJournal] = None):
         self._worker = worker
         self._lock = threading.RLock()
         self._nodes: Dict[NodeID, NodeEntry] = {}
@@ -73,6 +146,13 @@ class GcsService:
         self._actor_names: Dict[Tuple[str, str], ActorID] = {}
         self._jobs: Dict[JobID, Dict[str, Any]] = {}
         self._kv: Dict[Tuple[str, bytes], bytes] = {}
+        # detached-actor recovery payloads (cloudpickled (cls, opts)):
+        # the reference keeps the serialized creation spec in the actor
+        # table for exactly this (restart/recovery) purpose
+        self._actor_recovery: Dict[ActorID, bytes] = {}
+        self._journal = journal
+        if journal is not None:
+            self._replay(GcsJournal.replay(journal.path))
         # object directory: primary-copy location of objects resident in
         # REMOTE node arenas (reference: the object directory the object
         # manager consults before a Pull —
@@ -82,6 +162,60 @@ class GcsService:
         self._sub_seq = 0
         self._health_thread: Optional[threading.Thread] = None
         self._shutdown = False
+
+    # ------------------------------------------------------------------
+    # journal replay (restore-in-place after a head restart)
+    # ------------------------------------------------------------------
+    def _replay(self, ops: List[Tuple]) -> None:
+        """Rebuild actor + KV tables from the WAL. Replayed actors come
+        back ORPHANED: name-resolvable immediately, runnable once their
+        node daemon rejoins and the runtime re-attaches. Nodes are NOT
+        journaled — live daemons re-register themselves."""
+        for op in ops:
+            kind = op[0]
+            if kind == "actor":
+                _, abin, name, ns, class_name, recovery = op
+                actor_id = ActorID(abin)
+                entry = ActorEntry(actor_id, name, ns, class_name, None)
+                entry.state = "ORPHANED"
+                self._actors[actor_id] = entry
+                if name:
+                    self._actor_names[(ns, name)] = actor_id
+                if recovery is not None:
+                    self._actor_recovery[actor_id] = recovery
+            elif kind == "actor_state":
+                _, abin, state = op
+                e = self._actors.get(ActorID(abin))
+                if e is not None:
+                    e.state = state if state != "ALIVE" else "ORPHANED"
+                    if state == "DEAD":
+                        if e.name:
+                            self._actor_names.pop((e.namespace, e.name),
+                                                  None)
+                        self._actors.pop(ActorID(abin), None)
+                        self._actor_recovery.pop(ActorID(abin), None)
+            elif kind == "kv_put":
+                _, ns, k, v = op
+                self._kv[(ns, k)] = v
+            elif kind == "kv_del":
+                _, ns, k = op
+                self._kv.pop((ns, k), None)
+        if ops:
+            logger.info("GCS journal replayed: %d ops, %d actors, %d kv",
+                        len(ops), len(self._actors), len(self._kv))
+
+    def _log(self, op: Tuple) -> None:
+        if self._journal is not None:
+            self._journal.append(op)
+
+    def actor_recovery_blob(self, actor_id: ActorID) -> Optional[bytes]:
+        with self._lock:
+            return self._actor_recovery.get(actor_id)
+
+    def orphaned_actor(self, actor_id: ActorID) -> Optional[ActorEntry]:
+        with self._lock:
+            e = self._actors.get(actor_id)
+            return e if e is not None and e.state == "ORPHANED" else None
 
     # ------------------------------------------------------------------
     # node table (reference: GcsNodeManager)
@@ -152,7 +286,10 @@ class GcsService:
     # actor metadata and name resolution)
     # ------------------------------------------------------------------
     def register_actor(self, actor_id: ActorID, name: str, namespace: str,
-                       class_name: str, job_id=None) -> ActorEntry:
+                       class_name: str, job_id=None,
+                       recovery: Optional[bytes] = None) -> ActorEntry:
+        """``recovery`` (cloudpickled (cls, opts), detached actors only)
+        makes the actor re-attachable after a head restart."""
         entry = ActorEntry(actor_id, name, namespace, class_name, job_id)
         with self._lock:
             if name and (namespace, name) in self._actor_names:
@@ -162,6 +299,11 @@ class GcsService:
             self._actors[actor_id] = entry
             if name:
                 self._actor_names[(namespace, name)] = actor_id
+            if recovery is not None:
+                self._actor_recovery[actor_id] = recovery
+        if recovery is not None:
+            self._log(("actor", actor_id.binary(), name, namespace,
+                       class_name, recovery))
         self.publish(CH_ACTOR, {"event": "REGISTERED",
                                 "actor_id": actor_id})
         return entry
@@ -177,6 +319,11 @@ class GcsService:
                 e.node_index = node_index
             if state == "DEAD" and e.name:
                 self._actor_names.pop((e.namespace, e.name), None)
+            journaled = actor_id in self._actor_recovery
+            if state == "DEAD":
+                self._actor_recovery.pop(actor_id, None)
+        if journaled:
+            self._log(("actor_state", actor_id.binary(), state))
         self.publish(CH_ACTOR, {"event": state, "actor_id": actor_id})
 
     def get_actor_by_name(self, name: str,
@@ -221,6 +368,7 @@ class GcsService:
                namespace: str = "") -> None:
         with self._lock:
             self._kv[(namespace, bytes(key))] = bytes(value)
+        self._log(("kv_put", namespace, bytes(key), bytes(value)))
 
     def kv_get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
         with self._lock:
@@ -228,7 +376,10 @@ class GcsService:
 
     def kv_del(self, key: bytes, namespace: str = "") -> bool:
         with self._lock:
-            return self._kv.pop((namespace, bytes(key)), None) is not None
+            hit = self._kv.pop((namespace, bytes(key)), None) is not None
+        if hit:
+            self._log(("kv_del", namespace, bytes(key)))
+        return hit
 
     def kv_keys(self, prefix: bytes = b"",
                 namespace: str = "") -> List[bytes]:

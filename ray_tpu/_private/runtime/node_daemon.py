@@ -61,7 +61,8 @@ from ray_tpu._private.ids import ObjectID
 
 
 class _WorkerSlot:
-    __slots__ = ("num", "proc", "conn", "ctrl", "pid", "returns", "gets")
+    __slots__ = ("num", "proc", "conn", "ctrl", "pid", "returns", "gets",
+                 "actor_bin")
 
     def __init__(self, num: int):
         self.num = num
@@ -75,13 +76,17 @@ class _WorkerSlot:
         # req_ids of get RPCs forwarded to the head, whose replies may
         # carry ("node_shm", oid) markers to rewrite as arena locations
         self.gets: set = set()
+        # dedicated actor workers record their actor id (from the
+        # actor_create payload) so a RESTARTED head can re-adopt them
+        self.actor_bin: Optional[bytes] = None
 
 
 class NodeDaemon:
     def __init__(self, head_address, head_authkey: bytes,
                  node_token: str, object_store_memory: int,
                  inline_max: int, spill_dir: Optional[str] = None,
-                 join_info: Optional[dict] = None):
+                 join_info: Optional[dict] = None,
+                 rejoin_timeout_s: float = 20.0):
         from ray_tpu._private.runtime.shm_store import ShmObjectStore
 
         self.store = ShmObjectStore(object_store_memory,
@@ -90,6 +95,15 @@ class NodeDaemon:
         self._slots: Dict[int, _WorkerSlot] = {}
         self._lock = threading.Lock()
         self._shutdown = False
+        self._head_address = tuple(head_address)
+        self._head_authkey = head_authkey
+        self._node_info = dict(join_info or {})
+        # control-plane FT: a lost head connection WITHOUT an explicit
+        # exit leaves this node orphaned-but-alive; it re-dials the head
+        # address (same cluster secret, persisted beside the head's GCS
+        # journal) for this long before giving up. Workers — and actor
+        # STATE living in their processes — survive the head restart.
+        self._rejoin_timeout_s = rejoin_timeout_s
 
         # workers dial this daemon, never the head (they may share no
         # filesystem/host with it)
@@ -417,7 +431,19 @@ class NodeDaemon:
             try:
                 msg = self._head.recv()
             except (EOFError, OSError):
-                break  # head gone: the node dies with it
+                # head gone WITHOUT an exit: orphaned. Try to rejoin a
+                # restarted head at the same address; workers (and the
+                # actor state inside them) stay alive meanwhile.
+                import logging
+                logging.getLogger(__name__).warning(
+                    "head connection lost; trying to rejoin %s for %.0fs",
+                    self._head_address, self._rejoin_timeout_s)
+                if self._rejoin_timeout_s > 0 and self._try_rejoin():
+                    logging.getLogger(__name__).warning(
+                        "rejoined head at %s; workers survived",
+                        self._head_address)
+                    continue
+                break  # no head came back: the node dies
             kind = msg[0]
             if kind == "spawn":
                 self._spawn(msg[1])
@@ -431,6 +457,13 @@ class NodeDaemon:
                         rids = p.get("return_ids")
                         if rids:
                             slot.returns[p["task_id"]] = list(rids)
+                        if payload[0] == "actor_create":
+                            slot.actor_bin = p.get("actor_bin")
+                    elif payload[0] == "tasks":
+                        for p in payload[1]:
+                            rids = p.get("return_ids")
+                            if rids:
+                                slot.returns[p["task_id"]] = list(rids)
                     elif (payload[0] == "reply"
                           and payload[1] in slot.gets):
                         slot.gets.discard(payload[1])
@@ -474,6 +507,59 @@ class NodeDaemon:
                 break
         self.shutdown()
 
+    def _try_rejoin(self) -> bool:
+        """Re-dial the head address until a (restarted) head accepts
+        this node back. The rejoin hello reports the live workers —
+        numbers, pids, and which actor each dedicated worker hosts —
+        so the new head re-adopts them instead of spawning fresh."""
+        import time
+
+        deadline = time.monotonic() + self._rejoin_timeout_s
+        while not self._shutdown and time.monotonic() < deadline:
+            try:
+                head = Client(self._head_address,
+                              authkey=self._head_authkey)
+            except Exception:  # conn refused / auth failure / reset
+                time.sleep(0.5)
+                continue
+            # plain workers still executing a PRE-crash task are
+            # killed, not reported: their owner died with the old head,
+            # so the in-flight work is orphaned, and the new head must
+            # not queue fresh tasks behind it (actors keep running —
+            # their state is the thing being saved)
+            with self._lock:
+                stale = [s for s in self._slots.values()
+                         if s.returns and s.actor_bin is None
+                         and s.proc is not None and s.proc.poll() is None]
+            for s in stale:
+                try:
+                    s.proc.kill()
+                    s.proc.wait(timeout=5.0)
+                except Exception:
+                    pass
+            with self._lock:
+                workers = {
+                    s.num: {"pid": s.pid,
+                            "actor": (s.actor_bin.hex()
+                                      if s.actor_bin else None)}
+                    for s in self._slots.values()
+                    if s.proc is not None and s.proc.poll() is None}
+            try:
+                head.send(("hello", "rejoin", os.getpid(),
+                           self.store.arena.name, dict(self._node_info),
+                           tuple(self.peer_address), workers))
+            except (OSError, ValueError):
+                try:
+                    head.close()
+                except Exception:
+                    pass
+                time.sleep(0.5)
+                continue
+            with self._head_lock:
+                self._head = head
+            return True
+        return False
+
     def shutdown(self) -> None:
         self._shutdown = True
         with self._lock:
@@ -515,18 +601,21 @@ class NodeDaemon:
 
 def _main(argv) -> None:
     """``python -m ray_tpu._private.runtime.node_daemon <host> <port>
-    <token> <object_store_memory> <inline_max> [join_info_json]`` with
-    the head authkey in RAY_TPU_HEAD_AUTHKEY. Exec'd by the head's
-    Cluster harness, or self-started with token "join" by
-    `ray_tpu start --address=...` on another machine."""
+    <token> <object_store_memory> <inline_max> [join_info_json]
+    [rejoin_timeout_s]`` with the head authkey in
+    RAY_TPU_HEAD_AUTHKEY. Exec'd by the head's Cluster harness, or
+    self-started with token "join" by `ray_tpu start --address=...`
+    on another machine."""
     import json
 
     host, port, token = argv[0], int(argv[1]), argv[2]
     mem, inline_max = int(argv[3]), int(argv[4])
-    join_info = json.loads(argv[5]) if len(argv) > 5 else None
+    join_info = (json.loads(argv[5])
+                 if len(argv) > 5 and argv[5] else None)
+    rejoin = float(argv[6]) if len(argv) > 6 else 20.0
     authkey = bytes.fromhex(os.environ["RAY_TPU_HEAD_AUTHKEY"])
     daemon = NodeDaemon((host, port), authkey, token, mem, inline_max,
-                        join_info=join_info)
+                        join_info=join_info, rejoin_timeout_s=rejoin)
     daemon.run()
 
 

@@ -216,6 +216,31 @@ class RemoteNodePool(ProcessWorkerPool):
         self._send_daemon(("spawn", num))
         return h
 
+    def adopt_worker(self, num: int, pid: Optional[int],
+                     is_actor: bool) -> _Handle:
+        """Attach a handle to a worker process that ALREADY RUNS on the
+        rejoining daemon (head-restart re-adoption): same plumbing as
+        _spawn minus the spawn message — the process is alive, so it is
+        ready by construction."""
+        with self._lock:
+            self._worker_seq = max(self._worker_seq, num)
+        h = _Handle(num)
+        h.conn = _ProxyConn(self, num, "to_w")
+        h.ctrl = _ProxyConn(self, num, "to_ctrl")
+        h.pid = pid
+        h.ready = True
+        q: queue.Queue = queue.Queue()
+        self._hqueues[num] = q
+        with self._lock:
+            self._by_num[num] = h
+        threading.Thread(target=self._queue_loop, args=(h, q), daemon=True,
+                         name=f"ray_tpu_remote_w{num}").start()
+        if not is_actor:
+            with self._lock:
+                self._handles.append(h)
+            self._mark_idle(h)
+        return h
+
     def _queue_loop(self, h: _Handle, q: queue.Queue) -> None:
         """Per-worker message pump — the remote analog of the local
         per-worker reader thread (same ordering guarantees)."""

@@ -301,19 +301,26 @@ class EventScheduler(SchedulerBase):
             return len(self._nodes)
 
     # -- node management (used by the virtual cluster test util) -----------
-    def add_node(self, node: NodeState) -> int:
-        to_dispatch = []
+    def add_node(self, node: NodeState, wake: bool = True) -> int:
+        """wake=False defers dispatch until the caller finishes wiring
+        the node (pool registration) and calls poke() — see
+        TensorScheduler.add_node."""
         with self._lock:
             self._nodes.append(node)
             idx = len(self._nodes) - 1
-            # a new node can make previously-infeasible tasks feasible;
-            # without this rescan they would be parked forever
+        if wake:
+            # a new node can make previously-infeasible tasks feasible
+            self.poke()
+        return idx
+
+    def poke(self) -> None:
+        to_dispatch = []
+        with self._lock:
             if self._infeasible:
                 self._ready.extend(self._infeasible)
                 self._infeasible.clear()
             to_dispatch = self._drain_ready_locked()
         self._run_dispatch(to_dispatch)
-        return idx
 
     def remove_node(self, node_index: int) -> None:
         with self._lock:

@@ -319,12 +319,24 @@ class TensorScheduler(SchedulerBase):
             return len(self._node_states)
 
     # -- node management ---------------------------------------------------
-    def add_node(self, node: NodeState) -> int:
+    def add_node(self, node: NodeState, wake: bool = True) -> int:
+        """wake=False appends the row WITHOUT waking the tick thread:
+        callers that must finish wiring (e.g. registering the node's
+        worker pool) before any task can dispatch to the row call
+        poke() afterwards — dispatching into a half-registered node
+        races pool_for_node() to None."""
         with self._wake:
             idx = self._append_node(node)
+            if wake:
+                self._dirty = True
+                self._wake.notify()
+            return idx
+
+    def poke(self) -> None:
+        """Wake the tick thread (schedulability may have changed)."""
+        with self._wake:
             self._dirty = True
             self._wake.notify()
-            return idx
 
     def remove_node(self, node_index: int) -> None:
         with self._wake:

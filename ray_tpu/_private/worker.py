@@ -227,8 +227,11 @@ class Worker:
             self.scheduler = EventScheduler([node], dispatcher, contains)
 
         # control plane (node/actor/job tables, KV, pubsub, health checks)
-        from ray_tpu._private.gcs import GcsService
-        self.gcs = GcsService(self)
+        from ray_tpu._private.gcs import GcsJournal, GcsService
+        journal = None
+        if GLOBAL_CONFIG.gcs_journal_path:
+            journal = GcsJournal(GLOBAL_CONFIG.gcs_journal_path)
+        self.gcs = GcsService(self, journal=journal)
         self.gcs.register_node(
             self.node_id, 0,
             {"CPU": capacity_cpu, "TPU": _detect_tpu_count(),
@@ -588,10 +591,11 @@ class Worker:
         state = NodeState((num_cpus, num_tpus, 1e18, custom),
                           node_id=node_id, custom_resources=resources,
                           window_factor=auto_pipeline_depth(nw))
-        row = self.scheduler.add_node(state)
+        row = self.scheduler.add_node(state, wake=False)
         pool = ProcessWorkerPool(self, nw,
                                  self.shm_store, node_index=row)
         self._node_pools[row] = pool
+        self.scheduler.poke()
         entry = self.gcs.register_node(
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus,
                            **(resources or {})},
@@ -624,11 +628,17 @@ class Worker:
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in sys.path if p) + os.pathsep + env.get("PYTHONPATH", "")
         host, port = self._head_server.address
+        import json as _json
+        info = _json.dumps({"num_cpus": num_cpus, "num_tpus": num_tpus,
+                            "resources": resources or {},
+                            "num_workers": num_workers
+                            or max(int(num_cpus), 1)})
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.runtime.node_daemon",
              host, str(port), token,
              str(GLOBAL_CONFIG.object_store_memory),
-             str(GLOBAL_CONFIG.inline_object_max_bytes)],
+             str(GLOBAL_CONFIG.inline_object_max_bytes),
+             info, str(GLOBAL_CONFIG.daemon_rejoin_timeout_s)],
             env=env, close_fds=True)
         if not slot_ev.wait(timeout=30.0) or not slot:
             proc.kill()
@@ -641,12 +651,16 @@ class Worker:
         node_id = NodeID.from_random()
         state = NodeState((num_cpus, num_tpus, 1e18, custom),
                           node_id=node_id, custom_resources=resources)
-        row = self.scheduler.add_node(state)
+        # row wiring order: the pool must be reachable through
+        # pool_for_node BEFORE the scheduler may dispatch to the row, or
+        # a pending task/actor lands on a half-registered node
+        row = self.scheduler.add_node(state, wake=False)
         pool = RemoteNodePool(self, num_workers or max(int(num_cpus), 1),
                               row, conn, node_id, daemon_proc=proc,
                               arena_name=arena_name,
                               peer_address=peer_address)
         self._node_pools[row] = pool
+        self.scheduler.poke()
         entry = self.gcs.register_node(
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus,
                            **(resources or {})},
@@ -672,7 +686,30 @@ class Worker:
                     f"enable_head_endpoint(host=..., port=...) BEFORE "
                     f"adding remote nodes to pick the bind address")
         if self._head_server is None:
-            self._head_server = HeadServer(host, port)
+            authkey = None
+            if GLOBAL_CONFIG.gcs_journal_path:
+                # persist (port, authkey) beside the journal: after a
+                # head restart, orphaned daemons re-dial the SAME
+                # address with the SAME cluster secret
+                import json as _json
+                secret_path = GLOBAL_CONFIG.gcs_journal_path + ".secret"
+                if os.path.exists(secret_path):
+                    with open(secret_path) as f:
+                        d = _json.load(f)
+                    authkey = bytes.fromhex(d["authkey"])
+                    if port == 0:
+                        port = int(d["port"])
+                self._head_server = HeadServer(host, port, authkey=authkey)
+                # the authkey is the cluster credential: owner-only
+                # permissions, like ssh key material
+                fd = os.open(secret_path,
+                             os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+                with os.fdopen(fd, "w") as f:
+                    _json.dump({"authkey":
+                                self._head_server.authkey.hex(),
+                                "port": self._head_server.address[1]}, f)
+            else:
+                self._head_server = HeadServer(host, port)
         if self.client_server is None:
             self.client_server = ClientServer(self)
         self._head_server.on_unsolicited = self._on_unsolicited_hello
@@ -684,6 +721,8 @@ class Worker:
             self.client_server.attach(conn, hello)
         elif kind == "join" and len(hello) >= 5:
             self.adopt_remote_node(conn, hello)
+        elif kind == "rejoin" and len(hello) >= 7:
+            self.readopt_remote_node(conn, hello)
         else:
             conn.close()
 
@@ -704,7 +743,7 @@ class Worker:
         state = NodeState((num_cpus, num_tpus, 1e18,
                            sum(resources.values())),
                           node_id=node_id, custom_resources=resources)
-        row = self.scheduler.add_node(state)
+        row = self.scheduler.add_node(state, wake=False)
         # arena_name travels so a SAME-host joined daemon's segment can
         # be reaped after death (on another host the name matches
         # nothing here and the reap is a no-op)
@@ -712,12 +751,71 @@ class Worker:
                               daemon_proc=None, arena_name=arena_name,
                               peer_address=peer_address)
         self._node_pools[row] = pool
+        self.scheduler.poke()
         entry = self.gcs.register_node(
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus, **resources},
             kind="remote", pool=pool)
         self.gcs.start_health_checks()
         logger.info("adopted remote node %s (row %d, arena %s)",
                     node_id.hex()[:16], row, arena_name)
+        return entry
+
+    def readopt_remote_node(self, conn, hello: tuple):
+        """Control-plane FT, node side: an orphaned daemon (its head
+        died without an exit) rejoins a RESTARTED head. Its live worker
+        processes are adopted instead of respawned, and dedicated
+        workers hosting journaled (detached) actors get their runtimes
+        re-attached — actor state survives the head restart inside the
+        worker process (reference: GCS restart with Redis replay while
+        raylets keep running, SURVEY.md §5 GCS FT)."""
+        from ray_tpu._private.ids import ActorID
+        from ray_tpu._private.runtime.remote_pool import RemoteNodePool
+
+        _, _, pid, arena_name, info, peer_address, workers = hello[:7]
+        num_cpus = float(info.get("num_cpus", 4.0))
+        num_tpus = float(info.get("num_tpus", 0.0))
+        resources = dict(info.get("resources") or {})
+        node_id = NodeID.from_random()
+        state = NodeState((num_cpus, num_tpus, 1e18,
+                           sum(resources.values())),
+                          node_id=node_id, custom_resources=resources)
+        row = self.scheduler.add_node(state, wake=False)
+        pool = RemoteNodePool(self, 0, row, conn, node_id,
+                              daemon_proc=None, arena_name=arena_name,
+                              peer_address=peer_address)
+        self._node_pools[row] = pool
+        adopted_actors = 0
+        for num, winfo in sorted(workers.items()):
+            actor_hex = winfo.get("actor")
+            h = pool.adopt_worker(int(num), winfo.get("pid"),
+                                  is_actor=actor_hex is not None)
+            if actor_hex is None:
+                continue
+            actor_id = ActorID(bytes.fromhex(actor_hex))
+            entry = self.gcs.orphaned_actor(actor_id)
+            recovery = self.gcs.actor_recovery_blob(actor_id)
+            if entry is None or recovery is None:
+                # not a journaled detached actor: its owner died with
+                # the old head — release the worker
+                pool.release_actor_worker(h, kill=True)
+                continue
+            try:
+                from ray_tpu.actor import adopt_process_actor
+                adopt_process_actor(self, actor_id, entry, recovery,
+                                    pool, h, row)
+                adopted_actors += 1
+            except Exception:
+                logger.exception("actor %s re-adoption failed",
+                                 actor_id.hex()[:16])
+                pool.release_actor_worker(h, kill=True)
+        entry = self.gcs.register_node(
+            node_id, row, {"CPU": num_cpus, "TPU": num_tpus, **resources},
+            kind="remote", pool=pool)
+        self.gcs.start_health_checks()
+        self.scheduler.poke()
+        logger.info("re-adopted node %s (row %d): %d workers, "
+                    "%d actors", node_id.hex()[:16], row, len(workers),
+                    adopted_actors)
         return entry
 
     def on_node_failure(self, node_id: NodeID, reason: str = "") -> None:

@@ -109,6 +109,9 @@ class _ActorRuntime:
         self._threads: List[threading.Thread] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stopped = threading.Event()
+        # True when re-attached to an already-initialized worker after a
+        # head restart: the inbox loop starts, __init__ does NOT re-run
+        self._adopted = False
 
     # -- runtime_env (thread-mode actors share the driver process: env
     # vars save/restore around init and each call, same documented
@@ -173,10 +176,13 @@ class _ActorRuntime:
 
     def _sync_main(self, thread_index: int):
         if thread_index == 0:
-            ok = self._run_init()
-            if not ok:
-                self._drain_with_error()
-                return
+            if self._adopted:
+                self.init_done.set()  # worker already holds the instance
+            else:
+                ok = self._run_init()
+                if not ok:
+                    self._drain_with_error()
+                    return
         else:
             self.init_done.wait()
             if self.state == ActorState.DEAD:
@@ -543,7 +549,10 @@ class _ProcessActorRuntime(_ActorRuntime):
             _time.sleep(0.005)
         creation_oid = _creation_object_id(self.actor_id)
         h = self._h
-        extra = dict(cls_blob=cloudpickle.dumps(self.cls))
+        # actor_bin lets the node daemon record WHICH actor this
+        # dedicated worker hosts (head-restart re-adoption)
+        extra = dict(cls_blob=cloudpickle.dumps(self.cls),
+                     actor_bin=self.actor_id.binary())
         env_vars = (self._creation_spec.runtime_env or {}).get("env_vars")
         if env_vars:
             # the actor OWNS its worker process: env_vars apply for its
@@ -764,6 +773,13 @@ class ActorHandle:
         self._class_name = class_name
         self._seq = 0
         self._seq_lock = threading.Lock()
+        # per-handle task-id namespace salt. RANDOM, not id(self): a
+        # handle in a RESTARTED head (or one allocated at a recycled
+        # address) must not reuse an old handle's task ids — the old
+        # results may still sit in a surviving node arena, and a
+        # colliding create would reject the new result
+        import os as _os
+        self._salt = int.from_bytes(_os.urandom(2), "big")
 
     @property
     def actor_id(self) -> ActorID:
@@ -802,7 +818,7 @@ class ActorHandle:
             self._seq += 1
             seq = self._seq
         task_id = TaskID.for_actor_task(self._actor_id,
-                                        (id(self) & 0xFFFF) * 65536 + seq)
+                                        self._salt * 65536 + seq)
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(num_returns)]
         for oid in return_ids:
@@ -908,9 +924,24 @@ class ActorClass:
         is_async = any(inspect.iscoroutinefunction(m) for _, m in
                        inspect.getmembers(cls, inspect.isfunction))
         # actor registry: the GCS actor table is the source of truth
-        # (reference: GcsActorManager)
+        # (reference: GcsActorManager). DETACHED actors additionally
+        # journal a recovery payload: they are meant to outlive their
+        # owner, so a restarted head can re-attach them to their still-
+        # running worker process (the reference keeps the serialized
+        # creation spec in the actor table for the same reason).
+        recovery = None
+        if copts.get("lifetime") == "detached":
+            import cloudpickle
+            try:
+                # init args ride along: a re-adopted actor that later
+                # crashes restarts through the normal max_restarts path,
+                # which re-runs __init__ with these
+                recovery = cloudpickle.dumps((cls, copts, args, kwargs))
+            except Exception:
+                recovery = None  # unpicklable class: no head-restart FT
         worker.gcs.register_actor(actor_id, name or "", namespace,
-                                  self._cls.__name__, worker.job_id)
+                                  self._cls.__name__, worker.job_id,
+                                  recovery=recovery)
 
         def create(pending, node_index, _worker=worker):
             # process mode: sync single-threaded actors get a dedicated
@@ -939,6 +970,54 @@ class ActorClass:
         _submit_actor_creation(worker, pending, create)
         handle = ActorHandle(actor_id, self._cls.__name__)
         return handle
+
+
+def adopt_process_actor(worker, actor_id: ActorID, entry, recovery: bytes,
+                        pool, h, node_index: int):
+    """Re-attach a journaled detached actor to its STILL-RUNNING worker
+    process after a head restart (see Worker.readopt_remote_node). The
+    worker holds the live instance; only the head-side runtime (inbox,
+    ordered execution, borrow bookkeeping) is rebuilt."""
+    import cloudpickle
+
+    from ray_tpu._private.task_spec import TaskSpec, TaskType
+
+    blob = cloudpickle.loads(recovery)
+    cls, opts, init_args, init_kwargs = (blob if len(blob) == 4
+                                         else (*blob, (), {}))
+    spec = TaskSpec(
+        task_id=TaskID.for_actor_task(actor_id, 0),
+        name=f"{cls.__name__}.__init__",
+        func=None,
+        func_descriptor=f"{cls.__module__}.{cls.__name__}.__init__",
+        args=tuple(init_args),
+        kwargs=dict(init_kwargs),
+        num_returns=1,
+        resources=_build_resources(opts),
+        task_type=TaskType.ACTOR_CREATION_TASK,
+        actor_id=actor_id,
+    )
+    rt = _ProcessActorRuntime(worker, actor_id, cls, tuple(init_args),
+                              dict(init_kwargs), dict(opts),
+                              spec, node_index)
+    rt._pool = pool
+    rt._h = h
+    h.actor_rt = rt
+    with pool._lock:
+        pool._actor_handles.append(h)
+    # lifetime resources re-charge on the rejoined node (best effort:
+    # the fresh scheduler row has full capacity)
+    if rt._explicit_resources:
+        worker.scheduler.try_allocate(node_index, spec.resources)
+    rt._adopted = True
+    rt.state = ActorState.ALIVE
+    rt.init_done.set()
+    worker.memory_store.put(_creation_object_id(actor_id), "ALIVE")
+    with worker._actors_lock:
+        worker.actors[actor_id] = rt
+    _ActorRuntime.start(rt)  # inbox loop only; no worker spawn/re-init
+    worker.gcs.update_actor_state(actor_id, "ALIVE", node_index)
+    return rt
 
 
 def _submit_actor_creation(worker, pending, create):

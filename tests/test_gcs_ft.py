@@ -1,0 +1,164 @@
+"""Control-plane fault tolerance: the head dies and restarts, the
+cluster survives.
+
+Reference semantics (SURVEY.md §5 "GCS FT"): with Redis persistence the
+GCS restarts and replays its tables; raylets reconnect and the cluster
+keeps running through the control-plane outage. Here: the GCS journal
+(`gcs.py GcsJournal`) is the Redis analog, the node daemon's rejoin
+loop is the raylet reconnect, and a detached actor's STATE survives in
+its still-running worker process across the head restart.
+
+The chaos sequence: head #1 (subprocess, journal + fixed endpoint) ->
+remote node joins -> detached counter actor on the node -> increments
+-> SIGKILL the head -> head #2 restarts on the same journal/endpoint ->
+daemon rejoins, actor re-adopts -> a NEW client resolves the actor by
+name and observes the pre-kill count.
+"""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _start_head(journal: str, log_path: str, port: int = 0):
+    """Output goes to a FILE: worker grandchildren inherit the fd, so a
+    pipe would never EOF (and diagnostics would be lost on kill)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "ray_tpu", "start", "--head",
+           "--num-cpus", "2", "--num-workers", "2",
+           "--gcs-journal", journal]
+    if port:
+        cmd += ["--port", str(port)]
+    offset = (os.path.getsize(log_path) if os.path.exists(log_path)
+              else 0)
+    log = open(log_path, "a")
+    proc = subprocess.Popen(cmd, env=env, stdout=log,
+                            stderr=subprocess.STDOUT)
+    address = None
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        with open(log_path) as f:
+            f.seek(offset)
+            tail = f.read()
+        if proc.poll() is not None:
+            raise RuntimeError("head exited during startup:\n"
+                               + tail[-2000:])
+        m = re.search(r"address='(ray://[^']+)'", tail)
+        if m:
+            address = m.group(1)
+            break
+        time.sleep(0.1)
+    assert address, "head did not print a connect string"
+    return proc, address
+
+
+def _start_node(address: str, log_path: str):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["RAY_TPU_DAEMON_REJOIN_TIMEOUT_S"] = "60"
+    log = open(log_path, "a")
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start",
+         "--address", address, "--num-cpus", "2",
+         "--resources", '{"away": 2}'],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+
+
+COUNTER_SRC = """
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def value(self):
+        return self.n
+"""
+
+
+def _load_counter():
+    ns: dict = {}
+    exec(COUNTER_SRC, ns)
+    return ns["Counter"]
+
+
+def test_head_restart_actor_survives(tmp_path):
+    journal = str(tmp_path / "gcs.journal")
+    head_log = str(tmp_path / "head.log")
+    node_log = str(tmp_path / "node.log")
+    head1, address = _start_head(journal, head_log)
+    node = None
+    head2 = None
+    try:
+        node = _start_node(address, node_log)
+        ray_tpu.shutdown()
+        ray_tpu.init(address=address)
+        # wait for the node's resources to register
+        deadline = time.monotonic() + 60
+        Counter = _load_counter()
+        ActorCls = ray_tpu.remote(Counter).options(
+            name="survivor", lifetime="detached",
+            resources={"away": 1.0})
+        handle = None
+        while time.monotonic() < deadline:
+            try:
+                handle = ActorCls.remote()
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert handle is not None
+        for _ in range(3):
+            assert isinstance(ray_tpu.get(handle.incr.remote(),
+                                          timeout=60), int)
+        assert ray_tpu.get(handle.value.remote(), timeout=60) == 3
+        ray_tpu.shutdown()
+
+        # chaos: SIGKILL the head. The daemon (grandchild) survives and
+        # enters its rejoin loop; the actor's worker process keeps its
+        # state.
+        head1.send_signal(signal.SIGKILL)
+        head1.wait(timeout=10)
+
+        # restart the head on the SAME journal -> same port + authkey
+        head2, address2 = _start_head(journal, head_log)
+        assert address2 == address  # endpoint persisted with the journal
+
+        # a NEW client resolves the actor by name (journal replay) and
+        # the rejoined daemon serves calls against the SURVIVING state
+        ray_tpu.init(address=address2)
+        deadline = time.monotonic() + 90
+        val = None
+        while time.monotonic() < deadline:
+            try:
+                h2 = ray_tpu.get_actor("survivor")
+                val = ray_tpu.get(h2.value.remote(), timeout=30)
+                break
+            except Exception:
+                time.sleep(1.0)
+        assert val == 3, (
+            f"actor state lost across head restart: {val}\n"
+            f"--- head log ---\n{open(head_log).read()[-3000:]}\n"
+            f"--- node log ---\n{open(node_log).read()[-2000:]}")
+        # and it still ACCEPTS new work
+        assert ray_tpu.get(h2.incr.remote(10), timeout=30) == 13
+    finally:
+        ray_tpu.shutdown()
+        for p in (node, head1, head2):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
