@@ -103,6 +103,17 @@ def main() -> int:
         traceback.print_exc()
         out["data_pipeline"] = None
 
+    # --- Data library: Arrow columnar MB/s -----------------------------
+    try:
+        r = perf.data_arrow_throughput(total_mb=32 if smoke else 256)
+        out["data_arrow_mb_per_sec"] = r["mb_per_sec"]
+        print(f"  data arrow: {r['mb_per_sec']:.0f} MB/s "
+              f"({r['total_mb']:.0f} MB in {r['seconds']:.1f}s)",
+              file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
+        out["data_arrow_mb_per_sec"] = None
+
     # --- model perf: step time / tokens/s / MFU ------------------------
     try:
         m = perf.model_mfu(smoke=smoke)

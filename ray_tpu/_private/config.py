@@ -173,6 +173,10 @@ _d("data_op_inflight", int, 8,
    "ray_tpu.data: max in-flight tasks per streaming operator")
 _d("data_buffer_blocks", int, 32,
    "ray_tpu.data: max live blocks across the pipeline (backpressure)")
+_d("data_buffer_bytes", int, 256 * 1024 * 1024,
+   "ray_tpu.data: max BYTES of buffered arena-resident blocks across "
+   "the pipeline (bytes-based backpressure; sizes known for shm-stored "
+   "blocks)")
 _d("health_check_period_s", float, 1.0, "control-plane health check period")
 _d("health_check_timeout_s", float, 5.0, "mark node dead after this")
 

@@ -301,9 +301,11 @@ class GcsService:
                 self._actor_names[(namespace, name)] = actor_id
             if recovery is not None:
                 self._actor_recovery[actor_id] = recovery
-        if recovery is not None:
-            self._log(("actor", actor_id.binary(), name, namespace,
-                       class_name, recovery))
+                # journaled under the table lock: replay order must
+                # match applied order (GcsJournal has its own _wlock,
+                # so holding self._lock here cannot deadlock)
+                self._log(("actor", actor_id.binary(), name, namespace,
+                           class_name, recovery))
         self.publish(CH_ACTOR, {"event": "REGISTERED",
                                 "actor_id": actor_id})
         return entry
@@ -322,8 +324,8 @@ class GcsService:
             journaled = actor_id in self._actor_recovery
             if state == "DEAD":
                 self._actor_recovery.pop(actor_id, None)
-        if journaled:
-            self._log(("actor_state", actor_id.binary(), state))
+            if journaled:
+                self._log(("actor_state", actor_id.binary(), state))
         self.publish(CH_ACTOR, {"event": state, "actor_id": actor_id})
 
     def get_actor_by_name(self, name: str,
@@ -368,7 +370,7 @@ class GcsService:
                namespace: str = "") -> None:
         with self._lock:
             self._kv[(namespace, bytes(key))] = bytes(value)
-        self._log(("kv_put", namespace, bytes(key), bytes(value)))
+            self._log(("kv_put", namespace, bytes(key), bytes(value)))
 
     def kv_get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
         with self._lock:
@@ -377,8 +379,8 @@ class GcsService:
     def kv_del(self, key: bytes, namespace: str = "") -> bool:
         with self._lock:
             hit = self._kv.pop((namespace, bytes(key)), None) is not None
-        if hit:
-            self._log(("kv_del", namespace, bytes(key)))
+            if hit:
+                self._log(("kv_del", namespace, bytes(key)))
         return hit
 
     def kv_keys(self, prefix: bytes = b"",

@@ -808,6 +808,15 @@ class Worker:
                 logger.exception("actor %s re-adoption failed",
                                  actor_id.hex()[:16])
                 pool.release_actor_worker(h, kill=True)
+        # the daemon killed plain workers that were mid-task for the
+        # DEAD owner; respawn up to the node's worker count or the row
+        # would advertise CPUs with no one to run on them
+        target = int(info.get("num_workers") or max(int(num_cpus), 1))
+        plain = sum(1 for w in workers.values() if not w.get("actor"))
+        for _ in range(max(0, target - plain)):
+            h = pool._spawn()  # takes the pool lock itself
+            with pool._lock:
+                pool._handles.append(h)
         entry = self.gcs.register_node(
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus, **resources},
             kind="remote", pool=pool)

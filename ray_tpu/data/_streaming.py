@@ -25,7 +25,31 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.data import block as blk
 from ray_tpu.data.dataset import _LogicalOp
+
+
+def _ref_nbytes(ref) -> int:
+    """Known storage size of a block ref: shm arena residency first,
+    then the in-process store's recorded size; 0 when unknown (small
+    inline values — the block-count budget covers those)."""
+    from ray_tpu._private import worker as wm
+
+    w = wm.global_worker
+    if w is None:
+        return 0
+    oid = ref.object_id()
+    shm = getattr(w, "shm_store", None)
+    if shm is not None:
+        loc = shm.locate(oid)
+        if loc is not None:
+            return int(loc[1])
+    store = getattr(w, "memory_store", None)
+    if store is not None:
+        entry = store.get_entry(oid)
+        if entry is not None and getattr(entry, "size", 0):
+            return int(entry.size)
+    return 0
 
 
 def _compose(fns: List[Callable]) -> Callable:
@@ -60,9 +84,12 @@ def _map_task(fn, block):
 def _sample_task(block, k):
     import random as _r
 
-    if not block:
+    from ray_tpu.data import block as _blk
+
+    rows = _blk.block_to_rows(block)
+    if not rows:
         return []
-    return _r.Random(0).sample(block, min(k, len(block)))
+    return _r.Random(0).sample(rows, min(k, len(rows)))
 
 
 def _stable_hash(value) -> int:
@@ -79,6 +106,11 @@ def _stable_hash(value) -> int:
 def _partition_task(kind, arg, num_out, block, block_idx):
     """block -> num_out sub-blocks (returned as num_out VALUES via
     num_returns, so each reducer fetches only its own piece)."""
+    from ray_tpu.data import block as _blk
+
+    # the exchange is ROW-oriented (hash/range partitioning): columnar
+    # blocks convert to rows at this boundary
+    block = _blk.block_to_rows(block)
     parts: List[List[Any]] = [[] for _ in range(num_out)]
     if kind == "repartition":
         for i, row in enumerate(block):
@@ -182,6 +214,7 @@ class _MapActor:
 class _Stage:
     __slots__ = ("kind", "name", "fn", "pool_size", "actors", "actor_load",
                  "inputs", "inflight", "submitted", "completed", "busy_s",
+                 "out_bytes",
                  "limit_remaining", "limit_next_in", "limit_buf",
                  "limit_out_idx")
 
@@ -198,6 +231,7 @@ class _Stage:
         self.submitted = 0
         self.completed = 0
         self.busy_s = 0.0
+        self.out_bytes = 0   # arena-resident output bytes (known sizes)
         # limit-stage state: processed IN ORDER, renumbering outputs
         self.limit_remaining = limit
         self.limit_next_in = 0
@@ -213,6 +247,13 @@ class StreamingExecutor:
         self._max_inflight = max(4, GLOBAL_CONFIG.data_op_inflight)
         self._buffer_blocks = max(self._max_inflight * 2,
                                   GLOBAL_CONFIG.data_buffer_blocks)
+        # bytes-based backpressure (reference: the streaming executor's
+        # resource budgets are BYTES in the object store, not block
+        # counts): sizes are known for arena-resident blocks (shm
+        # locate / store entry); inline blocks fall back to the block-
+        # count budget
+        self._buffer_bytes = GLOBAL_CONFIG.data_buffer_bytes
+        self._ref_sizes: Dict[Any, int] = {}
         self._stopped = False
         self._quenched = False   # a limit stage satisfied: stop sources
         self._t0 = None
@@ -282,10 +323,11 @@ class StreamingExecutor:
         for ref in self.run_refs():
             block = ray_tpu.get(ref)
             if remaining is not None:
-                if len(block) >= remaining:
-                    yield block[:remaining]
+                n = blk.block_rows(block)
+                if n >= remaining:
+                    yield blk.block_slice(block, 0, remaining)
                     return
-                remaining -= len(block)
+                remaining -= n
             yield block
 
     def _make_block_fn(self):
@@ -296,6 +338,16 @@ class StreamingExecutor:
         source = self._source
         if source.make_block is not None:
             return source.make_block
+        if source.blocks is not None:
+            # pre-built driver-resident blocks (e.g. from_arrow Table
+            # slices): one object-store put each, tasks fetch by ref —
+            # a closure would re-ship the data with EVERY task
+            refs = [ray_tpu.put(b) for b in source.blocks]
+
+            def make_block(i: int, _refs=tuple(refs)):
+                return ray_tpu.get(_refs[i])
+
+            return make_block
         items = source.items
         per = -(-len(items) // source.num_blocks) if items else 0
         refs = [ray_tpu.put(items[i * per:(i + 1) * per])
@@ -322,8 +374,24 @@ class StreamingExecutor:
                       + len(st.limit_buf))
             return n
 
+        sizes = self._ref_sizes
+
+        def live_bytes() -> int:
+            total = 0
+            for r in emit_buf.values():
+                total += sizes.get(r, 0)
+            for st in stages:
+                for _i, r in st.inputs:
+                    total += sizes.get(r, 0)
+                for r in st.limit_buf.values():
+                    total += sizes.get(r, 0)
+            return total
+
         def route_output(pos: int, idx: int, ref: Any) -> None:
             """Block leaving stage pos goes to the next stage or emits."""
+            nbytes = _ref_nbytes(ref)
+            sizes[ref] = nbytes
+            stages[pos].out_bytes += nbytes
             if stages[pos] is final:
                 emit_buf[idx] = ref
             else:
@@ -336,16 +404,19 @@ class StreamingExecutor:
             stage = stages[pos]
             while stage.limit_next_in in stage.limit_buf:
                 ref = stage.limit_buf.pop(stage.limit_next_in)
+                sizes.pop(ref, None)
                 stage.limit_next_in += 1
                 if stage.limit_remaining <= 0:
                     continue  # drop: quota already satisfied
                 block = ray_tpu.get(ref)
                 stage.completed += 1
-                if len(block) > stage.limit_remaining:
-                    ref = ray_tpu.put(block[:stage.limit_remaining])
+                n = blk.block_rows(block)
+                if n > stage.limit_remaining:
+                    ref = ray_tpu.put(blk.block_slice(
+                        block, 0, stage.limit_remaining))
                     stage.limit_remaining = 0
                 else:
-                    stage.limit_remaining -= len(block)
+                    stage.limit_remaining -= n
                 out_idx = stage.limit_out_idx
                 stage.limit_out_idx += 1
                 route_output(pos, out_idx, ref)
@@ -359,7 +430,8 @@ class StreamingExecutor:
             while (not self._quenched
                    and next_block < num_blocks
                    and len(src.inflight) < self._max_inflight
-                   and live_blocks() < self._buffer_blocks):
+                   and live_blocks() < self._buffer_blocks
+                   and live_bytes() < self._buffer_bytes):
                 ref = _source_task.remote(make_block, src.fn, next_block)
                 src.inflight[ref] = (next_block, time.perf_counter(), 0)
                 src.submitted += 1
@@ -381,6 +453,7 @@ class StreamingExecutor:
                 while stage.inputs and len(stage.inflight) < \
                         self._max_inflight:
                     idx, in_ref = stage.inputs.popleft()
+                    sizes.pop(in_ref, None)  # consumed: stop pinning
                     if quenched_upstream:
                         continue  # feeding a satisfied limit: drop
                     if stage.kind == "actor":
@@ -396,7 +469,9 @@ class StreamingExecutor:
 
             # emit in order
             while next_emit in emit_buf:
-                yield emit_buf.pop(next_emit)
+                out_ref = emit_buf.pop(next_emit)
+                sizes.pop(out_ref, None)
+                yield out_ref
                 next_emit += 1
 
             all_inflight = [r for st in stages for r in st.inflight]
@@ -408,7 +483,9 @@ class StreamingExecutor:
                                 for st in stages)
                 if drained:
                     while next_emit in emit_buf:
-                        yield emit_buf.pop(next_emit)
+                        out_ref = emit_buf.pop(next_emit)
+                        sizes.pop(out_ref, None)
+                        yield out_ref
                         next_emit += 1
                     return
                 continue
@@ -430,6 +507,7 @@ class StreamingExecutor:
 
     def _shutdown(self) -> None:
         self._stopped = True
+        self._ref_sizes.clear()
         for stage in self._stages:
             for ref in list(stage.inflight):
                 try:
@@ -454,7 +532,8 @@ class StreamingExecutor:
                              if st.kind == "actor" else st.kind),
                  "submitted": st.submitted,
                  "completed": st.completed,
-                 "busy_s": round(st.busy_s, 4)}
+                 "busy_s": round(st.busy_s, 4),
+                 "out_bytes": st.out_bytes}
                 for st in self._stages
             ],
         }

@@ -25,14 +25,15 @@ class _LogicalOp:
 
     def __init__(self, kind: str, *, name: str = "", fn=None,
                  num_blocks: int = 0, make_block=None, items=None,
-                 limit: int = 0, compute=None,
+                 blocks=None, limit: int = 0, compute=None,
                  parent: Optional["_LogicalOp"] = None):
         self.kind = kind
         self.name = name or kind
         self.fn = fn
         self.num_blocks = num_blocks
         self.make_block = make_block
-        self.items = items           # driver-resident source data
+        self.items = items           # driver-resident source ROWS
+        self.blocks = blocks         # driver-resident source BLOCKS
         self.limit = limit
         self.compute = compute       # None = tasks | ActorPoolStrategy
         self.parent = parent
@@ -64,25 +65,41 @@ class Dataset:
         self._last_stats = None
 
     # -- transforms (lazy) ----------------------------------------------
-    def map_batches(self, fn: Callable[[List[Any]], List[Any]],
+    def map_batches(self, fn: Callable[[Any], Any],
                     batch_size: Optional[int] = None,
                     compute: Optional[ActorPoolStrategy] = None,
+                    batch_format: str = "default",
                     name: str = "") -> "Dataset":
         """fn: batch -> batch. compute=None runs tasks (fusible);
         ActorPoolStrategy(n) runs on a pool of n actors. batch_size
         slices each block into fn-sized batches (batches do not cross
-        block boundaries — the reference re-bundles across blocks)."""
-        if batch_size is not None:
-            inner = fn
+        block boundaries — the reference re-bundles across blocks).
 
-            def fn(block, _f=inner, _bs=int(batch_size)):  # noqa: F811
-                out: List[Any] = []
-                for i in builtins.range(0, len(block), _bs):
-                    out.extend(_f(block[i:i + _bs]))
-                return out
+        batch_format (reference: Dataset.map_batches batch_format):
+        "default" passes the block through as-is (list blocks arrive
+        as lists, Arrow blocks as pyarrow.Table); "pyarrow" /
+        "pandas" / "numpy" convert each batch before fn, and fn may
+        return a list, Table, DataFrame, or dict of arrays."""
+        from ray_tpu.data import block as blk
 
-        return Dataset(_LogicalOp("map_block", fn=fn, compute=compute,
-                                  name=name or getattr(fn, "__name__",
+        inner = fn
+        fmt = batch_format
+
+        def wrapped(block, _f=inner, _fmt=fmt,
+                    _bs=(int(batch_size) if batch_size else None)):
+            if _bs is None:
+                return blk.from_batch_output(
+                    _f(blk.to_batch_format(block, _fmt)))
+            outs: List[Any] = []
+            n = blk.block_rows(block)
+            for i in builtins.range(0, n, _bs):
+                piece = blk.block_slice(block, i, min(i + _bs, n))
+                outs.append(blk.from_batch_output(
+                    _f(blk.to_batch_format(piece, _fmt))))
+            return blk.concat_blocks(outs)
+
+        return Dataset(_LogicalOp("map_block", fn=wrapped, compute=compute,
+                                  name=name or getattr(inner, "__name__",
                                                        "map_batches"),
                                   parent=self._op))
 
@@ -133,34 +150,45 @@ class Dataset:
 
     # -- consumption (triggers streaming execution) ---------------------
     def take(self, n: int = 20) -> List[Any]:
+        from ray_tpu.data import block as blk
+
         out: List[Any] = []
         for block in self._execute(limit=n):
-            out.extend(block)
+            out.extend(blk.block_to_rows(block))
             if len(out) >= n:
                 break
         return out[:n]
 
     def take_all(self) -> List[Any]:
+        from ray_tpu.data import block as blk
+
         out: List[Any] = []
         for block in self._execute():
-            out.extend(block)
+            out.extend(blk.block_to_rows(block))
         return out
 
     def count(self) -> int:
-        return sum(len(b) for b in self._execute())
+        from ray_tpu.data import block as blk
+
+        return sum(blk.block_rows(b) for b in self._execute())
 
     def sum(self) -> Any:
+        from ray_tpu.data import block as blk
+
         total = 0
         for b in self._execute():
-            total = total + builtins.sum(b)
+            total = total + builtins.sum(blk.iter_block_rows(b))
         return total
 
-    def iter_batches(self) -> Iterator[List[Any]]:
+    def iter_batches(self) -> Iterator[Any]:
+        """Blocks in their native format (lists or pyarrow Tables)."""
         yield from self._execute()
 
     def iter_rows(self) -> Iterator[Any]:
+        from ray_tpu.data import block as blk
+
         for block in self._execute():
-            yield from block
+            yield from blk.iter_block_rows(block)
 
     # -- datasinks (reference: Dataset.write_* -> Datasink tasks) -------
     def write_csv(self, path: str) -> List[str]:

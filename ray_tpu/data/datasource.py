@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import glob as _glob
 import os
+from builtins import range as builtins_range
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu.data.dataset import Dataset, _LogicalOp
@@ -145,16 +146,22 @@ def read_numpy(paths: Paths) -> Dataset:
 
 
 def read_parquet(paths: Paths,
-                 columns: Optional[List[str]] = None) -> Dataset:
-    """One dict row per record; one block per file. Requires pyarrow."""
-    def parse(path: str) -> List[Dict[str, Any]]:
+                 columns: Optional[List[str]] = None,
+                 block_format: str = "arrow") -> Dataset:
+    """One block per file. block_format="arrow" (default) keeps each
+    file as a COLUMNAR pyarrow.Table block — the column buffers travel
+    zero-copy through the shm object store (pickle-5 out-of-band) and
+    map_batches sees tables; "rows" converts to dict rows per record
+    (the pre-Arrow behavior). Requires pyarrow."""
+    def parse(path: str) -> Any:
         try:
             import pyarrow.parquet as pq
         except ImportError as e:  # pragma: no cover - pyarrow is baked in
             raise ImportError(
                 "read_parquet requires pyarrow") from e
 
-        return pq.read_table(path, columns=columns).to_pylist()
+        table = pq.read_table(path, columns=columns)
+        return table if block_format == "arrow" else table.to_pylist()
 
     return _file_source(paths, "read_parquet", parse)
 
@@ -173,10 +180,23 @@ def from_numpy(arr, *, parallelism: int = 8) -> Dataset:
     return from_items(list(arr), parallelism=parallelism)
 
 
-def from_arrow(table) -> Dataset:
-    from ray_tpu.data.dataset import from_items
+def from_arrow(table, *, parallelism: int = 1) -> Dataset:
+    """COLUMNAR blocks: the table splits into ``parallelism`` Table
+    slices (zero-copy views) that stay Arrow end to end. Slices enter
+    the object store once at execution (refs), not per task."""
+    from ray_tpu.data.dataset import Dataset, _LogicalOp
 
-    return from_items(table.to_pylist(), parallelism=1)
+    from ray_tpu.data.block import compact_table
+
+    n = max(1, min(parallelism, table.num_rows or 1))
+    per = -(-table.num_rows // n) if table.num_rows else 0
+    # compact: a slice VIEW would ship the whole table's buffers with
+    # every block (see block.compact_table)
+    blocks = [compact_table(table.slice(i * per, per))
+              for i in builtins_range(n)] if table.num_rows else [table]
+
+    return Dataset(_LogicalOp("read", name=f"from_arrow({table.num_rows})",
+                              num_blocks=len(blocks), blocks=blocks))
 
 
 # ----------------------------------------------------------------------
@@ -214,6 +234,8 @@ def write_csv(ds: Dataset, path: str) -> List[str]:
     def write_fn(block, out_path):
         import csv
 
+        from ray_tpu.data.block import block_to_rows
+        block = block_to_rows(block)
         keys: List[str] = []
         for row in block:
             for k in row:
@@ -232,20 +254,26 @@ def write_json(ds: Dataset, path: str) -> List[str]:
     def write_fn(block, out_path):
         import json
 
+        from ray_tpu.data.block import block_to_rows
         with open(out_path, "w") as f:
-            for row in block:
+            for row in block_to_rows(block):
                 f.write(json.dumps(row) + "\n")
 
     return _write_blocks(ds, path, "json", write_fn)
 
 
 def write_parquet(ds: Dataset, path: str) -> List[str]:
-    """Dict rows -> one parquet file per block. Requires pyarrow."""
+    """One parquet file per block; Arrow blocks write COLUMNAR without
+    ever materializing Python rows. Requires pyarrow."""
     def write_fn(block, out_path):
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        pq.write_table(pa.Table.from_pylist(block), out_path)
+        from ray_tpu.data.block import block_to_rows
+
+        if not isinstance(block, pa.Table):
+            block = pa.Table.from_pylist(block_to_rows(block))
+        pq.write_table(block, out_path)
 
     return _write_blocks(ds, path, "parquet", write_fn)
 
