@@ -511,6 +511,11 @@ class ProcessWorkerPool:
         env_vars = (spec.runtime_env or {}).get("env_vars") or {}
         if env_vars:
             payload["env_vars"] = dict(env_vars)
+        renv = spec.runtime_env or {}
+        if renv.get("working_dir_pkg"):
+            payload["working_dir_pkg"] = renv["working_dir_pkg"]
+        if renv.get("pip"):
+            payload["pip"] = list(renv["pip"])
         payload["_contained"] = [r.object_id() for r in contained]
         return payload, contained
 
@@ -871,6 +876,13 @@ class ProcessWorkerPool:
 
     def _rpc_create(self, h: _Handle, oid_bin: bytes, nbytes: int) -> int:
         return self._shm.create(ObjectID(oid_bin), nbytes)
+
+    def _rpc_env_pkg(self, h: _Handle, pkg_hash: str) -> Optional[bytes]:
+        """Content-addressed runtime_env package fetch (working_dir
+        zips live in the GCS KV; workers cache extractions per node)."""
+        from ray_tpu._private import runtime_envs as rte
+
+        return self._worker.gcs.kv_get(rte.kv_key(pkg_hash))
 
     def _task_borrows(self, h: _Handle) -> Set[ObjectID]:
         """Borrow set of the task EXECUTING on h right now (= oldest

@@ -324,12 +324,29 @@ class _WorkerRunner:
     def actor_create(self, payload: dict) -> None:
         def run(args, kwargs):
             # per-actor runtime_env: this process is DEDICATED to the
-            # actor, so env_vars apply for its lifetime (no restore)
+            # actor, so env_vars/working_dir/pip apply for its whole
+            # lifetime (no restore — the reference builds the actor's
+            # env around its worker process the same way)
             actor_env = payload.get("actor_env_vars")
             if actor_env:
                 import os as _os
 
                 _os.environ.update(actor_env)
+            if payload.get("actor_working_dir_pkg") or \
+                    payload.get("actor_pip"):
+                from ray_tpu._private import runtime_envs as rte
+
+                mgr = rte.get_manager()
+                wd_path = None
+                pkg = payload.get("actor_working_dir_pkg")
+                if pkg:
+                    wd_path = mgr.ensure_working_dir(
+                        pkg, lambda: self.rpc("env_pkg", (pkg,)))
+                sp = None
+                if payload.get("actor_pip"):
+                    sp = mgr.ensure_pip(list(payload["actor_pip"]))
+                # entered, never exited: lifetime env
+                rte.applied_env(wd_path, sp, use_cwd=True).__enter__()
             cls = cloudpickle.loads(payload["cls_blob"])
             self.actor_instance = cls(*args, **kwargs)
             return "ALIVE"
@@ -371,7 +388,28 @@ class _WorkerRunner:
 
             env_saved = {k: _os.environ.get(k) for k in env_vars}
             _os.environ.update(env_vars)
+        env_ctx = None
         try:
+            if payload.get("working_dir_pkg") or payload.get("pip"):
+                # runtime env agent, worker half: extract/build into
+                # the per-node cache (fetching package bytes over the
+                # owner RPC once per node), then sys.path + cwd for
+                # this task. INSIDE the try: a build failure (e.g. a
+                # non-local pip requirement in this egress-less
+                # environment) must fail the TASK, not the worker.
+                from ray_tpu._private import runtime_envs as rte
+
+                mgr = rte.get_manager()
+                wd_path = None
+                pkg = payload.get("working_dir_pkg")
+                if pkg:
+                    wd_path = mgr.ensure_working_dir(
+                        pkg, lambda: self.rpc("env_pkg", (pkg,)))
+                sp = None
+                if payload.get("pip"):
+                    sp = mgr.ensure_pip(list(payload["pip"]))
+                env_ctx = rte.applied_env(wd_path, sp, use_cwd=True)
+                env_ctx.__enter__()
             args, kwargs = cloudpickle.loads(payload["args_blob"])
             args = tuple(self._resolve(a) for a in args)
             kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
@@ -406,6 +444,8 @@ class _WorkerRunner:
                     RuntimeError(f"[unpicklable {type(e).__name__}] {e}"))
             self._emit(("err", payload["task_id"], blob, tb))
         finally:
+            if env_ctx is not None:
+                env_ctx.__exit__(None, None, None)
             if env_saved is not None:
                 import os as _os
 

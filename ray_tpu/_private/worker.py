@@ -443,7 +443,28 @@ class Worker:
     # ------------------------------------------------------------------
     # Task submission
     # ------------------------------------------------------------------
+    def prepare_runtime_env(self, runtime_env: Optional[dict]
+                            ) -> Optional[dict]:
+        """Driver-side half of the env agent: package working_dir into
+        a content-addressed zip in the GCS KV (once per content), so
+        every node can fetch it on demand. Returns the env with the
+        path replaced by its package hash."""
+        if not runtime_env or "working_dir" not in runtime_env:
+            return runtime_env
+        from ray_tpu._private import runtime_envs as rte
+
+        pkg_hash, data = rte.package_working_dir(runtime_env["working_dir"])
+        key = rte.kv_key(pkg_hash)
+        if self.gcs.kv_get(key) is None:
+            self.gcs.kv_put(key, data)
+        out = dict(runtime_env)
+        out.pop("working_dir")
+        out["working_dir_pkg"] = pkg_hash
+        return out
+
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        if spec.runtime_env and "working_dir" in spec.runtime_env:
+            spec.runtime_env = self.prepare_runtime_env(spec.runtime_env)
         return_ids = spec.return_ids()
         for oid in return_ids:
             self.reference_counter.add_owned_object(oid, lineage_task=spec.task_id)
@@ -902,7 +923,17 @@ class Worker:
         env_vars = (spec.runtime_env or {}).get("env_vars") or {}
         if env_vars:
             env_vars_push(env_vars)
+        env_ctx = None
         try:
+            try:
+                # INSIDE the try: an env build failure (bad pip spec,
+                # missing package) must fail the TASK — store the error
+                # and let the finally release the slot (the process-
+                # worker twin does the same)
+                env_ctx = self._enter_runtime_env(spec.runtime_env)
+            except Exception as e:
+                self._store_error(spec, return_ids, e)
+                return
             args, kwargs, dep_error, requeue_deps = self._resolve_args(spec)
             if requeue_deps:
                 # lost deps are reconstructing: give the slot back and
@@ -928,6 +959,8 @@ class Worker:
                 return
             ready_oids = self._store_returns(spec, return_ids, result)
         finally:
+            if env_ctx is not None:
+                env_ctx.__exit__(None, None, None)
             if env_vars:
                 env_vars_pop(env_vars)
             if pg_token is not None:
@@ -951,6 +984,53 @@ class Worker:
             # releases this execution's slot before seeing the retry
             if retry_task is not None:
                 self.scheduler.submit(retry_task)
+
+    # serializes thread-mode env'd tasks: sys.path / sys.modules are
+    # process-global, and two concurrent tasks with DIFFERENT
+    # working_dirs would resolve each other's imports (env_vars gets a
+    # depth-counted push/pop; import visibility cannot be layered the
+    # same way, so env'd tasks take turns — process workers are the
+    # isolated path, as in the reference)
+    _env_serial_lock = threading.Lock()
+
+    def _enter_runtime_env(self, runtime_env: Optional[dict]):
+        """Thread-mode env application: working_dir extraction +
+        pip-venv site-packages on sys.path for the task's duration
+        (no chdir — one process cwd is shared across thread workers,
+        same documented caveat as thread-mode env_vars)."""
+        if not runtime_env or not (runtime_env.get("working_dir_pkg")
+                                   or runtime_env.get("pip")):
+            return None
+        from ray_tpu._private import runtime_envs as rte
+
+        Worker._env_serial_lock.acquire()
+        try:
+            mgr = rte.get_manager()
+            wd_path = None
+            pkg = runtime_env.get("working_dir_pkg")
+            if pkg:
+                wd_path = mgr.ensure_working_dir(
+                    pkg, lambda: self.gcs.kv_get(rte.kv_key(pkg)))
+            sp = None
+            if runtime_env.get("pip"):
+                sp = mgr.ensure_pip(list(runtime_env["pip"]))
+            ctx = rte.applied_env(wd_path, sp, use_cwd=False)
+            ctx.__enter__()
+        except BaseException:
+            Worker._env_serial_lock.release()
+            raise
+
+        class _LockedEnv:
+            """applied_env + the serialization lock, released together."""
+
+            def __exit__(self, *exc):
+                try:
+                    ctx.__exit__(*exc)
+                finally:
+                    Worker._env_serial_lock.release()
+                return False
+
+        return _LockedEnv()
 
     def _resolve_args(self, spec: TaskSpec):
         """Replace top-level ObjectRefs by values (reference semantics: only

@@ -553,6 +553,13 @@ class _ProcessActorRuntime(_ActorRuntime):
         # dedicated worker hosts (head-restart re-adoption)
         extra = dict(cls_blob=cloudpickle.dumps(self.cls),
                      actor_bin=self.actor_id.binary())
+        renv = self._creation_spec.runtime_env or {}
+        if renv.get("working_dir_pkg"):
+            # the actor OWNS its worker process: the env applies for
+            # its whole lifetime (reference: per-actor runtime_env)
+            extra["actor_working_dir_pkg"] = renv["working_dir_pkg"]
+        if renv.get("pip"):
+            extra["actor_pip"] = list(renv["pip"])
         env_vars = (self._creation_spec.runtime_env or {}).get("env_vars")
         if env_vars:
             # the actor OWNS its worker process: env_vars apply for its
@@ -905,6 +912,18 @@ class ActorClass:
         )
         from ray_tpu.remote_function import _validate_runtime_env
         _validate_runtime_env(spec.runtime_env)
+        renv = spec.runtime_env or {}
+        if renv.get("working_dir") or renv.get("pip"):
+            # working_dir/pip apply for the DEDICATED worker process's
+            # lifetime; thread-mode actors share the driver process and
+            # cannot isolate them — fail eagerly when no process-backed
+            # node could ever host this actor
+            if not worker.needs_serialized_funcs:
+                raise NotImplementedError(
+                    "actor runtime_env working_dir/pip need a process-"
+                    "backed node (worker_mode='process' or a cluster "
+                    "node); this cluster is thread-only")
+            spec.runtime_env = worker.prepare_runtime_env(renv)
         pg = opts.get("placement_group")
         strategy = opts.get("scheduling_strategy")
         if strategy is not None and hasattr(strategy, "placement_group"):

@@ -180,24 +180,34 @@ class RemoteFunction:
 
 
 def _validate_runtime_env(runtime_env) -> None:
-    """Supported: env_vars (applied around task execution in BOTH worker
-    modes). Unsupported keys raise instead of being silently dropped
-    (reference: pip/conda/working_dir need a per-node env agent,
-    ray: python/ray/_private/runtime_env/ — not built here)."""
+    """Supported: env_vars (both worker modes), working_dir (zipped,
+    content-addressed per-node cache), pip (venv per spec; LOCAL
+    wheel/dir requirements only — this environment has no network
+    egress). Reference: the per-node runtime env agent,
+    ray: python/ray/_private/runtime_env/. Unsupported keys raise
+    instead of being silently dropped."""
     if not runtime_env:
         return
-    supported = {"env_vars"}
+    supported = {"env_vars", "working_dir", "pip", "working_dir_pkg"}
     extra = set(runtime_env) - supported
     if extra:
         raise NotImplementedError(
             f"runtime_env keys {sorted(extra)} are not supported "
-            f"(supported: {sorted(supported)})")
+            f"(supported: {sorted(supported - {'working_dir_pkg'})})")
     env_vars = runtime_env.get("env_vars") or {}
     if not isinstance(env_vars, dict) or not all(
             isinstance(k, str) and isinstance(v, str)
             for k, v in env_vars.items()):
         raise TypeError("runtime_env['env_vars'] must be a "
                         "str -> str dict")
+    wd = runtime_env.get("working_dir")
+    if wd is not None and not isinstance(wd, str):
+        raise TypeError("runtime_env['working_dir'] must be a path str")
+    pip = runtime_env.get("pip")
+    if pip is not None and not (isinstance(pip, list) and all(
+            isinstance(p, str) for p in pip)):
+        raise TypeError("runtime_env['pip'] must be a list of "
+                        "requirement strings (local paths here)")
 
 
 def _validate_bundle_fit(worker, pg_id, bundle_index, resources) -> None:

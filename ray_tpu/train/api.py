@@ -94,22 +94,30 @@ class DataIterator:
         self._count: Optional[int] = None
 
     def iter_batches(self):
+        from ray_tpu.data import block as blk
+
         n = 0
         for ref in self._refs:
             block = ray_tpu.get(ref)
-            n += len(block)
+            n += blk.block_rows(block)
             yield block
         self._count = n
 
     def iter_rows(self):
+        from ray_tpu.data import block as blk
+
         for block in self.iter_batches():
-            yield from block
+            # Arrow blocks iterate COLUMNS natively; rows means rows
+            yield from blk.iter_block_rows(block)
 
     def count(self) -> int:
+        from ray_tpu.data import block as blk
+
         # cached after any full pass: counting must not re-fetch and
         # re-deserialize the entire shard on every call
         if self._count is None:
-            self._count = sum(len(b) for b in self.iter_batches())
+            self._count = sum(blk.block_rows(b)
+                              for b in self.iter_batches())
         return self._count
 
 

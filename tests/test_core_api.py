@@ -421,7 +421,7 @@ class TestRuntimeEnv:
                 return 1
 
             with _pytest.raises(NotImplementedError):
-                f.options(runtime_env={"pip": ["torch"]}).remote()
+                f.options(runtime_env={"conda": {"deps": []}}).remote()
         finally:
             ray_tpu.shutdown()
 

@@ -1,0 +1,169 @@
+"""Runtime environments: working_dir + pip (the env agent).
+
+Reference semantics (ray: python/ray/_private/runtime_env/): working_dir
+zips upload once (content-addressed) and extract into a per-node cache;
+pip environments build per spec on first use and are reused. Here the
+pip path is gated to LOCAL wheel/dir requirements (no network egress).
+"""
+
+import os
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+
+def _write_module(dirpath, name, value):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, f"{name}.py"), "w") as f:
+        f.write(f"VALUE = {value!r}\n")
+
+
+class TestWorkingDir:
+    @pytest.mark.parametrize("mode", ["thread", "process"])
+    def test_task_imports_from_working_dir(self, tmp_path, mode):
+        """A module that exists ONLY inside the task's runtime_env."""
+        wd = str(tmp_path / "proj")
+        _write_module(wd, "only_in_env", "hello-env")
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": mode})
+        try:
+            @ray_tpu.remote
+            def load():
+                import only_in_env
+                return only_in_env.VALUE
+
+            ref = load.options(runtime_env={"working_dir": wd}).remote()
+            assert ray_tpu.get(ref, timeout=60) == "hello-env"
+
+            # WITHOUT the env the module must not be importable
+            @ray_tpu.remote
+            def probe():
+                try:
+                    import only_in_env  # noqa: F401
+                    return "leaked"
+                except ImportError:
+                    return "isolated"
+
+            assert ray_tpu.get(probe.remote(), timeout=60) == "isolated"
+        finally:
+            ray_tpu.shutdown()
+
+    def test_content_addressing_reuses_package(self, tmp_path):
+        wd = str(tmp_path / "proj")
+        _write_module(wd, "mod_a", 1)
+        from ray_tpu._private import runtime_envs as rte
+
+        h1, data1 = rte.package_working_dir(wd)
+        h2, data2 = rte.package_working_dir(wd)
+        assert h1 == h2 and data1 is data2  # cached by (path, mtime)
+        _write_module(wd, "mod_b", 2)
+        h3, _ = rte.package_working_dir(wd)
+        assert h3 != h1  # content changed -> new address
+
+    def test_working_dir_cwd_in_process_mode(self, tmp_path):
+        """Process workers chdir into the extracted dir (data files
+        resolve relatively, like the reference)."""
+        wd = str(tmp_path / "proj")
+        os.makedirs(wd)
+        with open(os.path.join(wd, "data.txt"), "w") as f:
+            f.write("payload")
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process"})
+        try:
+            @ray_tpu.remote
+            def read_rel():
+                with open("data.txt") as f:
+                    return f.read()
+
+            ref = read_rel.options(
+                runtime_env={"working_dir": wd}).remote()
+            assert ray_tpu.get(ref, timeout=60) == "payload"
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestPipEnv:
+    def test_pip_local_package(self, tmp_path):
+        """pip installs a LOCAL source package into a per-spec venv;
+        the task imports it, tasks without the env cannot."""
+        pkg = tmp_path / "mylib"
+        (pkg / "mylib").mkdir(parents=True)
+        (pkg / "mylib" / "__init__.py").write_text(
+            "def answer():\n    return 41 + 1\n")
+        (pkg / "pyproject.toml").write_text(textwrap.dedent("""\
+            [build-system]
+            requires = ["setuptools"]
+            build-backend = "setuptools.build_meta"
+            [project]
+            name = "mylib"
+            version = "0.0.1"
+        """))
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process"})
+        try:
+            @ray_tpu.remote
+            def use_lib():
+                import mylib
+                return mylib.answer()
+
+            ref = use_lib.options(
+                runtime_env={"pip": [str(pkg)]}).remote()
+            assert ray_tpu.get(ref, timeout=300) == 42
+
+            @ray_tpu.remote
+            def probe():
+                try:
+                    import mylib  # noqa: F401
+                    return "leaked"
+                except ImportError:
+                    return "isolated"
+
+            assert ray_tpu.get(probe.remote(), timeout=60) == "isolated"
+        finally:
+            ray_tpu.shutdown()
+
+    def test_pip_network_requirement_fails_loud(self, tmp_path):
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=1, scheduler="tensor",
+                     _system_config={"worker_mode": "process"})
+        try:
+            @ray_tpu.remote
+            def f():
+                return 1
+
+            ref = f.options(
+                runtime_env={"pip": ["definitely-not-local-pkg"]}).remote()
+            with pytest.raises(Exception, match="pip install failed"):
+                ray_tpu.get(ref, timeout=120)
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestActorEnv:
+    def test_actor_working_dir_lifetime(self, tmp_path):
+        """A process actor keeps its working_dir for its lifetime."""
+        wd = str(tmp_path / "proj")
+        _write_module(wd, "actor_mod", "actor-env")
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor",
+                     _system_config={"worker_mode": "process"})
+        try:
+            @ray_tpu.remote
+            class Loader:
+                def load(self):
+                    import actor_mod
+                    return actor_mod.VALUE
+
+            a = Loader.options(
+                runtime_env={"working_dir": wd}).remote()
+            assert ray_tpu.get(a.load.remote(), timeout=60) == "actor-env"
+            # a second call still sees it (lifetime, not per-call)
+            assert ray_tpu.get(a.load.remote(), timeout=60) == "actor-env"
+            ray_tpu.kill(a)
+        finally:
+            ray_tpu.shutdown()
