@@ -61,9 +61,13 @@ def package_working_dir(path: str) -> Tuple[str, bytes]:
                              os.path.getmtime(os.path.join(root, name)))
             except OSError:
                 pass
-    key = (path, latest, count)
+    key = (latest, count)
     with _pack_lock:
-        hit = _pack_cache.get(key)
+        cached = _pack_cache.get(path)
+        # one entry PER PATH (validated by fingerprint): per-version
+        # caching would retain every edit's zip for the process lifetime
+        hit = cached[1] if cached is not None and cached[0] == key \
+            else None
     if hit is not None:
         return hit
     buf = io.BytesIO()
@@ -80,12 +84,32 @@ def package_working_dir(path: str) -> Tuple[str, bytes]:
     data = buf.getvalue()
     digest = hashlib.sha1(data).hexdigest()
     with _pack_lock:
-        _pack_cache[key] = (digest, data)
+        _pack_cache[path] = (key, (digest, data))
     return digest, data
 
 
 def pip_spec_hash(pip: List[str]) -> str:
-    return hashlib.sha1(json.dumps(sorted(pip)).encode()).hexdigest()
+    """Spec hash INCLUDING the content fingerprint of local-path
+    requirements: editing a local package must build a fresh venv, not
+    silently reuse the stale install."""
+    parts: List[str] = []
+    for req in sorted(pip):
+        entry = req
+        if os.path.exists(req):
+            latest = os.path.getmtime(req)
+            count = 1
+            if os.path.isdir(req):
+                for root, dirs, files in os.walk(req):
+                    for name in list(dirs) + list(files):
+                        count += 1
+                        try:
+                            latest = max(latest, os.path.getmtime(
+                                os.path.join(root, name)))
+                        except OSError:
+                            pass
+            entry = f"{req}@{latest}:{count}"
+        parts.append(entry)
+    return hashlib.sha1(json.dumps(parts).encode()).hexdigest()
 
 
 class EnvManager:

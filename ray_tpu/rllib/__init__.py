@@ -11,7 +11,8 @@ clipped surrogate + value/entropy terms) on device — no DDP learner
 group; scaling the learner is a sharding annotation, not more actors.
 """
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.env import CartPoleEnv  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 
-__all__ = ["PPOConfig", "PPO", "CartPoleEnv"]
+__all__ = ["PPOConfig", "PPO", "DQNConfig", "DQN", "CartPoleEnv"]

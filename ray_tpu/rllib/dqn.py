@@ -1,0 +1,340 @@
+"""DQN — the second algorithm family on the env-runner/learner split.
+
+Reference: ray: rllib/algorithms/dqn/ (DQN/DQNConfig: replay buffer,
+epsilon-greedy exploration, target network, double-Q update) on the
+same architecture PPO uses here (rllib/ppo.py): rollouts on CPU env-
+runner actors, the update as ONE jitted program. The replay buffer is
+host-side (numpy ring) — sampling minibatches feeds the device update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import exceptions as rex
+
+# ----------------------------------------------------------------------
+# Q network (flax-free MLP, same parameter pytree style as ppo.py)
+# ----------------------------------------------------------------------
+
+
+def _q_apply(params, obs):
+    import jax.numpy as jnp
+
+    x = obs
+    for i, (w, b) in enumerate(params["layers"]):
+        x = x @ w + b
+        if i < len(params["layers"]) - 1:
+            x = jnp.tanh(x)
+    return x  # [batch, num_actions]
+
+
+def _q_init(rng, obs_dim: int, num_actions: int, hidden: int):
+    import jax
+
+    sizes = [obs_dim, hidden, hidden, num_actions]
+    keys = jax.random.split(rng, len(sizes) - 1)
+    layers = []
+    for k, (m, n) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (m, n)) * (1.0 / np.sqrt(m))
+        layers.append((w, np.zeros(n, np.float32)))
+    return {"layers": layers}
+
+
+# ----------------------------------------------------------------------
+# env runner actor (reference: rllib EnvRunner with epsilon-greedy
+# exploration for value-based algorithms)
+# ----------------------------------------------------------------------
+
+@ray_tpu.remote
+class _DQNRunner:
+    def __init__(self, env_maker, num_envs: int, rollout_len: int,
+                 seed: int):
+        import jax
+
+        self.envs = [env_maker(seed * 1000 + i) for i in range(num_envs)]
+        self.obs = np.stack([e.reset() for e in self.envs])
+        self.rollout_len = rollout_len
+        self.running = np.zeros(len(self.envs))
+        self.rng = np.random.default_rng(seed)
+        self._apply = jax.jit(_q_apply)
+
+    def sample(self, params, epsilon: float) -> Dict[str, Any]:
+        """rollout_len epsilon-greedy steps per env; returns flat
+        transition arrays + completed-episode returns."""
+        import jax.numpy as jnp
+
+        T, N = self.rollout_len, len(self.envs)
+        d = self.envs[0].observation_dim
+        na = self.envs[0].num_actions
+        obs_buf = np.zeros((T, N, d), np.float32)
+        next_buf = np.zeros((T, N, d), np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        episode_returns: List[float] = []
+
+        for t in range(T):
+            q = np.asarray(self._apply(params, jnp.asarray(self.obs)))
+            greedy = np.argmax(q, axis=-1)
+            explore = self.rng.random(N) < epsilon
+            actions = np.where(explore,
+                               self.rng.integers(0, na, size=N), greedy)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            for i, env in enumerate(self.envs):
+                nobs, r, done = env.step(int(actions[i]))
+                rew_buf[t, i] = r
+                self.running[i] += r
+                done_buf[t, i] = 1.0 if done else 0.0
+                next_buf[t, i] = nobs
+                if done:
+                    episode_returns.append(self.running[i])
+                    self.running[i] = 0.0
+                    nobs = env.reset()
+                self.obs[i] = nobs
+        return {
+            "obs": obs_buf.reshape(-1, d),
+            "next_obs": next_buf.reshape(-1, d),
+            "actions": act_buf.reshape(-1),
+            "rewards": rew_buf.reshape(-1),
+            "dones": done_buf.reshape(-1),
+            "episode_returns": episode_returns,
+        }
+
+
+# ----------------------------------------------------------------------
+# replay buffer (host-side ring; reference:
+# rllib/utils/replay_buffers/)
+# ----------------------------------------------------------------------
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.size = 0
+        self._pos = 0
+
+    def add_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(batch["actions"])
+        idx = (self._pos + np.arange(n)) % self.capacity
+        self.obs[idx] = batch["obs"]
+        self.next_obs[idx] = batch["next_obs"]
+        self.actions[idx] = batch["actions"]
+        self.rewards[idx] = batch["rewards"]
+        self.dones[idx] = batch["dones"]
+        self._pos = int((self._pos + n) % self.capacity)
+        self.size = min(self.size + n, self.capacity)
+
+    def sample(self, rng, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, size=batch_size)
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx],
+                "rewards": self.rewards[idx], "dones": self.dones[idx]}
+
+
+# ----------------------------------------------------------------------
+# jitted double-DQN update
+# ----------------------------------------------------------------------
+
+def _make_update(lr: float, gamma: float, max_grad_norm: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.chain(optax.clip_by_global_norm(max_grad_norm),
+                            optax.adam(lr))
+
+    def loss_fn(params, target_params, obs, actions, rewards, next_obs,
+                dones):
+        q = _q_apply(params, obs)
+        q_sa = jnp.take_along_axis(q, actions[:, None], axis=-1)[:, 0]
+        # double DQN: online net picks the action, target net scores it
+        next_q_online = _q_apply(params, next_obs)
+        next_a = jnp.argmax(next_q_online, axis=-1)
+        next_q_target = _q_apply(target_params, next_obs)
+        next_v = jnp.take_along_axis(next_q_target, next_a[:, None],
+                                     axis=-1)[:, 0]
+        target = rewards + gamma * next_v * (1.0 - dones)
+        td = q_sa - jax.lax.stop_gradient(target)
+        return jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
+                         jnp.abs(td) - 0.5).mean()  # Huber
+
+    @jax.jit
+    def update(params, target_params, opt_state, obs, actions, rewards,
+               next_obs, dones):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, target_params, obs, actions, rewards, next_obs,
+            dones)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return optimizer, update
+
+
+# ----------------------------------------------------------------------
+# config + algorithm (reference: DQNConfig / Algorithm.train())
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DQNConfig:
+    env_maker: Any = None
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_len: int = 64
+    hidden: int = 64
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_capacity: int = 50_000
+    batch_size: int = 128
+    updates_per_iteration: int = 32
+    learning_starts: int = 500
+    target_update_freq: int = 200     # gradient steps between syncs
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 4_000  # env steps to anneal over
+    max_grad_norm: float = 10.0
+    seed: int = 0
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    def __init__(self, config: DQNConfig):
+        import jax
+
+        self.config = config
+        if config.env_maker is not None:
+            self._env_maker = config.env_maker
+        else:
+            from ray_tpu.rllib.env import CartPoleEnv
+
+            self._env_maker = lambda seed: CartPoleEnv(seed)
+        env = self._env_maker(0)
+        self._obs_dim = env.observation_dim
+        self._num_actions = env.num_actions
+        self.params = _q_init(jax.random.PRNGKey(config.seed),
+                              self._obs_dim, self._num_actions,
+                              config.hidden)
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params)
+        self._optimizer, self._update = _make_update(
+            config.lr, config.gamma, config.max_grad_norm)
+        self.opt_state = self._optimizer.init(self.params)
+        self.buffer = ReplayBuffer(config.buffer_capacity, self._obs_dim)
+        self.iteration = 0
+        self.env_steps = 0
+        self.grad_steps = 0
+        self._rng = np.random.default_rng(config.seed)
+        self._respawns = 0
+        self._runners: List[Any] = []
+        self._spawn_runners()
+
+    def _spawn_runners(self) -> None:
+        cfg = self.config
+        self._runners = [
+            _DQNRunner.remote(self._env_maker, cfg.num_envs_per_runner,
+                              cfg.rollout_len, seed=cfg.seed + 1 + i)
+            for i in range(cfg.num_env_runners)
+        ]
+
+    def _respawn_runner(self, i: int) -> None:
+        cfg = self.config
+        try:
+            ray_tpu.kill(self._runners[i])
+        except Exception:
+            pass
+        self._respawns += 1
+        self._runners[i] = _DQNRunner.remote(
+            self._env_maker, cfg.num_envs_per_runner, cfg.rollout_len,
+            seed=cfg.seed + 101 + i + 1000 * self._respawns)
+
+    @property
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end
+                                           - cfg.epsilon_start)
+
+    def _collect(self) -> List[Dict[str, Any]]:
+        """Same runner fault tolerance as PPO (rllib/ppo.py _collect)."""
+        params_ref = ray_tpu.put(self.params)
+        eps = self.epsilon
+        batches: List[Optional[Dict[str, Any]]] = [None] * len(
+            self._runners)
+        for _attempt in range(3):
+            missing = [i for i, b in enumerate(batches) if b is None]
+            if not missing:
+                break
+            refs = {}
+            for i in missing:
+                try:
+                    refs[i] = self._runners[i].sample.remote(params_ref,
+                                                             eps)
+                except rex.ActorError:
+                    self._respawn_runner(i)
+            for i, ref in refs.items():
+                try:
+                    batches[i] = ray_tpu.get(ref, timeout=120)
+                except rex.ActorError:
+                    self._respawn_runner(i)
+        got = [b for b in batches if b is not None]
+        if not got:
+            raise rex.RayTpuError("all env runners failed")
+        return got
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: collect -> replay -> K double-DQN updates."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        ep_returns: List[float] = []
+        for b in self._collect():
+            self.buffer.add_batch(b)
+            self.env_steps += len(b["actions"])
+            ep_returns.extend(b["episode_returns"])
+
+        losses = []
+        if self.buffer.size >= max(cfg.learning_starts, cfg.batch_size):
+            for _ in range(cfg.updates_per_iteration):
+                mb = self.buffer.sample(self._rng, cfg.batch_size)
+                self.params, self.opt_state, loss = self._update(
+                    self.params, self.target_params, self.opt_state,
+                    jnp.asarray(mb["obs"]), jnp.asarray(mb["actions"]),
+                    jnp.asarray(mb["rewards"]),
+                    jnp.asarray(mb["next_obs"]),
+                    jnp.asarray(mb["dones"]))
+                losses.append(float(loss))
+                self.grad_steps += 1
+                if self.grad_steps % cfg.target_update_freq == 0:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda x: x, self.params)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (float(np.mean(ep_returns))
+                                    if ep_returns else float("nan")),
+            "num_episodes": len(ep_returns),
+            "num_env_steps": int(self.env_steps),
+            "epsilon": float(self.epsilon),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+        }
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._runners = []

@@ -8,11 +8,14 @@ get_best_result). Semantics kept at minimum-viable scale; trials run as
 framework actors, reporting through the same train.report session API.
 """
 
-from ray_tpu.tune.tuner import (ASHAScheduler, ResultGrid,  # noqa: F401
+from ray_tpu.tune.tuner import (ASHAScheduler,  # noqa: F401
+                                PopulationBasedTraining, ResultGrid,
                                 TrialResult, TuneConfig, Tuner, choice,
-                                grid_search, loguniform, report, uniform)
+                                get_checkpoint, grid_search, loguniform,
+                                report, uniform)
 
 __all__ = [
-    "Tuner", "TuneConfig", "ASHAScheduler", "ResultGrid", "TrialResult",
-    "grid_search", "choice", "uniform", "loguniform", "report",
+    "Tuner", "TuneConfig", "ASHAScheduler", "PopulationBasedTraining",
+    "ResultGrid", "TrialResult", "grid_search", "choice", "uniform",
+    "loguniform", "report", "get_checkpoint",
 ]

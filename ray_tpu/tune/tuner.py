@@ -91,13 +91,28 @@ from ray_tpu.train.api import _Session  # noqa: E402
 _sessions: Dict[int, _Session] = {}
 
 
-def report(metrics: Dict[str, Any]) -> None:
-    """Called from inside the trainable."""
+def report(metrics: Dict[str, Any], checkpoint: Any = None) -> None:
+    """Called from inside the trainable. ``checkpoint`` (any picklable
+    state) is retained as the trial's LATEST checkpoint — PBT exploit
+    clones it into a lagging trial (reference: tune.report(...,
+    checkpoint=Checkpoint))."""
     session = _sessions.get(threading.get_ident())
     if session is None:
         raise RuntimeError("tune.report() called outside a trial")
     with session.lock:
         session.reports.append(dict(metrics))
+        if checkpoint is not None:
+            session.checkpoint = checkpoint
+
+
+def get_checkpoint() -> Any:
+    """Inside a trainable: the checkpoint this trial was (re)started
+    from — None for a fresh start, a donor's state after a PBT exploit
+    (reference: tune.get_checkpoint)."""
+    session = _sessions.get(threading.get_ident())
+    if session is None:
+        raise RuntimeError("tune.get_checkpoint() called outside a trial")
+    return getattr(session, "restored", None)
 
 
 @ray_tpu.remote
@@ -107,8 +122,10 @@ class _TrialActor:
         self._session: Optional[_Session] = None
         self._stop = threading.Event()
 
-    def run(self, fn, config):
+    def run(self, fn, config, restored=None):
         session = _Session(0, 1, None)
+        session.checkpoint = None
+        session.restored = restored
         self._session = session
         _sessions[threading.get_ident()] = session
         try:
@@ -126,6 +143,14 @@ class _TrialActor:
             return []
         with s.lock:
             return list(s.reports[since:])
+
+    def get_checkpoint(self):
+        """The trial's latest reported checkpoint (PBT donor read)."""
+        s = self._session
+        if s is None:
+            return None
+        with s.lock:
+            return s.checkpoint
 
 
 # ----------------------------------------------------------------------
@@ -162,6 +187,77 @@ class ASHAScheduler:
                 if sign * value < cutoff:
                     return "stop"
         return "continue"
+
+
+# ----------------------------------------------------------------------
+# PBT (reference: tune/schedulers/pbt.py PopulationBasedTraining)
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PopulationBasedTraining:
+    """Exploit-and-explore over a live population: at every
+    ``perturbation_interval`` reports, a bottom-quantile trial copies a
+    top-quantile trial's CHECKPOINT and hyperparameters, then perturbs
+    the mutable hyperparameters (x1.2 / x0.8 for numeric domains,
+    resample for choices). Trainables must report(...,
+    checkpoint=state) and start from tune.get_checkpoint()."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    perturbation_interval: int = 4
+    hyperparam_mutations: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    quantile_fraction: float = 0.25
+    resample_probability: float = 0.25
+    seed: int = 0
+
+    def __post_init__(self):
+        if not 0.0 < self.quantile_fraction <= 0.5:
+            raise ValueError(
+                "quantile_fraction must be in (0, 0.5]: top and bottom "
+                "quantiles must not overlap")
+        self._scores: Dict[int, float] = {}   # trial -> latest score
+        self._rng = _random.Random(self.seed)
+        self.num_perturbations = 0
+
+    def on_result(self, trial_id: int, iteration: int, value: float):
+        """'continue' or ('exploit', donor_trial_id)."""
+        sign = 1.0 if self.mode == "max" else -1.0
+        self._scores[trial_id] = sign * value
+        if iteration % self.perturbation_interval != 0 \
+                or len(self._scores) < 2:
+            return "continue"
+        ranked = sorted(self._scores, key=self._scores.__getitem__)
+        k = max(1, int(len(ranked) * self.quantile_fraction))
+        bottom, top = ranked[:k], ranked[-k:]
+        if trial_id in bottom:
+            donors = [t for t in top if t != trial_id]
+            if donors:
+                return ("exploit", self._rng.choice(donors))
+        return "continue"
+
+    def perturb(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        for key, domain in self.hyperparam_mutations.items():
+            cur = out.get(key)
+            resample = (self._rng.random() < self.resample_probability
+                        or not isinstance(cur, (int, float)))
+            if resample:
+                if isinstance(domain, _Domain):
+                    out[key] = domain.sample(self._rng)
+                elif isinstance(domain, list):
+                    out[key] = self._rng.choice(domain)
+                elif callable(domain):
+                    out[key] = domain()
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                out[key] = type(cur)(cur * factor) \
+                    if isinstance(cur, float) else max(1, int(cur * factor))
+        self.num_perturbations += 1
+        return out
+
+    def forget(self, trial_id: int) -> None:
+        self._scores.pop(trial_id, None)
 
 
 # ----------------------------------------------------------------------
@@ -218,10 +314,32 @@ class ResultGrid:
 class Tuner:
     def __init__(self, trainable: Callable[[dict], None], *,
                  param_space: Optional[Dict[str, Any]] = None,
-                 tune_config: Optional[TuneConfig] = None):
+                 tune_config: Optional[TuneConfig] = None,
+                 storage_path: Optional[str] = None):
         self._fn = trainable
         self._space = dict(param_space or {})
         self._cfg = tune_config or TuneConfig()
+        self._storage = storage_path
+
+    @classmethod
+    def restore(cls, storage_path: str,
+                trainable: Callable[[dict], None]) -> "Tuner":
+        """Resume an interrupted experiment from its storage directory
+        (reference: Tuner.restore): the search space and tune config
+        reload from the experiment spec; completed trials load from
+        their result files and do NOT re-run; the remainder execute."""
+        import os
+        import pickle
+
+        spec_path = os.path.join(storage_path, "experiment.pkl")
+        if not os.path.exists(spec_path):
+            raise FileNotFoundError(
+                f"no experiment spec at {spec_path}; was this experiment "
+                "run with storage_path?")
+        with open(spec_path, "rb") as f:
+            spec = pickle.load(f)
+        return cls(trainable, param_space=spec["space"],
+                   tune_config=spec["cfg"], storage_path=storage_path)
 
     def _make_configs(self) -> List[Dict[str, Any]]:
         rng = _random.Random(self._cfg.seed)
@@ -232,22 +350,90 @@ class Tuner:
                 configs.append(_sample(g, rng))
         return configs
 
+    def _storage_setup(self, configs) -> Dict[int, TrialResult]:
+        """Create/load the experiment directory; returns completed
+        trials keyed by id (reference: experiment checkpointing)."""
+        import os
+        import pickle
+
+        if self._storage is None:
+            return {}
+        os.makedirs(self._storage, exist_ok=True)
+        spec_path = os.path.join(self._storage, "experiment.pkl")
+        if not os.path.exists(spec_path):
+            with open(spec_path, "wb") as f:
+                pickle.dump({"space": self._space, "cfg": self._cfg}, f)
+        done: Dict[int, TrialResult] = {}
+        for tid in range(len(configs)):
+            p = os.path.join(self._storage, f"trial_{tid}.pkl")
+            if os.path.exists(p):
+                try:
+                    with open(p, "rb") as f:
+                        done[tid] = pickle.load(f)
+                except Exception:
+                    pass  # torn write from the crash: re-run the trial
+        return done
+
+    def _storage_save(self, result: TrialResult) -> None:
+        if self._storage is None:
+            return
+        import os
+        import pickle
+
+        p = os.path.join(self._storage, f"trial_{result.trial_id}.pkl")
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(result, f)
+        os.replace(tmp, p)
+
     def fit(self) -> ResultGrid:
         cfg = self._cfg
         configs = self._make_configs()
         sched = cfg.scheduler
         metric = cfg.metric or (sched.metric if sched else None)
         mode = cfg.mode
+        is_pbt = isinstance(sched, PopulationBasedTraining)
 
-        queue = list(enumerate(configs))
+        completed = self._storage_setup(configs)
+        queue = [(tid, conf) for tid, conf in enumerate(configs)
+                 if tid not in completed]
         running: Dict[int, Dict[str, Any]] = {}  # trial_id -> state
         results: List[Optional[TrialResult]] = [None] * len(configs)
+        for tid, res in completed.items():
+            results[tid] = res
 
-        def launch(tid: int, conf: Dict[str, Any]) -> None:
+        def launch(tid: int, conf: Dict[str, Any],
+                   restored: Any = None) -> None:
             actor = _TrialActor.remote(tid)
-            ref = actor.run.remote(self._fn, conf)
+            ref = actor.run.remote(self._fn, conf, restored)
+            prev = running.get(tid)
+            # a RESTARTED actor's report log begins empty: the poll
+            # cursor must reset with it (carrying the old counter would
+            # skip the fresh run's first reports and starve the
+            # scheduler); accumulated history is kept
             running[tid] = {"actor": actor, "ref": ref, "config": conf,
-                            "seen": 0, "history": [], "stopped": False}
+                            "seen": 0,
+                            "history": prev["history"] if prev else [],
+                            "stopped": False}
+
+        def exploit(tid: int, donor_tid: int) -> None:
+            """PBT: clone the donor's checkpoint + config, perturb the
+            mutations, restart the lagging trial in place."""
+            st = running[tid]
+            donor = running.get(donor_tid)
+            if donor is None:
+                return
+            try:
+                ckpt = ray_tpu.get(
+                    donor["actor"].get_checkpoint.remote(), timeout=30)
+            except Exception:
+                return
+            new_conf = sched.perturb(dict(donor["config"]))
+            try:
+                ray_tpu.kill(st["actor"])
+            except Exception:
+                pass
+            launch(tid, new_conf, restored=ckpt)
 
         while queue or running:
             while queue and len(running) < cfg.max_concurrent_trials:
@@ -266,6 +452,7 @@ class Tuner:
                         st["actor"].poll.remote(st["seen"]), timeout=10)
                 except Exception:
                     new = []
+                restarted = False
                 for rep in new:
                     st["seen"] += 1
                     st["history"].append(rep)
@@ -278,12 +465,21 @@ class Tuner:
                             ray_tpu.kill(st["actor"])
                             final = st["history"][-1] if st["history"] \
                                 else {}
-                            results[tid] = TrialResult(
+                            result = TrialResult(
                                 tid, st["config"], dict(final),
                                 list(st["history"]), True)
+                            results[tid] = result
+                            self._storage_save(result)
+                            if is_pbt:
+                                sched.forget(tid)
                             running.pop(tid)
                             break
-                if tid not in running:
+                        if isinstance(verdict, tuple) \
+                                and verdict[0] == "exploit":
+                            exploit(tid, verdict[1])
+                            restarted = True
+                            break
+                if tid not in running or restarted:
                     continue
                 if st["ref"].object_id() in done_ids:
                     try:
@@ -291,9 +487,13 @@ class Tuner:
                     except Exception:
                         history = st["history"]  # killed or crashed
                     final = history[-1] if history else {}
-                    results[tid] = TrialResult(
+                    result = TrialResult(
                         tid, st["config"], dict(final), list(history),
                         False)
+                    results[tid] = result
+                    self._storage_save(result)
+                    if is_pbt:
+                        sched.forget(tid)
                     try:
                         ray_tpu.kill(st["actor"])
                     except Exception:

@@ -201,3 +201,49 @@ class TestDataIngest:
             loop,
             scaling_config=train.ScalingConfig(num_workers=1)).fit()
         assert r.metrics["ok"] == 1
+
+
+class TestDQN:
+    """Second algorithm family on the env-runner/learner split
+    (reference: rllib/algorithms/dqn/)."""
+
+    def test_dqn_improves_on_cartpole(self, rt):
+        """Learning test: mean episode return must improve
+        substantially (DQN is noisy; compare best-so-far against the
+        starting point, early-exit on clear success)."""
+        from ray_tpu.rllib import DQNConfig
+
+        algo = DQNConfig(num_env_runners=2, num_envs_per_runner=6,
+                         rollout_len=48, updates_per_iteration=64,
+                         learning_starts=400, epsilon_decay_steps=2000,
+                         target_update_freq=150, seed=0).build()
+        try:
+            first = None
+            best = 0.0
+            for _ in range(18):
+                m = algo.train()
+                if m["num_episodes"]:
+                    if first is None:
+                        first = m["episode_return_mean"]
+                    best = max(best, m["episode_return_mean"])
+                if first is not None and best > 2.0 * max(first, 20):
+                    break
+            assert first is not None
+            assert best > max(first, 20) * 1.5, (first, best)
+        finally:
+            algo.stop()
+
+    def test_dqn_survives_runner_death(self, rt):
+        from ray_tpu.rllib import DQNConfig
+
+        algo = DQNConfig(num_env_runners=2, num_envs_per_runner=2,
+                         rollout_len=16, learning_starts=10_000,
+                         seed=1).build()
+        try:
+            algo.train()
+            ray_tpu.kill(algo._runners[0])
+            out = algo.train()
+            assert out["num_env_steps"] > 0
+            assert out["training_iteration"] == 2
+        finally:
+            algo.stop()
