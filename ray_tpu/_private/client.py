@@ -347,6 +347,11 @@ class ClientWorker:
         self.reference_counter = _ClientRC(self)
         self._task_seq_lock = threading.Lock()
         self._task_seq = 0
+        # multiplexed ready-callback waiter (futures / await on refs)
+        self._waiting: Dict[ObjectID, list] = {}
+        self._waiter_lock = threading.Lock()
+        self._waiter_wake = threading.Event()
+        self._waiter_thread: Optional[threading.Thread] = None
         ready = self._conn.recv()
         if ready != ("ready",):
             raise ConnectionError("head did not acknowledge the client "
@@ -436,8 +441,46 @@ class ClientWorker:
         self.reference_counter.remove_local_reference(oid)
 
     def run_callback_when_ready(self, oid, cb) -> None:
-        raise NotImplementedError("futures/await on refs require a "
-                                  "driver-side runtime (not client mode)")
+        """Async/future support in client mode (`await ref`,
+        ref.future()): ONE multiplexed waiter thread cycles a server-
+        side wait over every pending oid and fires callbacks as they
+        land — thread-per-ref would explode under fan-out awaits
+        (reference: the client dataserver's async get)."""
+        with self._waiter_lock:
+            self._waiting.setdefault(oid, []).append(cb)
+            if self._waiter_thread is None \
+                    or not self._waiter_thread.is_alive():
+                self._waiter_thread = threading.Thread(
+                    target=self._waiter_loop, daemon=True,
+                    name="ray_tpu_client_waiter")
+                self._waiter_thread.start()
+        self._waiter_wake.set()
+
+    def _waiter_loop(self) -> None:
+        while self.alive:
+            with self._waiter_lock:
+                oids = list(self._waiting)
+            if not oids:
+                self._waiter_wake.wait(timeout=5.0)
+                self._waiter_wake.clear()
+                continue
+            refs = [ObjectRef(o, None, _register=False) for o in oids]
+            try:
+                ready, _ = self.wait(refs, 1, 2.0)
+            except Exception:
+                if not self.alive:
+                    ready = refs  # fire everything: gets surface errors
+                else:
+                    continue
+            fired = []
+            with self._waiter_lock:
+                for r in ready:
+                    fired.extend(self._waiting.pop(r.object_id(), ()))
+            for cb in fired:
+                try:
+                    cb()
+                except Exception:
+                    logger.exception("ready callback failed")
 
     # -- object plane ---------------------------------------------------
     def put(self, value: Any) -> ObjectRef:

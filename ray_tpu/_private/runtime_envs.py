@@ -38,8 +38,37 @@ import zipfile
 from typing import Dict, List, Optional, Tuple
 
 _PKG_PREFIX = b"env_pkg:"
-_pack_cache: Dict[Tuple[str, float], Tuple[str, bytes]] = {}
+_pack_cache: Dict[str, Tuple[tuple, Tuple[str, bytes]]] = {}
 _pack_lock = threading.Lock()
+
+# build artifacts excluded from fingerprints AND packages: pip install
+# of a source dir writes egg-info/build into it — fingerprinting those
+# would rebuild the venv after every install, forever
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", ".eggs"}
+
+
+def _skip(name: str) -> bool:
+    return name in _SKIP_DIRS or name.endswith(".egg-info")
+
+
+def _fingerprint(path: str) -> tuple:
+    """(latest mtime, entry count) over a tree, excluding build
+    artifacts; tolerant of files vanishing mid-walk."""
+    try:
+        latest = os.path.getmtime(path)
+    except OSError:
+        return (0.0, 0)
+    count = 0
+    for root, dirs, files in os.walk(path):
+        dirs[:] = [d for d in dirs if not _skip(d)]
+        for name in list(dirs) + list(files):
+            count += 1
+            try:
+                latest = max(latest,
+                             os.path.getmtime(os.path.join(root, name)))
+            except OSError:
+                pass
+    return (latest, count)
 
 
 def package_working_dir(path: str) -> Tuple[str, bytes]:
@@ -49,19 +78,9 @@ def package_working_dir(path: str) -> Tuple[str, bytes]:
     if not os.path.isdir(path):
         raise ValueError(f"runtime_env working_dir {path!r} is not a "
                          "directory")
-    latest = os.path.getmtime(path)
-    count = 0
-    for root, dirs, files in os.walk(path):
-        # DIRECTORY mtimes too: deleting sub/old.py bumps only sub's
-        # mtime, which file-only scanning would miss (stale package)
-        for name in list(dirs) + list(files):
-            count += 1
-            try:
-                latest = max(latest,
-                             os.path.getmtime(os.path.join(root, name)))
-            except OSError:
-                pass
-    key = (latest, count)
+    # deleting sub/old.py bumps only sub's mtime, so the fingerprint
+    # counts directory mtimes + entries too
+    key = _fingerprint(path)
     with _pack_lock:
         cached = _pack_cache.get(path)
         # one entry PER PATH (validated by fingerprint): per-version
@@ -73,7 +92,7 @@ def package_working_dir(path: str) -> Tuple[str, bytes]:
     buf = io.BytesIO()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
         for root, dirs, files in os.walk(path):
-            dirs.sort()
+            dirs[:] = sorted(d for d in dirs if not _skip(d))
             for f in sorted(files):
                 full = os.path.join(root, f)
                 rel = os.path.relpath(full, path)
@@ -96,17 +115,13 @@ def pip_spec_hash(pip: List[str]) -> str:
     for req in sorted(pip):
         entry = req
         if os.path.exists(req):
-            latest = os.path.getmtime(req)
-            count = 1
             if os.path.isdir(req):
-                for root, dirs, files in os.walk(req):
-                    for name in list(dirs) + list(files):
-                        count += 1
-                        try:
-                            latest = max(latest, os.path.getmtime(
-                                os.path.join(root, name)))
-                        except OSError:
-                            pass
+                latest, count = _fingerprint(req)
+            else:
+                try:
+                    latest, count = os.path.getmtime(req), 1
+                except OSError:
+                    latest, count = 0.0, 0
             entry = f"{req}@{latest}:{count}"
         parts.append(entry)
     return hashlib.sha1(json.dumps(parts).encode()).hexdigest()

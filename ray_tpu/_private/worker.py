@@ -176,6 +176,10 @@ class Worker:
         self._context = _TaskContext()
         self._driver_task_id = TaskID.of(self.job_id)
         self._task_seq = _Counter()
+        # ONE random 8-byte namespace for this worker's task ids; the
+        # sequence provides uniqueness within it (an os.urandom syscall
+        # per task id was a measurable slice of the submission path)
+        self._task_unique = os.urandom(8)
 
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter(self._on_object_out_of_scope)
@@ -319,7 +323,8 @@ class Worker:
         return self._context.task_id or self._driver_task_id
 
     def next_task_id(self) -> TaskID:
-        return TaskID.of(self.job_id, seq=self._task_seq.next())
+        return TaskID.of(self.job_id, unique=self._task_unique,
+                         seq=self._task_seq.next())
 
     def next_put_id(self) -> ObjectID:
         self._context.put_counter += 1
@@ -957,6 +962,13 @@ class Worker:
             except BaseException as e:  # noqa: BLE001
                 retry_task = self._handle_task_failure(spec, return_ids, e)
                 return
+            finally:
+                # tear the env down BEFORE results publish: a caller
+                # unblocked by _store_returns may submit a follow-up
+                # task that must not see this env's modules/sys.path
+                if env_ctx is not None:
+                    env_ctx.__exit__(None, None, None)
+                    env_ctx = None
             ready_oids = self._store_returns(spec, return_ids, result)
         finally:
             if env_ctx is not None:

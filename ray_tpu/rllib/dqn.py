@@ -236,28 +236,17 @@ class DQN:
         self.env_steps = 0
         self.grad_steps = 0
         self._rng = np.random.default_rng(config.seed)
-        self._respawns = 0
-        self._runners: List[Any] = []
-        self._spawn_runners()
+        from ray_tpu.rllib.runner_group import RunnerGroup
+        cfg2 = self.config
+        self._group = RunnerGroup(
+            _DQNRunner,
+            lambda seed: (self._env_maker, cfg2.num_envs_per_runner,
+                          cfg2.rollout_len, seed),
+            cfg2.num_env_runners, cfg2.seed)
 
-    def _spawn_runners(self) -> None:
-        cfg = self.config
-        self._runners = [
-            _DQNRunner.remote(self._env_maker, cfg.num_envs_per_runner,
-                              cfg.rollout_len, seed=cfg.seed + 1 + i)
-            for i in range(cfg.num_env_runners)
-        ]
-
-    def _respawn_runner(self, i: int) -> None:
-        cfg = self.config
-        try:
-            ray_tpu.kill(self._runners[i])
-        except Exception:
-            pass
-        self._respawns += 1
-        self._runners[i] = _DQNRunner.remote(
-            self._env_maker, cfg.num_envs_per_runner, cfg.rollout_len,
-            seed=cfg.seed + 101 + i + 1000 * self._respawns)
+    @property
+    def _runners(self):
+        return self._group.runners
 
     @property
     def epsilon(self) -> float:
@@ -267,31 +256,11 @@ class DQN:
                                            - cfg.epsilon_start)
 
     def _collect(self) -> List[Dict[str, Any]]:
-        """Same runner fault tolerance as PPO (rllib/ppo.py _collect)."""
+        """Shared fault-tolerant group (rllib/runner_group.py)."""
         params_ref = ray_tpu.put(self.params)
         eps = self.epsilon
-        batches: List[Optional[Dict[str, Any]]] = [None] * len(
-            self._runners)
-        for _attempt in range(3):
-            missing = [i for i, b in enumerate(batches) if b is None]
-            if not missing:
-                break
-            refs = {}
-            for i in missing:
-                try:
-                    refs[i] = self._runners[i].sample.remote(params_ref,
-                                                             eps)
-                except rex.ActorError:
-                    self._respawn_runner(i)
-            for i, ref in refs.items():
-                try:
-                    batches[i] = ray_tpu.get(ref, timeout=120)
-                except rex.ActorError:
-                    self._respawn_runner(i)
-        got = [b for b in batches if b is not None]
-        if not got:
-            raise rex.RayTpuError("all env runners failed")
-        return got
+        return self._group.collect(
+            lambda r: r.sample.remote(params_ref, eps))
 
     def train(self) -> Dict[str, Any]:
         """One iteration: collect -> replay -> K double-DQN updates."""
@@ -332,9 +301,4 @@ class DQN:
         }
 
     def stop(self) -> None:
-        for r in self._runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
-        self._runners = []
+        self._group.stop()

@@ -224,63 +224,24 @@ class PPO:
             config.max_grad_norm)
         self.opt_state = self._optimizer.init(self.params)
         self.iteration = 0
-        self._runners: List[Any] = []
-        self._respawns = 0
-        self._spawn_runners()
+        from ray_tpu.rllib.runner_group import RunnerGroup
+        cfg2 = self.config
+        self._group = RunnerGroup(
+            _EnvRunner,
+            lambda seed: (self._env_maker, cfg2.num_envs_per_runner,
+                          cfg2.rollout_len, seed),
+            cfg2.num_env_runners, cfg2.seed)
 
-    def _spawn_runners(self) -> None:
-        cfg = self.config
-        self._runners = [
-            _EnvRunner.remote(self._env_maker, cfg.num_envs_per_runner,
-                              cfg.rollout_len, seed=cfg.seed + 1 + i)
-            for i in range(cfg.num_env_runners)
-        ]
-
-    def _respawn_runner(self, i: int) -> None:
-        cfg = self.config
-        old = self._runners[i]
-        try:
-            ray_tpu.kill(old)  # a merely-slow runner must not leak
-        except Exception:
-            pass
-        # fresh seed per respawn: a fixed one would replay the same env
-        # stream after every death, biasing the on-policy batch
-        self._respawns += 1
-        self._runners[i] = _EnvRunner.remote(
-            self._env_maker, cfg.num_envs_per_runner, cfg.rollout_len,
-            seed=cfg.seed + 101 + i + 1000 * self._respawns)
+    @property
+    def _runners(self):
+        return self._group.runners
 
     def _collect(self) -> List[Dict[str, Any]]:
-        """Fan the current params out, gather rollouts; a dead runner is
-        respawned and re-sampled (reference: EnvRunnerGroup
-        fault tolerance)."""
+        """Fan the current params out, gather rollouts; dead runners
+        respawn and re-sample (rllib/runner_group.py)."""
         params_ref = ray_tpu.put(self.params)
-        batches: List[Optional[Dict[str, Any]]] = [None] * len(
-            self._runners)
-        for attempt in range(3):
-            missing = [i for i, b in enumerate(batches) if b is None]
-            if not missing:
-                break
-            refs = {}
-            for i in missing:
-                try:
-                    # a dead runner can fail at SUBMIT (handle resolves
-                    # to a dead actor) or at get (death mid-rollout).
-                    # Only ActorError means death — a TaskError (env bug)
-                    # or timeout leaves the actor alive and must not
-                    # silently respawn around it
-                    refs[i] = self._runners[i].sample.remote(params_ref)
-                except rex.ActorError:
-                    self._respawn_runner(i)
-            for i, ref in refs.items():
-                try:
-                    batches[i] = ray_tpu.get(ref, timeout=120)
-                except rex.ActorError:
-                    self._respawn_runner(i)
-        got = [b for b in batches if b is not None]
-        if not got:
-            raise rex.RayTpuError("all env runners failed")
-        return got
+        return self._group.collect(
+            lambda r: r.sample.remote(params_ref))
 
     def train(self) -> Dict[str, Any]:
         """One iteration: sample -> GAE -> minibatched PPO epochs."""
@@ -329,9 +290,4 @@ class PPO:
         }
 
     def stop(self) -> None:
-        for r in self._runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
-        self._runners = []
+        self._group.stop()

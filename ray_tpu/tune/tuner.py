@@ -363,6 +363,17 @@ class Tuner:
         if not os.path.exists(spec_path):
             with open(spec_path, "wb") as f:
                 pickle.dump({"space": self._space, "cfg": self._cfg}, f)
+        else:
+            # trial_<id>.pkl files are keyed by index: silently reusing
+            # another experiment's storage would return ITS results as
+            # this one's
+            with open(spec_path, "rb") as f:
+                stored = pickle.load(f)
+            if repr(stored.get("space")) != repr(self._space):
+                raise ValueError(
+                    f"storage_path {self._storage!r} belongs to a "
+                    "different experiment (param_space mismatch); use "
+                    "Tuner.restore() or a fresh directory")
         done: Dict[int, TrialResult] = {}
         for tid in range(len(configs)):
             p = os.path.join(self._storage, f"trial_{tid}.pkl")
@@ -483,7 +494,11 @@ class Tuner:
                     continue
                 if st["ref"].object_id() in done_ids:
                     try:
-                        history = ray_tpu.get(st["ref"])
+                        fresh = ray_tpu.get(st["ref"])
+                        # st["history"] accumulates ACROSS restarts
+                        # (PBT exploit); the run() return covers only
+                        # the final run — append just its unpolled tail
+                        history = st["history"] + fresh[st["seen"]:]
                     except Exception:
                         history = st["history"]  # killed or crashed
                     final = history[-1] if history else {}

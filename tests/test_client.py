@@ -214,3 +214,25 @@ class TestCliNodeJoin:
                 node.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 node.kill()
+
+
+class TestClientAsync:
+    def test_future_and_await_on_client_refs(self, client):
+        """weak-spot closure: futures/await work in client mode via a
+        waiter thread over the server-side wait."""
+        import asyncio
+
+        @ray_tpu.remote
+        def slowish(x):
+            import time as _t
+            _t.sleep(0.2)
+            return x * 3
+
+        ref = slowish.remote(7)
+        fut = ref.future()
+        assert fut.result(timeout=60) == 21
+
+        async def consume():
+            return await slowish.remote(5)
+
+        assert asyncio.run(consume()) == 15
