@@ -404,7 +404,7 @@ def llm_decode_throughput(smoke: bool = False) -> dict:
         mcfg = TransformerConfig(vocab_size=32000, d_model=1024,
                                  n_layers=8, n_heads=8, n_kv_heads=4,
                                  d_ff=2816, max_seq_len=2048)
-        batch, new_tokens, pages = 16, 64, 512
+        batch, new_tokens, pages = 32, 64, 512
     model = Transformer(mcfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
