@@ -136,6 +136,7 @@ class _DeploymentState:
         self._init_kwargs = init_kwargs
         self._lock = threading.Lock()
         self._replicas: List[_ReplicaState] = []
+        self._sticky: Dict[str, _ReplicaState] = {}  # session -> replica
         self._stop = threading.Event()
         auto = dep.autoscaling_config
         self._scale_to(auto.min_replicas if auto else dep.num_replicas)
@@ -181,7 +182,12 @@ class _DeploymentState:
                 while len(self._replicas) > n:
                     victims.append(self._replicas.pop())
             else:
-                idle = [r for r in self._replicas if r.ongoing == 0]
+                # a replica holding sticky sessions is NOT idle even
+                # with no request in flight: a stream between polls
+                # would lose its replica-local state
+                pinned = set(map(id, self._sticky.values()))
+                idle = [r for r in self._replicas
+                        if r.ongoing == 0 and id(r) not in pinned]
                 while len(self._replicas) > n and idle:
                     victim = idle.pop()
                     self._replicas.remove(victim)
@@ -209,30 +215,80 @@ class _DeploymentState:
             chosen.ongoing += 1
             return chosen
 
+    def _track_until_resolved(self, state: _ReplicaState, ref) -> None:
+        """Queue-length bookkeeping decays when the result resolves
+        (or immediately when tracking cannot be registered)."""
+        def _dec():
+            with self._lock:
+                state.ongoing = max(0, state.ongoing - 1)
+
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            worker_mod.get_worker().run_callback_when_ready(
+                ref.object_id(), _dec)
+        except Exception:
+            _dec()
+
     def submit(self, method: str, args, kwargs, _retry: bool = True):
         state = self._pick()
         try:
             ref = state.actor.handle_request.remote(method, args, kwargs)
         except rex.ActorError:
-            # replica died: replace it and retry once on another
+            # replica died: release the reservation, replace it, retry
+            # once on another
+            with self._lock:
+                state.ongoing = max(0, state.ongoing - 1)
             self._replace(state)
             if _retry:
                 return self.submit(method, args, kwargs, _retry=False)
             raise
-        finally:
-            # queue-length bookkeeping decays when the result resolves
-            def _dec():
-                with self._lock:
-                    state.ongoing = max(0, state.ongoing - 1)
-
-            try:
-                from ray_tpu._private import worker as worker_mod
-
-                worker_mod.get_worker().run_callback_when_ready(
-                    ref.object_id(), _dec)
-            except Exception:
-                _dec()
+        self._track_until_resolved(state, ref)
         return ref
+
+    def submit_sticky(self, method: str, args, kwargs,
+                      session: Optional[str] = None,
+                      _retry: bool = True):
+        """Replica-PINNED call: session=None picks a replica and opens
+        a sticky session (returned token routes later calls to the
+        same replica — replica-local state like token streams must not
+        be load-balanced away). A dead PINNED replica raises (its
+        session state died with it); opening a session retries once on
+        another replica, like submit. Returns (ref, token)."""
+        import uuid as _uuid
+
+        if session is None:
+            state = self._pick()  # reserves (ongoing += 1)
+            token = _uuid.uuid4().hex
+            with self._lock:
+                self._sticky[token] = state
+        else:
+            token = session
+            with self._lock:
+                state = self._sticky.get(token)
+                if state is None or state not in self._replicas:
+                    self._sticky.pop(token, None)
+                    raise rex.RayTpuError(
+                        "sticky session's replica is gone")
+                state.ongoing += 1
+        try:
+            ref = state.actor.handle_request.remote(method, args, kwargs)
+        except rex.ActorError:
+            with self._lock:
+                state.ongoing = max(0, state.ongoing - 1)
+                self._sticky.pop(token, None)
+            self._replace(state)
+            if session is None and _retry:
+                # nothing was pinned yet: retry once on a replacement
+                return self.submit_sticky(method, args, kwargs,
+                                          session=None, _retry=False)
+            raise
+        self._track_until_resolved(state, ref)
+        return ref, token
+
+    def end_sticky(self, token: str) -> None:
+        with self._lock:
+            self._sticky.pop(token, None)
 
     def _replace(self, dead: _ReplicaState) -> None:
         with self._lock:
@@ -376,29 +432,88 @@ def shutdown() -> None:
 
 def start_http(port: int = 0) -> int:
     """POST /{deployment} with a JSON body calls the deployment's
-    __call__ with the decoded payload; responds JSON. Returns the bound
-    port."""
+    __call__ with the decoded payload; responds JSON.
+
+    POST /{deployment}/stream drives the deployment's streaming poll
+    protocol (start_stream/next_tokens — see serve/llm.py) and emits
+    Server-Sent Events: one ``data: {"tokens": [...], "done": ...}``
+    event per burst, connection closed after the done event (the SSE
+    emission shape of the reference's serve.llm streaming ingress).
+    Returns the bound port."""
     import http.server
 
     class Handler(http.server.BaseHTTPRequestHandler):
+        def _json_response(self, code: int, obj) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_POST(self):  # noqa: N802
             name = self.path.strip("/")
+            if name.endswith("/stream"):
+                return self._do_stream(name[:-len("/stream")])
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length) if length else b"null"
             try:
                 payload = json.loads(body)
                 handle = get_app_handle(name)
                 result = ray_tpu.get(handle.remote(payload), timeout=30)
-                data = json.dumps({"result": result}).encode()
-                code = 200
+                self._json_response(200, {"result": result})
             except Exception as e:  # noqa: BLE001
-                data = json.dumps({"error": str(e)}).encode()
-                code = 500
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+                self._json_response(500, {"error": str(e)})
+
+        def _do_stream(self, name: str) -> None:
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b"null"
+            state = token = None
+            try:
+                payload = json.loads(body) or {}
+                state = get_app_handle(name)._state()
+                # sticky: every poll must hit the replica holding the
+                # stream — load-balanced polls would land on replicas
+                # that never heard of it
+                ref, token = state.submit_sticky(
+                    "start_stream",
+                    (payload.get("prompt"),
+                     payload.get("max_new_tokens")), {})
+                sid = ray_tpu.get(ref, timeout=60)
+            except Exception as e:  # noqa: BLE001
+                if state is not None and token is not None:
+                    state.end_sticky(token)
+                self._json_response(500, {"error": str(e)})
+                return
+            try:   # sticky session releases on EVERY exit, including a
+                   # client that hangs up during the header write
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()  # no Content-Length: stream to close
+                while True:
+                    ref, _ = state.submit_sticky(
+                        "next_tokens", (sid,), {}, session=token)
+                    r = ray_tpu.get(ref, timeout=120)
+                    self.wfile.write(
+                        f"data: {json.dumps(r)}\n\n".encode())
+                    self.wfile.flush()
+                    if r.get("done"):
+                        return
+            except Exception as e:  # noqa: BLE001
+                # a final error event: the client must be able to tell
+                # a server-side failure from a complete stream or a
+                # network drop (best effort; the socket may be gone)
+                try:
+                    self.wfile.write(
+                        f"data: {json.dumps({'error': str(e), 'done': True})}"
+                        "\n\n".encode())
+                    self.wfile.flush()
+                except Exception:
+                    pass
+                return
+            finally:
+                state.end_sticky(token)
 
         def log_message(self, *a):
             pass
