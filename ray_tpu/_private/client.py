@@ -53,6 +53,12 @@ class ClientSession:
         self.pinned: set = set()  # ObjectIDs held on the client's behalf
 
 
+_STATE_VERBS = frozenset({
+    "list_tasks", "list_actors", "list_objects", "list_nodes",
+    "list_placement_groups", "summarize_tasks",
+})
+
+
 class ClientServer:
     """Serves client sessions registered through the HeadServer."""
 
@@ -250,13 +256,35 @@ class ClientServer:
 
     def _op_state(self, s, verb: str) -> Any:
         import ray_tpu
+        from ray_tpu.util import state as state_api
         if verb == "cluster_resources":
             return ray_tpu.cluster_resources()
         if verb == "available_resources":
             return ray_tpu.available_resources()
         if verb == "nodes":
             return ray_tpu.nodes()
+        # full state-observability verbs (reference: the GCS client
+        # accessors backing `ray list ...` from any process); allowlist,
+        # not bare getattr — the verb string comes off the wire
+        if verb in _STATE_VERBS:
+            return getattr(state_api, verb)()
         raise ValueError(f"unknown state verb {verb!r}")
+
+    def _op_kv(self, s, op: str, namespace: str, key: bytes,
+               value: Optional[bytes]) -> Any:
+        """Cluster KV through the client (reference: the GCS client's
+        internal_kv accessors)."""
+        gcs = self._worker.gcs
+        if op == "get":
+            return gcs.kv_get(key, namespace)
+        if op == "put":
+            gcs.kv_put(key, value, namespace)
+            return True
+        if op == "del":
+            return gcs.kv_del(key, namespace)
+        if op == "keys":
+            return gcs.kv_keys(key, namespace)
+        raise ValueError(f"unknown kv op {op!r}")
 
     def _op_ping(self, s) -> str:
         return "pong"
@@ -559,6 +587,20 @@ class ClientWorker:
     # -- state ----------------------------------------------------------
     def state(self, verb: str):
         return self._rpc("state", verb)
+
+    # -- cluster KV (GCS client accessor analog) -------------------------
+    def kv_get(self, key: bytes, namespace: str = ""):
+        return self._rpc("kv", "get", namespace, bytes(key), None)
+
+    def kv_put(self, key: bytes, value: bytes,
+               namespace: str = "") -> None:
+        self._rpc("kv", "put", namespace, bytes(key), bytes(value))
+
+    def kv_del(self, key: bytes, namespace: str = "") -> bool:
+        return self._rpc("kv", "del", namespace, bytes(key), None)
+
+    def kv_keys(self, prefix: bytes = b"", namespace: str = ""):
+        return self._rpc("kv", "keys", namespace, bytes(prefix), None)
 
     # -- lifecycle -------------------------------------------------------
     def shutdown(self) -> None:

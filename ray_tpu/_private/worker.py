@@ -326,6 +326,20 @@ class Worker:
         return TaskID.of(self.job_id, unique=self._task_unique,
                          seq=self._task_seq.next())
 
+    # -- cluster KV (same surface as ClientWorker.kv_*, so code using
+    # `w.kv_put(...)` works in both driver and client mode) -----------
+    def kv_get(self, key: bytes, namespace: str = ""):
+        return self.gcs.kv_get(key, namespace=namespace)
+
+    def kv_put(self, key: bytes, value: bytes, namespace: str = "") -> None:
+        self.gcs.kv_put(key, value, namespace=namespace)
+
+    def kv_del(self, key: bytes, namespace: str = "") -> bool:
+        return self.gcs.kv_del(key, namespace=namespace)
+
+    def kv_keys(self, prefix: bytes = b"", namespace: str = ""):
+        return self.gcs.kv_keys(prefix, namespace=namespace)
+
     def next_put_id(self) -> ObjectID:
         self._context.put_counter += 1
         return ObjectID.for_put(self.current_task_id, self._context.put_counter)

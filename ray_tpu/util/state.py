@@ -9,17 +9,34 @@ that reads back the scheduler tensors").
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List
 
 from ray_tpu._private import worker as worker_mod
 
 
+def _client_dispatch(fn):
+    """In client mode, run the verb HEAD-side over the session (the GCS
+    client accessor analog — `ray list ...` from any process). The
+    driver-side body below each decorated function only ever executes
+    in-process, where worker.scheduler/.gcs exist."""
+    @functools.wraps(fn)
+    def wrapper():
+        w = worker_mod.get_worker()
+        if getattr(w, "is_client", False):
+            return w.state(fn.__name__)
+        return fn()
+    return wrapper
+
+
+@_client_dispatch
 def list_tasks() -> List[Dict[str, Any]]:
     """Live (queued/pending/running) tasks from the scheduler arrays."""
     w = worker_mod.get_worker()
     return w.scheduler.task_table()
 
 
+@_client_dispatch
 def list_actors() -> List[Dict[str, Any]]:
     """All actors from the GCS actor table (the registry of record)."""
     w = worker_mod.get_worker()
@@ -31,6 +48,7 @@ def list_actors() -> List[Dict[str, Any]]:
     ]
 
 
+@_client_dispatch
 def list_objects() -> List[Dict[str, Any]]:
     """Objects in the owner's store (+ shm residency and pin counts)."""
     w = worker_mod.get_worker()
@@ -47,6 +65,7 @@ def list_objects() -> List[Dict[str, Any]]:
     return rows
 
 
+@_client_dispatch
 def list_nodes() -> List[Dict[str, Any]]:
     w = worker_mod.get_worker()
     return [
@@ -56,12 +75,14 @@ def list_nodes() -> List[Dict[str, Any]]:
     ]
 
 
+@_client_dispatch
 def list_placement_groups() -> List[Dict[str, Any]]:
     w = worker_mod.get_worker()
     return [dict(info, pg_id=pg_id)
             for pg_id, info in w.placement_groups.table().items()]
 
 
+@_client_dispatch
 def summarize_tasks() -> Dict[str, int]:
     """Counts by state (reference: ray summary tasks)."""
     out: Dict[str, int] = {}

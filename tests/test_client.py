@@ -236,3 +236,44 @@ class TestClientAsync:
             return await slowish.remote(5)
 
         assert asyncio.run(consume()) == 15
+
+
+class TestClientStateAndKV:
+    def test_state_verbs_from_client(self, client):
+        """GCS-client-accessor analog: `ray list ...` works from a thin
+        client — the verbs run head-side over the session."""
+        from ray_tpu.util import state
+
+        @ray_tpu.remote
+        class Marker:
+            def ping(self):
+                return 1
+
+        a = Marker.options(name="state-probe").remote()
+        ray_tpu.get(a.ping.remote(), timeout=60)
+        actors = state.list_actors()
+        assert any(r["name"] == "state-probe" for r in actors)
+        nodes = state.list_nodes()
+        assert nodes and all("resources" in n for n in nodes)
+        assert isinstance(state.summarize_tasks(), dict)
+        ray_tpu.kill(a)
+
+    def test_cluster_kv_from_client(self, client):
+        w = client
+        w.kv_put(b"client-key", b"client-value")
+        assert w.kv_get(b"client-key") == b"client-value"
+        assert b"client-key" in w.kv_keys(b"client-")
+        assert w.kv_del(b"client-key") is True
+        assert w.kv_get(b"client-key") is None
+
+    def test_cluster_kv_driver_mode_symmetry(self):
+        """The same w.kv_* surface works on an in-process driver."""
+        ray_tpu.shutdown()
+        w = ray_tpu.init(num_workers=1)
+        try:
+            w.kv_put(b"drv-key", b"drv-value", namespace="sym")
+            assert w.kv_get(b"drv-key", namespace="sym") == b"drv-value"
+            assert b"drv-key" in w.kv_keys(b"drv", namespace="sym")
+            assert w.kv_del(b"drv-key", namespace="sym") is True
+        finally:
+            ray_tpu.shutdown()

@@ -522,22 +522,26 @@ class TestDispatchWindow:
                      _system_config={"worker_mode": "process",
                                      "worker_pipeline_depth": 8})
         try:
-            import threading
-            live = [0]
-            peak = [0]
-            lock = threading.Lock()
-
             @ray_tpu.remote(resources={"gadget": 1.0})
             def exclusive(i):
+                # CLOCK_MONOTONIC is system-wide on Linux, so the
+                # (start, end) intervals are comparable across the
+                # worker processes
                 import time as _t
+                t0 = _t.monotonic()
                 _t.sleep(0.05)
-                return i
+                return (i, t0, _t.monotonic())
 
             # gadget has capacity 1: windowing it would run 2+ at once
-            # worker-side; correctness here = all complete AND the
-            # scheduler never charged more than capacity
+            # worker-side; correctness here = all complete AND no two
+            # execution intervals overlap
             refs = [exclusive.remote(i) for i in range(6)]
-            assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(6))
+            rows = ray_tpu.get(refs, timeout=120)
+            assert sorted(r[0] for r in rows) == list(range(6))
+            spans = sorted((t0, t1) for _, t0, t1 in rows)
+            for (_, prev_end), (next_start, _) in zip(spans, spans[1:]):
+                assert next_start >= prev_end, \
+                    f"gadget tasks overlapped: {spans}"
             sched = wm.global_worker.scheduler
             # class 0 may be windowable; the gadget class must not be
             gadget_cls = [i for i, ok in
