@@ -63,6 +63,12 @@ class ConfigRegistry:
     def get(self, name: str) -> Any:
         return self._entries[name].value
 
+    def entry(self, name: str) -> "_Entry":
+        """Live entry handle for hot paths: holders read `.value`
+        directly, skipping the per-access __getattr__ dict walk while
+        still observing later set()s."""
+        return self._entries[name]
+
     def set(self, name: str, value: Any) -> None:
         with self._lock:
             if self._frozen:

@@ -28,12 +28,15 @@ class EventBuffer:
     def record(self, task_id, name: str, event: str,
                node: int = -1) -> None:
         # lock-free: deque.append with maxlen is atomic under the GIL,
-        # and record() sits on the per-task hot path (4 calls/task)
-        self._buf.append((time.perf_counter(), task_id.hex(), name,
+        # and record() sits on the per-task hot path (4 calls/task) —
+        # the id is stored raw and hexed lazily at snapshot time
+        self._buf.append((time.perf_counter(), task_id, name,
                           event, node))
 
     def snapshot(self) -> List[tuple]:
-        return list(self._buf)
+        return [(ts, tid if isinstance(tid, str) else tid.hex(),
+                 name, event, node)
+                for ts, tid, name, event, node in list(self._buf)]
 
     def timeline(self) -> List[Dict[str, Any]]:
         """Chrome-trace events: one complete ("X") span per

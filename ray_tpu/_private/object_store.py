@@ -40,7 +40,7 @@ class MemoryStore:
 
     def __init__(self):
         self._objects: Dict[ObjectID, _Entry] = {}
-        self._lock = threading.Condition()
+        self._lock = threading.Lock()
         self._callbacks: Dict[ObjectID, List[Callable[[], None]]] = {}
 
     # -- write -------------------------------------------------------------
@@ -48,10 +48,12 @@ class MemoryStore:
             size: int = 0) -> None:
         with self._lock:
             self._objects[object_id] = _Entry(value, is_exception, size)
-            callbacks = self._callbacks.pop(object_id, [])
-            self._lock.notify_all()
-        for cb in callbacks:
-            cb()
+            # waiters are callback-based (_await_count), nobody blocks
+            # on this lock itself — no notify needed
+            callbacks = self._callbacks.pop(object_id, None)
+        if callbacks:
+            for cb in callbacks:
+                cb()
 
     # -- read --------------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:

@@ -59,6 +59,10 @@ class TensorScheduler(SchedulerBase):
         self._store_contains = store_contains or (lambda oid: False)
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
+        # True only while the tick thread is parked in wait(): producers
+        # skip the notify syscall when the loop is already awake (under
+        # load it almost always is, and notify-per-event was measurable)
+        self._sleeping = False
 
         n_res = GLOBAL_CONFIG.sched_num_resources
         self._cap = np.zeros((0, n_res), dtype=np.float32)
@@ -135,26 +139,30 @@ class TensorScheduler(SchedulerBase):
         with self._wake:
             self._submit_q.append(task)
             self._num_submitted += 1
-            self._wake.notify()
+            if self._sleeping:
+                self._wake.notify()
 
     def notify_object_ready(self, object_id: ObjectID) -> None:
         with self._wake:
             self._ready_obj_q.append(object_id)
-            self._wake.notify()
+            if self._sleeping:
+                self._wake.notify()
 
     def notify_task_finished(self, task_id: TaskID, node_index: int,
                              resources: Dict[str, float]) -> None:
         with self._wake:
             self._finish_q.append((task_id, node_index, resources))
             self._num_finished += 1
-            self._wake.notify()
+            if self._sleeping:
+                self._wake.notify()
 
     def notify_batch(self, ready_objects, finished) -> None:
         with self._wake:
             self._ready_obj_q.extend(ready_objects)
             self._finish_q.extend(finished)
             self._num_finished += len(finished)
-            self._wake.notify()
+            if self._sleeping:
+                self._wake.notify()
 
     def cancel(self, task_id: TaskID) -> bool:
         with self._wake:
@@ -214,7 +222,8 @@ class TensorScheduler(SchedulerBase):
     def shutdown(self) -> None:
         with self._wake:
             self._shutdown = True
-            self._wake.notify()
+            if self._sleeping:
+                self._wake.notify()
         self._tick_thread.join(timeout=2.0)
 
     def pending_entries(self, started=None) -> List[Tuple[Any, List[ObjectID]]]:
@@ -329,14 +338,16 @@ class TensorScheduler(SchedulerBase):
             idx = self._append_node(node)
             if wake:
                 self._dirty = True
-                self._wake.notify()
+                if self._sleeping:
+                    self._wake.notify()
             return idx
 
     def poke(self) -> None:
         """Wake the tick thread (schedulability may have changed)."""
         with self._wake:
             self._dirty = True
-            self._wake.notify()
+            if self._sleeping:
+                self._wake.notify()
 
     def remove_node(self, node_index: int) -> None:
         with self._wake:
@@ -351,7 +362,8 @@ class TensorScheduler(SchedulerBase):
             # (dead target -> fall back to the default node set)
             self._mask_dirty = True
             self._dirty = True
-            self._wake.notify()
+            if self._sleeping:
+                self._wake.notify()
 
     def _append_node(self, node: NodeState) -> int:
         vec = np.zeros((1, self._cap.shape[1] if self._cap.size else
@@ -413,7 +425,8 @@ class TensorScheduler(SchedulerBase):
                     custom_resources=custom))
                 rows.append(row)
             self._dirty = True
-            self._wake.notify()
+            if self._sleeping:
+                self._wake.notify()
             return rows
 
     def drain_pg_tasks(self, pg_id) -> List[PendingTask]:
@@ -468,7 +481,8 @@ class TensorScheduler(SchedulerBase):
                     ns.defunct = True
             self._mask_dirty = True
             self._dirty = True
-            self._wake.notify()
+            if self._sleeping:
+                self._wake.notify()
 
     # -- tick loop ---------------------------------------------------------
     def _tick_loop(self) -> None:
@@ -480,7 +494,9 @@ class TensorScheduler(SchedulerBase):
                 while (not self._shutdown and not self._submit_q
                        and not self._ready_obj_q and not self._finish_q
                        and not self._dirty):
+                    self._sleeping = True
                     self._wake.wait(timeout=0.5)
+                    self._sleeping = False
                 if self._shutdown:
                     return
                 self._dirty = False
@@ -563,9 +579,13 @@ class TensorScheduler(SchedulerBase):
 
         # 2) object-ready wave (batched indegree scatter)
         dec_slots: List[int] = []
+        waiters = self._waiters
         while self._ready_obj_q:
             oid = self._ready_obj_q.popleft()
-            dec_slots.extend(self._waiters.pop(oid, ()))
+            if waiters:
+                w = waiters.pop(oid, None)
+                if w:
+                    dec_slots.extend(w)
         if dec_slots:
             np.subtract.at(self._indeg, np.asarray(dec_slots, dtype=np.int64), 1)
 
@@ -574,8 +594,10 @@ class TensorScheduler(SchedulerBase):
             task_id, node_index, resources = self._finish_q.popleft()
             slot = self._slot_of.get(task_id)
             was_windowed = False
+            cidx = -1
             if slot is not None and self._state[slot] == RUNNING:
                 was_windowed = bool(self._windowed[slot])
+                cidx = int(self._cls[slot])
                 if 0 <= node_index < len(self._node_states):
                     self._outstanding[node_index] = max(
                         self._outstanding[node_index] - 1, 0)
@@ -583,9 +605,15 @@ class TensorScheduler(SchedulerBase):
             if was_windowed:
                 continue  # a window lease held no node resources
             if 0 <= node_index < len(self._node_states):
-                vec = np.asarray(resources_to_vector(resources),
-                                 dtype=np.float32)[:self._cap.shape[1]]
-                custom = custom_resources(resources)
+                if 0 <= cidx < len(self._class_custom):
+                    # the class row IS the demand vector — skip the
+                    # per-completion dict -> vector conversion
+                    vec = self._demands[cidx]
+                    custom = self._class_custom[cidx]
+                else:
+                    vec = np.asarray(resources_to_vector(resources),
+                                     dtype=np.float32)[:self._cap.shape[1]]
+                    custom = custom_resources(resources)
                 ns = self._node_states[node_index]
                 if ns.defunct:
                     # removed bundle: this task's share of the carved-out
@@ -700,7 +728,12 @@ class TensorScheduler(SchedulerBase):
         # class count no longer gates the device path: the kernel scans the
         # class axis (class as data), so many classes don't grow the program
         big = len(ready_idx) >= GLOBAL_CONFIG.sched_jax_min_batch
-        if backend == "auto" and big and self._calib_state == "cold":
+        # calibrate only once numpy ticks are slow enough that a device
+        # dispatch (~1-2 ms minimum) could plausibly win — otherwise the
+        # background jit compile steals CPU from the very workload the
+        # ticks are serving (measurable on small hosts)
+        if (backend == "auto" and big and self._calib_state == "cold"
+                and self._np_cost > 2e-3):
             self._start_calibration(snapshot)
         use_jax = (backend == "jax"
                    or (backend == "auto" and big

@@ -83,10 +83,18 @@ class TaskSpec:
     attempt_number: int = 0
     generator: bool = False  # streaming generator task
     class_key: Optional[Tuple] = None  # precomputed scheduling_class()
+    # (task_id, ids) memo: return_ids() runs on both the submit and the
+    # completion hot paths; keyed by the id because retries mutate task_id
+    _rid_memo: Any = None
 
     def return_ids(self) -> List[ObjectID]:
-        return [ObjectID.for_task_return(self.task_id, i)
-                for i in range(self.num_returns)]
+        memo = self._rid_memo
+        if memo is not None and memo[0] is self.task_id:
+            return memo[1]
+        ids = [ObjectID.for_task_return(self.task_id, i)
+               for i in range(self.num_returns)]
+        self._rid_memo = (self.task_id, ids)
+        return ids
 
     def placement(self) -> Tuple:
         """Hashable placement descriptor consumed by the schedulers'

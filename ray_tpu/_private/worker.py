@@ -14,7 +14,7 @@ processes + the shm object store, keeping this module's semantics.
 
 from __future__ import annotations
 
-import concurrent.futures
+import collections
 import logging
 import os
 import threading
@@ -36,6 +36,11 @@ logger = logging.getLogger(__name__)
 
 global_worker: Optional["Worker"] = None
 _init_lock = threading.Lock()
+
+
+def _noop_exec(task, node_index) -> None:
+    """Placeholder PendingTask.execute (dispatch goes through the
+    worker's dispatcher, not the task) — shared, not a per-task lambda."""
 
 
 class _TaskContext(threading.local):
@@ -64,6 +69,7 @@ class TaskManager:
         self._pending_origin: Dict[TaskID, TaskID] = {}
         self._lineage: Dict[TaskID, TaskSpec] = {}
         self._lineage_bytes = 0
+        self._lineage_cap = GLOBAL_CONFIG.entry("max_lineage_bytes")
         self.num_retries = 0
 
     def add_pending(self, spec: TaskSpec, deps: List[ObjectID]) -> None:
@@ -98,7 +104,7 @@ class TaskManager:
                 if key not in self._lineage:  # overwrites don't grow
                     self._lineage_bytes += 256  # coarse estimate per spec
                 self._lineage[key] = spec
-                if self._lineage_bytes > GLOBAL_CONFIG.max_lineage_bytes:
+                if self._lineage_bytes > self._lineage_cap.value:
                     self._evict_lineage_locked()
 
     def should_retry(self, spec: TaskSpec, exc: BaseException) -> bool:
@@ -134,7 +140,7 @@ class TaskManager:
                 self._lineage_bytes -= 256
 
     def _evict_lineage_locked(self):
-        while self._lineage_bytes > GLOBAL_CONFIG.max_lineage_bytes // 2 \
+        while self._lineage_bytes > self._lineage_cap.value // 2 \
                 and self._lineage:
             self._lineage.pop(next(iter(self._lineage)))
             self._lineage_bytes -= 256
@@ -160,6 +166,83 @@ class _Dispatcher:
 
     def dispatch_many(self, pendings) -> None:
         self._worker._dispatch_many(pendings)
+
+
+class _WorkQueue:
+    """Purpose-built thread-pool for the execution hot path.
+
+    ThreadPoolExecutor pays, per submission, a Future (one Condition
+    allocation), a set_result notify, and an unconditional queue notify
+    — all discarded by the dispatcher, which never reads the Future.
+    This pool is fire-and-forget: no Future, and the wake notify is
+    skipped whenever no thread is parked (under load none are)."""
+
+    def __init__(self, nworkers: int, name: str = "ray_tpu_worker"):
+        self._cv = threading.Condition()
+        self._q: collections.deque = collections.deque()
+        self._idle = 0
+        self._stop = False
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"{name}_{i}") for i in range(nworkers)]
+        for t in self._threads:
+            t.start()
+        # ThreadPoolExecutor's non-daemon threads drained the queue at
+        # interpreter exit; daemon threads need an explicit atexit drain
+        # to keep that guarantee (unregistered by shutdown())
+        import atexit
+        atexit.register(self._drain_at_exit)
+
+    def _drain_at_exit(self) -> None:
+        if not self._stop:
+            self.shutdown(wait=True)
+
+    def submit(self, fn, *args) -> None:
+        with self._cv:
+            self._q.append((fn, args))
+            if self._idle:
+                self._cv.notify()
+
+    def submit_many(self, items) -> None:
+        """Enqueue [(fn, args), ...] under ONE lock acquisition."""
+        with self._cv:
+            self._q.extend(items)
+            if self._idle:
+                self._cv.notify(min(len(items), self._idle))
+
+    def _run(self) -> None:
+        cv, q = self._cv, self._q
+        while True:
+            with cv:
+                while not q and not self._stop:
+                    self._idle += 1
+                    cv.wait()
+                    self._idle -= 1
+                if not q:
+                    return  # stopping and drained
+                fn, args = q.popleft()
+            try:
+                fn(*args)
+            except BaseException:  # noqa: BLE001
+                logger.exception("executor task failed")
+
+    def shutdown(self, wait: bool = False,
+                 cancel_futures: bool = False) -> None:
+        with self._cv:
+            self._stop = True
+            if cancel_futures:
+                self._q.clear()
+            self._cv.notify_all()
+        import atexit
+        atexit.unregister(self._drain_at_exit)
+        if wait:
+            # workers drain the remaining queue before exiting (the run
+            # loop only returns once stopped AND empty), so joining them
+            # gives ThreadPoolExecutor's shutdown(wait=True) semantics
+            me = threading.current_thread()
+            for t in self._threads:
+                if t is not me:
+                    t.join()
 
 
 class Worker:
@@ -188,8 +271,7 @@ class Worker:
         nworkers = num_workers or GLOBAL_CONFIG.num_workers or os.cpu_count() or 4
         self.num_workers = nworkers
         capacity_cpu = num_cpus if num_cpus is not None else float(nworkers)
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=nworkers, thread_name_prefix="ray_tpu_worker")
+        self._pool = _WorkQueue(nworkers)
 
         # P3 multi-process node runtime: process workers + shm object store
         # (reference: raylet WorkerPool + plasma). Thread mode keeps the
@@ -287,7 +369,7 @@ class Worker:
         self.dead_actors: set = set()
         self._actors_lock = threading.Lock()
 
-        self._running_tasks: Dict[TaskID, threading.Event] = {}
+        self._running_tasks: Dict[TaskID, bool] = {}  # id -> cancelled?
         # cancelled while window-leased but not yet executing (queued in
         # the executor): flagged here, honored at execution start
         self._precancelled: set = set()
@@ -295,7 +377,6 @@ class Worker:
 
         # deferred unref queue: ObjectRef.__del__ may fire during GC while
         # runtime locks are held, so deletions drain on a dedicated thread
-        import collections
         self._unref_queue: collections.deque = collections.deque()
         self._unref_event = threading.Event()
         self._unref_thread = threading.Thread(
@@ -489,7 +570,9 @@ class Worker:
             self.reference_counter.add_owned_object(oid, lineage_task=spec.task_id)
 
         deps = _top_level_deps(spec.args, spec.kwargs)
-        self.reference_counter.add_submitted_task_references(deps)
+        spec._deps_memo = deps  # args never change; reused at completion
+        if deps:
+            self.reference_counter.add_submitted_task_references(deps)
         self.task_manager.add_pending(spec, deps)
         self.events.record(spec.task_id, spec.name, "submitted")
 
@@ -502,8 +585,7 @@ class Worker:
                 continue
             unresolved.append(d)
             self.object_recovery.maybe_recover(d)
-        pending = PendingTask(spec=spec, deps=unresolved,
-                              execute=lambda t, n: None)
+        pending = PendingTask(spec=spec, deps=unresolved, execute=_noop_exec)
         self.scheduler.submit(pending)
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
 
@@ -525,24 +607,23 @@ class Worker:
                 and self.process_pool.cancel(task_id, force):
             return  # running in a worker process: flagged or killed there
         with self._running_lock:
-            ev = self._running_tasks.get(task_id)
-            if ev is None and \
-                    self.task_manager.get_pending_spec(task_id) is not None:
+            running = task_id in self._running_tasks
+            if running:
+                # cooperative flag read via was_current_task_cancelled
+                self._running_tasks[task_id] = True
+            elif self.task_manager.get_pending_spec(task_id) is not None:
                 # leased through the dispatch window but still queued in
                 # the executor: mark for cancellation at execution start
                 self._precancelled.add(task_id)
-        if ev is not None:
-            ev.set()  # cooperative flag checked via was_current_task_cancelled
-            if force:
-                _async_raise_in_task(task_id)
+        if running and force:
+            _async_raise_in_task(task_id)
 
     def was_current_task_cancelled(self) -> bool:
         task_id = self._context.task_id
         if task_id is None:
             return False
-        with self._running_lock:
-            ev = self._running_tasks.get(task_id)
-        return ev.is_set() if ev else False
+        # dict.get is GIL-atomic; the value is a plain bool flag
+        return bool(self._running_tasks.get(task_id, False))
 
     # ------------------------------------------------------------------
     # Execution (dispatcher target)
@@ -582,17 +663,28 @@ class Worker:
         one pipe message per worker per tick, instead of per task);
         everything else takes the per-task path."""
         groups: Dict[Any, List[PendingTask]] = {}
+        local: List[tuple] = []
+        record = self.events.record
         for pending in pendings:
             spec = pending.spec
             pool = self.pool_for_node(pending.node_index)
-            if (pool is not None and not pool.is_remote
-                    and getattr(spec, "_actor_boot", None) is None
-                    and spec.task_type == TaskType.NORMAL_TASK):
-                self.events.record(spec.task_id, spec.name, "dispatched",
-                                   pending.node_index)
+            if (getattr(spec, "_actor_boot", None) is not None
+                    or spec.task_type != TaskType.NORMAL_TASK):
+                self._dispatch(pending)
+            elif pool is not None and not pool.is_remote:
+                record(spec.task_id, spec.name, "dispatched",
+                       pending.node_index)
                 groups.setdefault(pool, []).append(pending)
+            elif pool is None:
+                # host-thread execution: queue the whole tick's grants
+                # in one executor lock acquisition
+                record(spec.task_id, spec.name, "dispatched",
+                       pending.node_index)
+                local.append((self._execute_task, (pending,)))
             else:
                 self._dispatch(pending)
+        if local:
+            self._pool.submit_many(local)
         for pool, batch in groups.items():
             self._pool.submit(self._run_pool_batch, pool, batch)
 
@@ -913,13 +1005,13 @@ class Worker:
         # spec.task_id, and the scheduler must be notified for THIS id
         # (and only after the retry has a fresh id) or its slot leaks
         exec_task_id = spec.task_id
-        cancel_ev = threading.Event()
         with self._running_lock:
-            self._running_tasks[exec_task_id] = cancel_ev
-            if self._precancelled:
-                if exec_task_id in self._precancelled:
-                    self._precancelled.discard(exec_task_id)
-                    cancel_ev.set()
+            # value is the cancellation flag: False = running, flipped
+            # to True by cancel_task (an Event per task cost ~2us each)
+            self._running_tasks[exec_task_id] = False
+            if self._precancelled and exec_task_id in self._precancelled:
+                self._precancelled.discard(exec_task_id)
+                self._running_tasks[exec_task_id] = True
 
         prev_task = self._context.task_id
         prev_put = self._context.put_counter
@@ -939,7 +1031,8 @@ class Worker:
         # conflicting env_vars can observe each other mid-flight
         # (process workers are the isolated path, as in the reference);
         # depth-counted push/pop guarantees the final restore is correct
-        env_vars = (spec.runtime_env or {}).get("env_vars") or {}
+        env_vars = (spec.runtime_env.get("env_vars")
+                    if spec.runtime_env else None)
         if env_vars:
             env_vars_push(env_vars)
         env_ctx = None
@@ -961,12 +1054,12 @@ class Worker:
                 self.reference_counter.add_submitted_task_references(
                     _top_level_deps(spec.args, spec.kwargs))
                 retry_task = PendingTask(spec=spec, deps=requeue_deps,
-                                         execute=lambda t, n: None)
+                                         execute=_noop_exec)
                 return
             if dep_error is not None:
                 self._store_error(spec, return_ids, dep_error)
                 return
-            if cancel_ev.is_set():
+            if self._running_tasks.get(exec_task_id):
                 self._store_error(spec, return_ids,
                                   rex.TaskCancelledError(exec_task_id))
                 return
@@ -998,7 +1091,9 @@ class Worker:
                 self._running_tasks.pop(exec_task_id, None)
             self.events.record(exec_task_id, spec.name, "finished",
                                pending.node_index)
-            deps = _top_level_deps(spec.args, spec.kwargs)
+            deps = getattr(spec, "_deps_memo", None)
+            if deps is None:
+                deps = _top_level_deps(spec.args, spec.kwargs)
             if deps:
                 self.reference_counter.remove_submitted_task_references(deps)
             # object-ready + task-finished in ONE scheduler wakeup
@@ -1131,7 +1226,7 @@ class Worker:
             self.task_manager.rekey_pending(old_id, spec, deps)
             unresolved = [d for d in deps if not self.memory_store.contains(d)]
             return PendingTask(spec=spec, deps=unresolved,
-                               execute=lambda t, n: None)
+                               execute=_noop_exec)
         if isinstance(exc, rex.TaskCancelledError):
             self._store_error(spec, return_ids, exc)
         else:
@@ -1147,11 +1242,16 @@ class Worker:
             self.scheduler.notify_object_ready(oid)
         self.task_manager.complete(spec.task_id)
 
+    _inject_entry = None
+
     def _maybe_inject_failure(self):
-        prob = GLOBAL_CONFIG.testing_inject_task_failure_prob
-        if prob > 0.0:
+        ent = Worker._inject_entry
+        if ent is None:
+            ent = Worker._inject_entry = GLOBAL_CONFIG.entry(
+                "testing_inject_task_failure_prob")
+        if ent.value > 0.0:
             import random
-            if random.random() < prob:
+            if random.random() < ent.value:
                 raise rex.WorkerCrashedError("injected failure (chaos test)")
 
     # ------------------------------------------------------------------
@@ -1234,8 +1334,9 @@ class Worker:
 
 def _top_level_deps(args, kwargs) -> List[ObjectID]:
     deps = [a.object_id() for a in args if isinstance(a, ObjectRef)]
-    deps.extend(v.object_id() for v in kwargs.values()
-                if isinstance(v, ObjectRef))
+    if kwargs:
+        deps.extend(v.object_id() for v in kwargs.values()
+                    if isinstance(v, ObjectRef))
     return deps
 
 

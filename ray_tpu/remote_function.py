@@ -72,6 +72,17 @@ class RemoteFunction:
             if (self._options["placement_group"] is None
                 and (strat is None or isinstance(strat, str)))
             else None)
+        # submission fast path: everything per-call-invariant is decided
+        # here once, so `.remote()` with plain options is a TaskSpec
+        # construction + submit and nothing else. The resources dict is
+        # SHARED across this function's specs (read-only downstream).
+        self._resources = res
+        self._descriptor = f"{self._module}.{self._name}"
+        self._fast = (strat is None
+                      and self._options["placement_group"] is None
+                      and not self._options["runtime_env"]
+                      and not self._is_generator
+                      and isinstance(self._options["num_returns"], int))
         functools.update_wrapper(self, func)
 
     def bind(self, *args, **kwargs):
@@ -107,6 +118,40 @@ class RemoteFunction:
 
     def _remote(self, args, kwargs, opts):
         worker = worker_mod.get_worker()
+        if opts is self._options and self._fast:
+            from ray_tpu.util.placement_group import _current_pg
+            if _current_pg.get() is None:
+                func = self._exec_func
+                if func is None:
+                    func = self._exec_func = self._function
+                if self._fn_blob is None and worker.needs_serialized_funcs:
+                    import hashlib
+
+                    import cloudpickle
+                    self._fn_blob = cloudpickle.dumps(func)
+                    self._fn_id = hashlib.sha1(self._fn_blob).digest()
+                max_retries = opts["max_retries"]
+                if max_retries is None:
+                    from ray_tpu._private.config import GLOBAL_CONFIG
+                    max_retries = GLOBAL_CONFIG.task_max_retries
+                num_returns = opts["num_returns"]
+                spec = TaskSpec(
+                    task_id=worker.next_task_id(),
+                    name=opts["name"] or self._name,
+                    func=func,
+                    func_descriptor=self._descriptor,
+                    args=args,
+                    kwargs=kwargs,
+                    num_returns=num_returns,
+                    resources=self._resources,
+                    max_retries=max_retries,
+                    retry_exceptions=opts["retry_exceptions"],
+                    serialized_func=self._fn_blob,
+                    func_id=self._fn_id,
+                    class_key=self._class_key,
+                )
+                refs = worker.submit_task(spec)
+                return refs[0] if num_returns == 1 else refs
         num_returns = opts["num_returns"]
         generator = self._is_generator or num_returns in ("dynamic", "streaming")
         if generator and isinstance(num_returns, str):
