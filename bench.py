@@ -114,6 +114,17 @@ def main() -> int:
         traceback.print_exc()
         out["data_arrow_mb_per_sec"] = None
 
+    # --- Data library: columnar shuffle MB/s ---------------------------
+    try:
+        r = perf.data_shuffle_throughput(total_mb=16 if smoke else 128)
+        out["data_shuffle_mb_per_sec"] = r["mb_per_sec"]
+        print(f"  data shuffle: {r['mb_per_sec']:.0f} MB/s "
+              f"({r['total_mb']:.0f} MB in {r['seconds']:.1f}s)",
+              file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
+        out["data_shuffle_mb_per_sec"] = None
+
     # --- model perf: step time / tokens/s / MFU ------------------------
     try:
         m = perf.model_mfu(smoke=smoke)

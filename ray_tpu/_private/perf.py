@@ -130,11 +130,16 @@ def data_pipeline_throughput(num_blocks: int = 100_000,
     }
 
 
-def data_arrow_throughput(total_mb: int = 256, num_blocks: int = 64,
-                          num_workers: int = 8) -> Dict[str, Any]:
-    """Columnar path MB/s: Arrow blocks flow through a numpy-format
-    map_batches in PROCESS workers (shm arena data plane; the sizes are
-    real block nbytes, so MB/s is honest payload throughput)."""
+def _arrow_data_bench(make_ds, warm_op, total_mb: int, num_blocks: int,
+                      num_workers: int, arena_mult: int,
+                      payload_mult: int) -> Dict[str, Any]:
+    """Shared harness for the Arrow data-plane benchmarks: sized shm
+    arena (the default 256 MB would thrash the spill tier and measure
+    disk), a warm-up dataset to absorb worker spin-up and per-worker
+    pyarrow imports (hundreds of ms each, serialized on small hosts),
+    then a timed iter_batches pass with honest block-nbytes accounting.
+    payload_mult: 2 counts in+out payload (map), 1 counts output only
+    (exchange)."""
     import numpy as np
     import pyarrow as pa
 
@@ -143,25 +148,18 @@ def data_arrow_throughput(total_mb: int = 256, num_blocks: int = 64,
     from ray_tpu.data import block as blk
 
     ray_tpu.shutdown()
-    # arena sized for the working set (inputs stay pinned by their refs
-    # for the whole run + in-flight outputs); the default 256 MB would
-    # thrash the spill tier and measure disk, not the data plane
     ray_tpu.init(num_workers=num_workers, scheduler="tensor",
                  _system_config={"worker_mode": "process",
                                  "object_store_memory":
-                                     max(4 * total_mb, 512) * 1024 * 1024})
+                                     max(arena_mult * total_mb, 512)
+                                     * 1024 * 1024})
     try:
         n_rows = total_mb * 1024 * 1024 // 8
         table = pa.table({"x": np.arange(n_rows, dtype=np.int64)})
-        ds = data.from_arrow(table, parallelism=num_blocks).map_batches(
-            lambda cols: {"x": cols["x"] * 2}, batch_format="numpy")
-        # warm worker spin-up AND per-worker pyarrow imports (hundreds
-        # of ms each, serialized on small hosts) so the timed pass
-        # measures the data plane, not interpreter imports
         warm = pa.table({"x": np.arange(num_workers * 4, dtype=np.int64)})
-        data.from_arrow(warm, parallelism=num_workers * 4).map_batches(
-            lambda cols: cols, batch_format="numpy").count()
+        warm_op(data.from_arrow(warm, parallelism=num_workers * 4)).count()
         time.sleep(2.0)
+        ds = make_ds(data.from_arrow(table, parallelism=num_blocks))
         t0 = time.perf_counter()
         out_bytes = 0
         rows = 0
@@ -173,11 +171,38 @@ def data_arrow_throughput(total_mb: int = 256, num_blocks: int = 64,
     finally:
         ray_tpu.shutdown()
     return {
-        "total_mb": round(2 * out_bytes / 1e6, 1),  # in + out payload
+        "total_mb": round(payload_mult * out_bytes / 1e6, 1),
         "seconds": dt,
-        "mb_per_sec": round(2 * out_bytes / 1e6 / dt, 1),
+        "mb_per_sec": round(payload_mult * out_bytes / 1e6 / dt, 1),
         "num_blocks": num_blocks,
     }
+
+
+def data_arrow_throughput(total_mb: int = 256, num_blocks: int = 64,
+                          num_workers: int = 8) -> Dict[str, Any]:
+    """Columnar path MB/s: Arrow blocks flow through a numpy-format
+    map_batches in PROCESS workers (shm arena data plane; the sizes are
+    real block nbytes, so MB/s is honest in+out payload throughput)."""
+    def mapped(ds):
+        return ds.map_batches(lambda cols: {"x": cols["x"] * 2},
+                              batch_format="numpy")
+
+    def warm(ds):
+        return ds.map_batches(lambda cols: cols, batch_format="numpy")
+
+    return _arrow_data_bench(mapped, warm, total_mb, num_blocks,
+                             num_workers, arena_mult=4, payload_mult=2)
+
+
+def data_shuffle_throughput(total_mb: int = 128, num_blocks: int = 16,
+                            num_workers: int = 8) -> Dict[str, Any]:
+    """Columnar all-to-all MB/s: random_shuffle over Arrow blocks — the
+    exchange stays table.take()/concat (rows never materialize)."""
+    def shuffled(ds):
+        return ds.random_shuffle()
+
+    return _arrow_data_bench(shuffled, shuffled, total_mb, num_blocks,
+                             num_workers, arena_mult=6, payload_mult=1)
 
 
 def _flops_per_step(compiled, params, batch: int, seq: int) -> float:

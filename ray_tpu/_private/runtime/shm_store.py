@@ -228,6 +228,10 @@ class ShmObjectStore:
         os.makedirs(self._spill_dir, exist_ok=True)
         self.num_spilled = 0
         self.num_restored = 0
+        # zero-copy pins: object_id -> live-view count; freed-while-
+        # pinned ranges wait in _deferred until their last unpin
+        self._pins: Dict[ObjectID, int] = {}
+        self._deferred: Dict[ObjectID, _Alloc] = {}
         self._lock = threading.Lock()
 
     # -- create/seal lifecycle --------------------------------------------
@@ -350,7 +354,31 @@ class ShmObjectStore:
         self.seal(object_id)
         return offset, nbytes
 
-    def get_serialized(self, object_id: ObjectID) -> Optional[SerializedObject]:
+    def get_serialized_for_view(
+            self, object_id: ObjectID
+    ) -> Tuple[Optional[SerializedObject], bool]:
+        """(sobj, pinned) for a caller that will hand out ZERO-COPY
+        views. pinned=True only when served straight from the arena —
+        the range is then atomically pinned against free_object reuse
+        and the caller must unpin() once the views are collected (the
+        plasma Release analog; without it, freeing a consumed block
+        while an Arrow/numpy view is alive hands its bytes to the next
+        allocation and the view silently mutates). Spill-tier reads
+        copy off disk and need no pin."""
+        with self._lock:
+            alloc = self._table.get(object_id)
+            if alloc is not None and alloc.sealed:
+                alloc.accessed = True
+                self._pins[object_id] = self._pins.get(object_id, 0) + 1
+                loc = (alloc.offset, alloc.nbytes)
+            else:
+                loc = None
+        if loc is not None:
+            return SerializedObject.from_bytes(self.arena.view(*loc)), True
+        return self.get_serialized(object_id), False
+
+    def get_serialized(self, object_id: ObjectID
+                       ) -> Optional[SerializedObject]:
         loc = self.locate(object_id)
         if loc is not None:
             offset, nbytes = loc
@@ -371,10 +399,30 @@ class ShmObjectStore:
             self.num_restored += 1
         return SerializedObject.from_bytes(data)
 
+    def unpin(self, object_id: ObjectID) -> None:
+        """Zero-copy views of the object were collected; recycle a
+        deferred range once the last pin drops."""
+        deferred = None
+        with self._lock:
+            count = self._pins.get(object_id, 0) - 1
+            if count > 0:
+                self._pins[object_id] = count
+            else:
+                self._pins.pop(object_id, None)
+                deferred = self._deferred.pop(object_id, None)
+        if deferred is not None:
+            self.arena.free(deferred.offset, deferred.nbytes)
+
     def free_object(self, object_id: ObjectID) -> None:
         with self._lock:
             alloc = self._table.pop(object_id, None)
             spilled = self._spilled.pop(object_id, None)
+            if alloc is not None and self._pins.get(object_id):
+                # live zero-copy views: quarantine the range until the
+                # last pin drops (unpin) instead of handing the bytes
+                # to the next allocation under those views
+                self._deferred[object_id] = alloc
+                alloc = None
         if alloc is not None:
             self.arena.free(alloc.offset, alloc.nbytes)
         if spilled is not None:

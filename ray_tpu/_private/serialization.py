@@ -135,6 +135,53 @@ def deserialize(obj: SerializedObject) -> Any:
     return pickle.loads(obj.meta, buffers=buffers)
 
 
+class _BufferAnchor:
+    """Weakref-able buffer-protocol re-exporter. Reconstructed views
+    (numpy arrays, Arrow buffers — and anything sliced off them) keep
+    their buffer EXPORTER alive through the C buffer protocol; plain
+    memoryviews cannot take weakrefs, so re-exporting through this
+    anchor is what lets a finalizer observe the true last-view death."""
+
+    __slots__ = ("_mv", "__weakref__")
+
+    def __init__(self, mv: memoryview):
+        self._mv = mv
+
+    def __buffer__(self, flags) -> memoryview:
+        return self._mv
+
+
+def deserialize_with_release(obj: SerializedObject,
+                             release: Callable[[], None]) -> Any:
+    """deserialize(), with `release()` called when the LAST object
+    aliasing obj's out-of-band buffers is garbage-collected — including
+    sub-views extracted later (an Arrow column taken off a Table, a
+    numpy slice). Used by the shm store's zero-copy read path to hold
+    the arena pin for exactly the views' lifetime."""
+    import weakref
+
+    if not obj.buffers:
+        try:
+            return deserialize(obj)  # plain pickle: nothing aliases
+        finally:
+            release()
+    anchors = [_BufferAnchor(b if isinstance(b, memoryview)
+                             else memoryview(b)) for b in obj.buffers]
+    remaining = [len(anchors)]
+
+    def _one_done():
+        remaining[0] -= 1
+        if remaining[0] == 0:
+            release()
+
+    for a in anchors:
+        weakref.finalize(a, _one_done)
+    rewrapped = SerializedObject(obj.meta,
+                                 [memoryview(a) for a in anchors],
+                                 obj.contained_refs)
+    return deserialize(rewrapped)
+
+
 def _device_to_host(value: Any) -> Any:
     """Convert jax.Array leaves to numpy (zero-copy when already on host)."""
     try:

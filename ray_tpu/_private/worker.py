@@ -199,6 +199,9 @@ class _WorkQueue:
 
     def submit(self, fn, *args) -> None:
         with self._cv:
+            if self._stop:
+                raise RuntimeError(
+                    "cannot schedule new futures after shutdown")
             self._q.append((fn, args))
             if self._idle:
                 self._cv.notify()
@@ -206,6 +209,9 @@ class _WorkQueue:
     def submit_many(self, items) -> None:
         """Enqueue [(fn, args), ...] under ONE lock acquisition."""
         with self._cv:
+            if self._stop:
+                raise RuntimeError(
+                    "cannot schedule new futures after shutdown")
             self._q.extend(items)
             if self._idle:
                 self._cv.notify(min(len(items), self._idle))
@@ -467,11 +473,20 @@ class Worker:
                                                            ShmPlaceholder)
         value = entry.value
         if isinstance(value, ShmPlaceholder):
-            from ray_tpu._private.serialization import deserialize
-            sobj = self.shm_store.get_serialized(object_id)
+            from ray_tpu._private.serialization import (
+                deserialize, deserialize_with_release)
+            sobj, pinned = self.shm_store.get_serialized_for_view(object_id)
             if sobj is None:
                 raise rex.ObjectLostError(object_id.hex())
-            value = deserialize(sobj)
+            if pinned:
+                # the arena range stays pinned until the LAST view that
+                # aliases it (incl. later-taken sub-views) is collected;
+                # the helper owns the release even on deserialize errors
+                value = deserialize_with_release(
+                    sobj,
+                    lambda oid=object_id: self.shm_store.unpin(oid))
+            else:
+                value = deserialize(sobj)  # spill read: copied bytes
             entry.value = value  # memoize the zero-copy view object
         elif isinstance(value, RemotePlaceholder):
             from ray_tpu._private.serialization import (SerializedObject,
@@ -1052,7 +1067,8 @@ class Worker:
                 # wait for them through the normal dependency machinery
                 # (the finally block releases this execution first)
                 self.reference_counter.add_submitted_task_references(
-                    _top_level_deps(spec.args, spec.kwargs))
+                    getattr(spec, "_deps_memo", None)
+                    or _top_level_deps(spec.args, spec.kwargs))
                 retry_task = PendingTask(spec=spec, deps=requeue_deps,
                                          execute=_noop_exec)
                 return

@@ -81,15 +81,35 @@ def _map_task(fn, block):
 # ----------------------------------------------------------------------
 
 @ray_tpu.remote
-def _sample_task(block, k):
+def _sample_task(block, k, key=None):
+    """k sampled SORT-KEY values from the block. Column-name keys on
+    Arrow blocks take k indices off the key column — the block itself
+    never converts to rows."""
     import random as _r
 
     from ray_tpu.data import block as _blk
 
+    if isinstance(key, str) and _blk._is_arrow(block):
+        n = block.num_rows
+        if not n:
+            return []
+        idx = _r.Random(0).sample(range(n), min(k, n))
+        return block.column(key).take(idx).to_pylist()
     rows = _blk.block_to_rows(block)
     if not rows:
         return []
-    return _r.Random(0).sample(rows, min(k, len(rows)))
+    keyf = _row_keyf(key)
+    return [keyf(r) for r in _r.Random(0).sample(rows, min(k, len(rows)))]
+
+
+def _row_keyf(key):
+    """Row-space sort key: column-NAME keys (the reference's
+    Dataset.sort("col") form) index the row dict; callables pass
+    through; None is identity."""
+    if isinstance(key, str):
+        import operator
+        return operator.itemgetter(key)
+    return key or (lambda x: x)
 
 
 def _stable_hash(value) -> int:
@@ -102,14 +122,46 @@ def _stable_hash(value) -> int:
     return zlib.crc32(pickle.dumps(value, protocol=4))
 
 
+def _arrow_partition(kind, arg, num_out, table, block_idx):
+    """Columnar partitioning: destination indices computed vectorized,
+    sub-blocks emitted as table.take() views — rows never materialize
+    (reference: the block-level exchange of push-based shuffle; here
+    the sub-blocks stay Arrow end-to-end). Returns None when the op
+    needs row semantics (callable sort key, groupby)."""
+    import numpy as np
+
+    n = table.num_rows
+    if kind == "repartition":
+        return [table.take(np.arange(j, n, num_out)) for j in range(num_out)]
+    if kind == "shuffle":
+        dest = np.random.default_rng(
+            (arg * 1_000_003 + block_idx) & 0xFFFFFFFF).integers(
+                0, num_out, n)
+        return [table.take(np.flatnonzero(dest == j)) for j in range(num_out)]
+    if kind == "sort":
+        key, _desc, boundaries = arg
+        if not isinstance(key, str):
+            return None  # callable keys are row semantics
+        vals = table.column(key).to_numpy(zero_copy_only=False)
+        dest = np.searchsorted(np.asarray(boundaries), vals, side="right")
+        return [table.take(np.flatnonzero(dest == j)) for j in range(num_out)]
+    return None  # groupby: per-value stable hash is row-cost either way
+
+
 @ray_tpu.remote
 def _partition_task(kind, arg, num_out, block, block_idx):
     """block -> num_out sub-blocks (returned as num_out VALUES via
     num_returns, so each reducer fetches only its own piece)."""
     from ray_tpu.data import block as _blk
 
-    # the exchange is ROW-oriented (hash/range partitioning): columnar
-    # blocks convert to rows at this boundary
+    if _blk._is_arrow(block):
+        out = _arrow_partition(kind, arg, num_out, block, block_idx)
+        if out is not None:
+            # num_out == 1 runs with num_returns=1, where the return
+            # value IS the single piece (a 1-list would reach the
+            # reducer as a nested block)
+            return out if num_out > 1 else out[0]
+    # row-oriented fallback (hash/range partitioning over Python rows)
     block = _blk.block_to_rows(block)
     parts: List[List[Any]] = [[] for _ in range(num_out)]
     if kind == "repartition":
@@ -127,33 +179,52 @@ def _partition_task(kind, arg, num_out, block, block_idx):
         import bisect
 
         key, _desc, boundaries = arg
-        keyf = key or (lambda x: x)
+        keyf = _row_keyf(key)
         for row in block:
             parts[bisect.bisect_right(boundaries, keyf(row))].append(row)
     elif kind == "groupby":
-        key = arg
+        key = _row_keyf(arg)
         for row in block:
             parts[_stable_hash(key(row)) % num_out].append(row)
     else:
         raise ValueError(kind)
-    return parts
+    return parts if num_out > 1 else parts[0]
 
 
 @ray_tpu.remote
 def _reduce_task(kind, arg, j, *pieces):
     """pieces: this reducer's sub-block from every partition task."""
+    from ray_tpu.data import block as _blk
+
+    if pieces and all(_blk._is_arrow(p) for p in pieces):
+        import numpy as np
+        import pyarrow as pa
+
+        table = pa.concat_tables(pieces).combine_chunks()
+        if kind == "sort":
+            key, desc, _b = arg
+            table = table.sort_by(
+                [(key, "descending" if desc else "ascending")])
+        elif kind == "shuffle":
+            perm = np.random.default_rng(
+                (arg * 1_000_003 + j) & 0xFFFFFFFF).permutation(
+                    table.num_rows)
+            table = table.take(perm)
+        return table
     rows: List[Any] = []
     for piece in pieces:
-        rows.extend(piece)
+        rows.extend(_blk.block_to_rows(piece)
+                    if _blk._is_arrow(piece) else piece)
     if kind == "sort":
         key, desc, _b = arg
-        rows.sort(key=key, reverse=desc)
+        rows.sort(key=_row_keyf(key), reverse=desc)
     elif kind == "shuffle":
         import random as _r
 
         _r.Random(arg * 1_000_003 + j).shuffle(rows)
     elif kind == "groupby":
         key, fn = arg
+        key = _row_keyf(key)
         groups: dict = {}
         for row in rows:
             groups.setdefault(key(row), []).append(row)
@@ -167,10 +238,11 @@ def all_to_all(refs: List[Any], op: _LogicalOp) -> List[Any]:
     num_out = op.num_blocks or max(1, len(refs))
     if kind == "sort":
         key, desc = arg
-        keyf = key or (lambda x: x)
         samples: List[Any] = []
-        for s in ray_tpu.get([_sample_task.remote(r, 20) for r in refs]):
-            samples.extend(keyf(x) for x in s)
+        # sample tasks return KEY VALUES (columnar on Arrow blocks)
+        for s in ray_tpu.get([_sample_task.remote(r, 20, key)
+                              for r in refs]):
+            samples.extend(s)
         samples.sort()
         # num_out-1 boundary keys -> num_out range partitions
         boundaries = [samples[int(len(samples) * (i + 1) / num_out)]

@@ -9,7 +9,8 @@ executor (ray_tpu/data/_streaming.py).
 from __future__ import annotations
 
 import builtins
-from typing import Any, Callable, Iterator, List, Optional, Sequence
+from typing import (Any, Callable, Iterator, List, Optional, Sequence,
+                    Union)
 
 # ----------------------------------------------------------------------
 # logical operators
@@ -129,12 +130,14 @@ class Dataset:
             num_blocks=num_blocks,
             fn=("repartition", None), parent=self._op))
 
-    def sort(self, key: Optional[Callable[[Any], Any]] = None,
+    def sort(self, key: Union[str, Callable[[Any], Any], None] = None,
              descending: bool = False,
              num_blocks: int = 0) -> "Dataset":
         """Distributed range-partitioned sort: sample -> partition by
         boundary -> per-partition sort (reference: sort.py push-based
-        shuffle at minimum scale)."""
+        shuffle at minimum scale). A STRING key names a column — on
+        Arrow blocks the whole exchange then stays columnar (vectorized
+        range partition + table.sort_by, rows never materialize)."""
         return Dataset(_LogicalOp(
             "all_to_all", name="sort", num_blocks=num_blocks,
             fn=("sort", (key, descending)), parent=self._op))

@@ -90,6 +90,40 @@ class TestShmArena:
         finally:
             store.shutdown()
 
+    def test_pinned_range_not_reused_while_views_live(self):
+        """free_object on a PINNED object must quarantine the arena
+        range, not recycle it — a zero-copy view (Arrow/numpy) would
+        silently mutate when the bytes go to the next allocation
+        (regression: large Dataset scans returned corrupted columns
+        once consumed blocks' refs died mid-iteration)."""
+        from ray_tpu._private.ids import JobID, ObjectID, TaskID
+        from ray_tpu._private.serialization import deserialize, serialize
+
+        store = ShmObjectStore(1 << 20)
+        try:
+            tid = TaskID.of(JobID.from_int(2))
+            oid = ObjectID.for_task_return(tid, 0)
+            arr = np.arange(4096, dtype=np.int64)
+            store.put_serialized(oid, serialize(arr))
+            sobj, pinned = store.get_serialized_for_view(oid)
+            assert pinned
+            view = deserialize(sobj)
+            assert not view.flags["OWNDATA"]
+            store.free_object(oid)  # ref died; view still alive
+            # hammer the freed space with new objects
+            for i in range(8):
+                o2 = ObjectID.for_task_return(tid, i + 1)
+                store.put_serialized(
+                    o2, serialize(np.full(4096, -1, dtype=np.int64)))
+            np.testing.assert_array_equal(view, np.arange(4096))
+            store.unpin(oid)  # views collected: range recycles now
+            o3 = ObjectID.for_task_return(tid, 99)
+            store.put_serialized(
+                o3, serialize(np.zeros(4096, dtype=np.int64)))
+            assert store.contains(o3)
+        finally:
+            store.shutdown()
+
 
 # ----------------------------------------------------------------------
 # End-to-end through the public API, worker_mode=process
