@@ -122,6 +122,34 @@ def _stable_hash(value) -> int:
     return zlib.crc32(pickle.dumps(value, protocol=4))
 
 
+def _agg_key_hash(value) -> int:
+    """Partition hash for groupby_agg keys. Numeric keys use the same
+    int64-truncation formula as the vectorized columnar path, so one
+    key never lands on two reducers when a dataset mixes Arrow and row
+    blocks; null-ish keys (None/NaN/inf) all route to reducer 0 in
+    both paths for the same reason; everything else uses the pickled
+    stable hash. (Within a reducer, Arrow groups nulls as one group;
+    the row path groups None as one group but distinct NaN objects
+    per-object — the reference's row semantics.)"""
+    import numbers
+    if value is None:
+        return 0
+    if isinstance(value, numbers.Real) and not isinstance(value, bool):
+        try:
+            return (int(value) * 2654435761) & 0x7FFFFFFF
+        except (ValueError, OverflowError):  # NaN / inf
+            return 0
+    return _stable_hash(value)
+
+
+_AGG_COL = {"count": "count()", "sum": "sum({})", "mean": "mean({})",
+            "min": "min({})", "max": "max({})"}
+
+
+def _agg_out_name(col, op) -> str:
+    return _AGG_COL[op].format(col)
+
+
 def _arrow_partition(kind, arg, num_out, table, block_idx):
     """Columnar partitioning: destination indices computed vectorized,
     sub-blocks emitted as table.take() views — rows never materialize
@@ -145,7 +173,19 @@ def _arrow_partition(kind, arg, num_out, table, block_idx):
         vals = table.column(key).to_numpy(zero_copy_only=False)
         dest = np.searchsorted(np.asarray(boundaries), vals, side="right")
         return [table.take(np.flatnonzero(dest == j)) for j in range(num_out)]
-    return None  # groupby: per-value stable hash is row-cost either way
+    if kind == "groupby_agg":
+        key, _specs = arg
+        vals = table.column(key).to_numpy(zero_copy_only=False)
+        if vals.dtype.kind not in "iuf":
+            return None  # string keys: per-value pickle hash, row-cost
+        with np.errstate(invalid="ignore"):
+            dest = ((vals.astype(np.int64) * 2654435761)
+                    & 0x7FFFFFFF) % num_out
+        if vals.dtype.kind == "f":
+            # null/NaN/inf keys go to reducer 0, matching _agg_key_hash
+            dest = np.where(np.isfinite(vals), dest, 0)
+        return [table.take(np.flatnonzero(dest == j)) for j in range(num_out)]
+    return None  # groupby(map_groups): per-value stable hash, row-cost
 
 
 @ray_tpu.remote
@@ -186,6 +226,10 @@ def _partition_task(kind, arg, num_out, block, block_idx):
         key = _row_keyf(arg)
         for row in block:
             parts[_stable_hash(key(row)) % num_out].append(row)
+    elif kind == "groupby_agg":
+        key, _specs = arg
+        for row in block:
+            parts[_agg_key_hash(row[key]) % num_out].append(row)
     else:
         raise ValueError(kind)
     return parts if num_out > 1 else parts[0]
@@ -210,6 +254,18 @@ def _reduce_task(kind, arg, j, *pieces):
                 (arg * 1_000_003 + j) & 0xFFFFFFFF).permutation(
                     table.num_rows)
             table = table.take(perm)
+        elif kind == "groupby_agg":
+            key, specs = arg
+            pa_specs = [(([], "count_all") if op == "count"
+                         else (col, op)) for col, op in specs]
+            out = table.group_by(key).aggregate(pa_specs)
+            # pyarrow names results "<col>_<op>" / "count_all"; emit the
+            # reference's "<op>(<col>)" / "count()" form
+            rename = {(f"{col}_{op}" if op != "count" else "count_all"):
+                      _agg_out_name(col, op) for col, op in specs}
+            out = out.rename_columns(
+                [rename.get(c, c) for c in out.column_names])
+            return out
         return table
     rows: List[Any] = []
     for piece in pieces:
@@ -229,6 +285,34 @@ def _reduce_task(kind, arg, j, *pieces):
         for row in rows:
             groups.setdefault(key(row), []).append(row)
         rows = [fn(k, v) for k, v in groups.items()]
+    elif kind == "groupby_agg":
+        key, specs = arg
+        groups = {}
+        for row in rows:
+            groups.setdefault(row[key], []).append(row)
+        out_rows = []
+        for k, grp in groups.items():
+            rec = {key: k}
+            for col, op in specs:
+                if op == "count":
+                    rec["count()"] = len(grp)
+                    continue
+                # None values are skipped, matching Arrow's null
+                # semantics (all-null -> null result)
+                vals = [r[col] for r in grp if r[col] is not None]
+                if not vals:
+                    v = None
+                elif op == "sum":
+                    v = sum(vals)
+                elif op == "mean":
+                    v = sum(vals) / len(vals)
+                elif op == "min":
+                    v = min(vals)
+                else:
+                    v = max(vals)
+                rec[_agg_out_name(col, op)] = v
+            out_rows.append(rec)
+        rows = out_rows
     return rows
 
 

@@ -142,7 +142,11 @@ class Dataset:
             "all_to_all", name="sort", num_blocks=num_blocks,
             fn=("sort", (key, descending)), parent=self._op))
 
-    def groupby(self, key: Callable[[Any], Any]) -> "GroupedDataset":
+    def groupby(self, key: Union[str, Callable[[Any], Any]]
+                ) -> "GroupedDataset":
+        """A STRING key names a column (the reference's form); named
+        aggregations (count/sum/mean/min/max) then run COLUMNAR on
+        Arrow blocks via hash partition + table.group_by."""
         return GroupedDataset(self, key)
 
     def random_shuffle(self, seed: int = 0,
@@ -279,8 +283,36 @@ class GroupedDataset:
             "all_to_all", name="groupby.map_groups",
             fn=("groupby", (self._key, fn)), parent=self._ds._op))
 
+    def _named_agg(self, specs) -> Dataset:
+        """Named aggregation exchange (reference: GroupedData.sum("c")
+        etc.): hash-partition by the key COLUMN, reduce columnar via
+        pyarrow group_by when blocks are Arrow, row accumulators
+        otherwise — same output schema either way."""
+        if not isinstance(self._key, str):
+            raise TypeError(
+                "named aggregations (count/sum/mean/min/max) need a "
+                "column-name groupby key; use map_groups/aggregate for "
+                "callable keys")
+        return Dataset(_LogicalOp(
+            "all_to_all", name=f"groupby_agg({specs})",
+            fn=("groupby_agg", (self._key, specs)), parent=self._ds._op))
+
     def count(self) -> Dataset:
+        if isinstance(self._key, str):
+            return self._named_agg([(None, "count")])
         return self.map_groups(lambda k, rows: (k, len(rows)))
+
+    def sum(self, col: str) -> Dataset:
+        return self._named_agg([(col, "sum")])
+
+    def mean(self, col: str) -> Dataset:
+        return self._named_agg([(col, "mean")])
+
+    def min(self, col: str) -> Dataset:
+        return self._named_agg([(col, "min")])
+
+    def max(self, col: str) -> Dataset:
+        return self._named_agg([(col, "max")])
 
     def aggregate(self, agg: Callable[[List[Any]], Any]) -> Dataset:
         return self.map_groups(lambda k, rows, _a=agg: (k, _a(rows)))
