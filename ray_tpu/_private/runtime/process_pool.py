@@ -986,6 +986,26 @@ class ProcessWorkerPool:
             borrows.add(r.object_id())
         return [r.object_id().binary() for r in refs]
 
+    def _rpc_actor_call(self, h: _Handle, blob: bytes) -> list:
+        """Actor method submitted from INSIDE a worker-process task
+        (reference: core-worker actor task submission from any worker).
+        Runs the normal head-side submission path; the caller's task
+        borrows the return refs until it completes."""
+        from ray_tpu._private.ids import ActorID
+        from ray_tpu.actor import ActorHandle
+
+        aid_bin, method, args, kwargs, num_returns = cloudpickle.loads(blob)
+        handle = ActorHandle(ActorID(aid_bin))
+        out = getattr(handle, method).options(
+            num_returns=num_returns).remote(*args, **kwargs)
+        refs = out if isinstance(out, list) else [out]
+        borrows = self._task_borrows(h)
+        for r in refs:
+            self._worker.reference_counter.add_borrower(
+                r.object_id(), h.worker_id)
+            borrows.add(r.object_id())
+        return [r.object_id().binary() for r in refs]
+
     # ------------------------------------------------------------------
     # cancellation
     # ------------------------------------------------------------------

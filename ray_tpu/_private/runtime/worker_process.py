@@ -142,6 +142,20 @@ class ProcessWorkerContext:
         # provisional id replaced at owner admission
         return TaskID.of(self._runner.current_task_id.job_id())
 
+    def actor_call(self, actor_id, method_name: str, args, kwargs,
+                   num_returns: int = 1):
+        """Actor method invoked from inside a worker-process task:
+        route the submission to the owner (which holds the actor
+        runtime tables) over the pipe RPC."""
+        from ray_tpu._private.object_ref import ObjectRef
+
+        blob = cloudpickle.dumps(
+            (actor_id.binary(), method_name, args, kwargs, num_returns),
+            protocol=5)
+        ret_bins = self._runner.rpc("actor_call", (blob,))
+        refs = [ObjectRef(ObjectID(b), None) for b in ret_bins]
+        return refs[0] if num_returns == 1 else refs
+
     # -- no-op surfaces (single-owner model: the driver owns refcounts) ----
     class _NoopRC:
         def add_local_reference(self, oid):  # borrows tracked owner-side

@@ -820,6 +820,11 @@ class ActorHandle:
         if getattr(worker, "is_client", False):
             return worker.actor_call(self._actor_id, method_name, args,
                                      kwargs, num_returns)
+        if not hasattr(worker, "actors"):
+            # inside a process worker: the actor runtime tables live
+            # with the owner — route the call over the pipe RPC
+            return worker.actor_call(self._actor_id, method_name, args,
+                                     kwargs, num_returns)
         rt = self._runtime()
         with self._seq_lock:
             self._seq += 1

@@ -136,9 +136,12 @@ def _agg_key_hash(value) -> int:
         return 0
     if isinstance(value, numbers.Real) and not isinstance(value, bool):
         try:
-            return (int(value) * 2654435761) & 0x7FFFFFFF
+            iv = int(value)
         except (ValueError, OverflowError):  # NaN / inf
             return 0
+        if not -(2 ** 63) <= iv < 2 ** 63:
+            return 0  # beyond int64: the vectorized cast saturates
+        return (iv * 2654435761) & 0x7FFFFFFF
     return _stable_hash(value)
 
 
@@ -182,8 +185,11 @@ def _arrow_partition(kind, arg, num_out, table, block_idx):
             dest = ((vals.astype(np.int64) * 2654435761)
                     & 0x7FFFFFFF) % num_out
         if vals.dtype.kind == "f":
-            # null/NaN/inf keys go to reducer 0, matching _agg_key_hash
-            dest = np.where(np.isfinite(vals), dest, 0)
+            # null/NaN/inf AND beyond-int64 keys go to reducer 0,
+            # matching _agg_key_hash (the int64 cast saturates there)
+            in_range = (np.isfinite(vals)
+                        & (vals >= -(2.0 ** 63)) & (vals < 2.0 ** 63))
+            dest = np.where(in_range, dest, 0)
         return [table.take(np.flatnonzero(dest == j)) for j in range(num_out)]
     return None  # groupby(map_groups): per-value stable hash, row-cost
 

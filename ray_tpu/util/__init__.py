@@ -9,10 +9,11 @@ from ray_tpu.util.scheduling_strategies import (  # noqa: F401
     NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
 from ray_tpu.util import state  # noqa: F401
 from ray_tpu.util import metrics  # noqa: F401
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
 
 __all__ = [
     "placement_group", "remove_placement_group", "placement_group_table",
     "get_current_placement_group", "PlacementGroup",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
-    "state", "metrics",
+    "state", "metrics", "ActorPool",
 ]

@@ -537,3 +537,29 @@ class TestSpilling:
             assert sums == [40_000.0 * i for i in range(4)]
         finally:
             ray_tpu.shutdown()
+
+
+class TestWorkerActorCalls:
+    def test_actor_call_from_process_task(self, proc_ray):
+        """A task running in a worker PROCESS can call actor methods:
+        the submission routes to the owner over the pipe RPC
+        (reference: core-worker actor task submission from any
+        worker)."""
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        @ray_tpu.remote
+        def feed(counter, k):
+            return ray_tpu.get(counter.add.remote(k))
+
+        c = Counter.remote()
+        out = ray_tpu.get([feed.remote(c, 1) for _ in range(4)],
+                          timeout=60)
+        assert sorted(out) == [1, 2, 3, 4]
+        assert ray_tpu.get(c.add.remote(0), timeout=30) == 4

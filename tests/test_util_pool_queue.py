@@ -1,0 +1,181 @@
+"""ray_tpu.util.ActorPool + ray_tpu.util.queue.Queue.
+
+Reference behaviors: python/ray/util/actor_pool.py (ordered vs
+unordered consumption, pending submits drain as actors free up,
+push/pop_idle membership) and python/ray/util/queue.py (blocking
+put/get with timeout on an async actor, nowait raises Empty/Full,
+batch ops, handles pickle into tasks).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=4, scheduler="tensor")
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class PoolWorker:
+    def double(self, x):
+        return 2 * x
+
+    def slow_double(self, x):
+        time.sleep(0.3 if x == 0 else 0.01)
+        return 2 * x
+
+
+class TestActorPool:
+    def test_map_ordered(self, rt):
+        pool = ActorPool([PoolWorker.remote() for _ in range(2)])
+        out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+        assert out == [2 * i for i in range(8)]
+
+    def test_map_unordered_completion_order(self, rt):
+        pool = ActorPool([PoolWorker.remote() for _ in range(2)])
+        out = list(pool.map_unordered(
+            lambda a, v: a.slow_double.remote(v), range(4)))
+        assert sorted(out) == [0, 2, 4, 6]
+        # the slow first item must NOT come back first
+        assert out[0] != 0
+
+    def test_submit_queues_beyond_pool_size(self, rt):
+        pool = ActorPool([PoolWorker.remote()])
+        for i in range(5):
+            pool.submit(lambda a, v: a.double.remote(v), i)
+        assert not pool.has_free()
+        got = [pool.get_next(timeout=60) for _ in range(5)]
+        assert got == [0, 2, 4, 6, 8]
+        assert not pool.has_next()
+        assert pool.has_free()
+
+    def test_push_pop_idle(self, rt):
+        a, b = PoolWorker.remote(), PoolWorker.remote()
+        pool = ActorPool([a])
+        pool.push(b)
+        popped = pool.pop_idle()
+        assert popped is not None
+        pool.submit(lambda ac, v: ac.double.remote(v), 21)
+        assert pool.get_next(timeout=60) == 42
+
+    def test_get_next_without_work_raises(self, rt):
+        pool = ActorPool([PoolWorker.remote()])
+        with pytest.raises(StopIteration):
+            pool.get_next()
+
+
+@ray_tpu.remote
+def _producer(q, items):
+    for it in items:
+        q.put(it)
+    return len(items)
+
+
+@ray_tpu.remote
+def _consumer(q, n):
+    return [q.get(timeout=30) for _ in range(n)]
+
+
+class TestQueue:
+    def test_fifo_roundtrip(self, rt):
+        q = Queue()
+        for i in range(5):
+            q.put(i)
+        assert q.qsize() == 5 and not q.empty()
+        assert [q.get() for _ in range(5)] == list(range(5))
+        assert q.empty()
+        q.shutdown()
+
+    def test_nowait_and_bounds(self, rt):
+        q = Queue(maxsize=2)
+        q.put_nowait(1)
+        q.put_nowait(2)
+        assert q.full()
+        with pytest.raises(Full):
+            q.put_nowait(3)
+        assert q.get_nowait() == 1
+        q2 = Queue()
+        with pytest.raises(Empty):
+            q2.get_nowait()
+        q.shutdown()
+        q2.shutdown()
+
+    def test_blocking_get_with_timeout(self, rt):
+        q = Queue()
+        t0 = time.monotonic()
+        with pytest.raises(Empty):
+            q.get(timeout=0.3)
+        assert time.monotonic() - t0 >= 0.25
+        q.shutdown()
+
+    def test_blocking_put_respects_capacity(self, rt):
+        q = Queue(maxsize=1)
+        q.put("a")
+        with pytest.raises(Full):
+            q.put("b", timeout=0.3)
+        assert q.get() == "a"
+        q.put("b", timeout=5)  # space freed: succeeds
+        assert q.get() == "b"
+        q.shutdown()
+
+    def test_cross_task_producer_consumer(self, rt):
+        """The handle pickles into tasks; a blocked consumer unblocks
+        when the producer task feeds the queue."""
+        q = Queue()
+        got_ref = _consumer.remote(q, 4)
+        time.sleep(0.2)  # consumer is parked on the empty queue
+        assert ray_tpu.get(_producer.remote(q, list("abcd")),
+                           timeout=60) == 4
+        assert ray_tpu.get(got_ref, timeout=60) == list("abcd")
+        q.shutdown()
+
+    def test_batch_ops(self, rt):
+        q = Queue(maxsize=4)
+        q.put_nowait_batch([1, 2, 3])
+        with pytest.raises(Full):
+            q.put_nowait_batch([4, 5])
+        assert q.get_nowait_batch(2) == [1, 2]
+        with pytest.raises(Empty):
+            q.get_nowait_batch(5)
+        q.shutdown()
+
+
+class TestActorPoolResilience:
+    def test_task_exception_does_not_shrink_pool(self, rt):
+        @ray_tpu.remote
+        class Flaky:
+            def work(self, x):
+                if x == 1:
+                    raise ValueError("boom")
+                return x
+
+        pool = ActorPool([Flaky.remote()])
+        pool.submit(lambda a, v: a.work.remote(v), 1)
+        with pytest.raises(ValueError):
+            pool.get_next(timeout=30)
+        # the actor came back: the pool still works
+        pool.submit(lambda a, v: a.work.remote(v), 7)
+        assert pool.get_next(timeout=30) == 7
+
+    def test_get_next_timeout_is_retryable(self, rt):
+        @ray_tpu.remote
+        class Slow:
+            def work(self):
+                time.sleep(1.0)
+                return "late"
+
+        pool = ActorPool([Slow.remote()])
+        pool.submit(lambda a, v: a.work.remote(), None)
+        with pytest.raises(TimeoutError):
+            pool.get_next(timeout=0.1)
+        # the slot was NOT consumed: the result is still retrievable
+        assert pool.get_next(timeout=30) == "late"
