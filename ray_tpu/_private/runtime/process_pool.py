@@ -385,15 +385,21 @@ class ProcessWorkerPool:
         if not built:
             return
         per_handle: Dict[_Handle, list] = {}
+        provisional: Dict[_Handle, int] = {}
         with self._lock:
             if self._shutdown:
                 return
             for pending, payload in built:
-                h = self._pick_worker_locked()
+                # Picks within one batch must see each other: inflight
+                # counts only update in _assign_many, so without the
+                # provisional map every post-idle task would land on the
+                # same "least-loaded" worker and blow the depth invariant.
+                h = self._pick_worker_locked(provisional)
                 if h is None:
                     self._queue.append((pending, payload))
                 else:
                     per_handle.setdefault(h, []).append((pending, payload))
+                    provisional[h] = provisional.get(h, 0) + 1
         for h, items in per_handle.items():
             self._assign_many(h, items)
 
@@ -462,12 +468,15 @@ class ProcessWorkerPool:
         except (OSError, ValueError) as e:
             self._on_worker_failure(h, e)
 
-    def _pick_worker_locked(self) -> Optional[_Handle]:
+    def _pick_worker_locked(
+            self, provisional: Optional[Dict["_Handle", int]] = None,
+    ) -> Optional[_Handle]:
         """Lease target for one task: an IDLE worker first (true
         process concurrency — tasks that sleep or block must overlap),
         then, at depth > 1, the least-loaded busy worker with pipe room
         (the backlog pipelines instead of round-tripping the
-        scheduler)."""
+        scheduler). `provisional` counts picks made earlier in the same
+        batch that haven't reached the handles' inflight sets yet."""
         if self._idle:
             return self._idle.popleft()
         if self._pipeline_depth <= 1:
@@ -478,6 +487,8 @@ class ProcessWorkerPool:
             if h.dead or not h.ready or h.actor_rt is not None:
                 continue
             n = len(h.inflight)
+            if provisional:
+                n += provisional.get(h, 0)
             if 0 < n < best_n:
                 best, best_n = h, n
         return best

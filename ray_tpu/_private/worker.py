@@ -89,6 +89,16 @@ class TaskManager:
             origin = rr[0].task_id() if rr else old_id
             self._pending_origin[origin] = spec.task_id
 
+    def pending_spec_for_object(self, oid: ObjectID) -> Optional[TaskSpec]:
+        """The in-flight spec that will produce oid, or None if its
+        task already completed (return ids derive from the ORIGINAL
+        task id, so retries resolve through _pending_origin)."""
+        with self._lock:
+            tid = oid.task_id()
+            tid = self._pending_origin.get(tid, tid)
+            entry = self._pending.get(tid)
+            return entry[0] if entry else None
+
     def complete(self, task_id: TaskID) -> None:
         with self._lock:
             entry = self._pending.pop(task_id, None)
@@ -527,6 +537,7 @@ class Worker:
         # from lineage before we block on the store
         missing = [oid for oid in ids if not self.memory_store.contains(oid)]
         if missing:
+            self._check_env_lock_deadlock(missing)
             self.object_recovery.recover_all(missing)
         try:
             entries = self.memory_store.wait_and_get(ids, timeout)
@@ -542,9 +553,51 @@ class Worker:
             out.append(self._entry_value(oid, entry))
         return out
 
+    def _env_lock_blocked_specs(self, missing: List[ObjectID]) -> List[TaskSpec]:
+        """Pending producers of `missing` that need the thread-mode
+        runtime-env lock, when the CALLING thread holds it — those
+        tasks can never run until the caller finishes (thread workers
+        serialize env'd tasks under one lock)."""
+        if Worker._env_lock_owner != threading.get_ident():
+            return []
+        blocked = []
+        for oid in missing:
+            spec = self.task_manager.pending_spec_for_object(oid)
+            env = spec.runtime_env if spec is not None else None
+            if env and (env.get("working_dir_pkg") or env.get("pip")):
+                blocked.append(spec)
+        return blocked
+
+    def _check_env_lock_deadlock(self, missing: List[ObjectID]) -> None:
+        """Fail loudly where a thread-mode env'd task would deadlock
+        blocking on another env'd task (fire-and-forget nested env'd
+        tasks remain legal — they run after the blocker releases)."""
+        blocked = self._env_lock_blocked_specs(missing)
+        if blocked:
+            raise RuntimeError(
+                f"deadlock: task {blocked[0].name} needs the "
+                "thread-mode runtime-env lock held by the task blocking "
+                "on it (thread workers serialize env'd tasks). Use "
+                "process workers for nested runtime environments, or "
+                "don't block on env'd children from an env'd task.")
+
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         ids = [r.object_id() for r in refs]
+        if timeout is None:
+            # deadlock only if the wait CANNOT be satisfied without an
+            # env-lock-blocked producer: refs already ready or produced
+            # by plain tasks still count toward num_returns
+            missing = [oid for oid in ids
+                       if not self.memory_store.contains(oid)]
+            blocked = self._env_lock_blocked_specs(missing)
+            if blocked and len(ids) - len(blocked) < num_returns:
+                raise RuntimeError(
+                    f"deadlock: wait(num_returns={num_returns}) cannot "
+                    f"complete without task {blocked[0].name}, which "
+                    "needs the thread-mode runtime-env lock held by the "
+                    "waiting task. Use process workers for nested "
+                    "runtime environments.")
         ready_set = self.memory_store.wait(ids, num_returns, timeout)
         ready, not_ready = [], []
         for r in refs:
@@ -1129,6 +1182,7 @@ class Worker:
     # same way, so env'd tasks take turns — process workers are the
     # isolated path, as in the reference)
     _env_serial_lock = threading.Lock()
+    _env_lock_owner: Optional[int] = None  # thread ident holding the lock
 
     def _enter_runtime_env(self, runtime_env: Optional[dict]):
         """Thread-mode env application: working_dir extraction +
@@ -1141,6 +1195,7 @@ class Worker:
         from ray_tpu._private import runtime_envs as rte
 
         Worker._env_serial_lock.acquire()
+        Worker._env_lock_owner = threading.get_ident()
         try:
             mgr = rte.get_manager()
             wd_path = None
@@ -1154,6 +1209,7 @@ class Worker:
             ctx = rte.applied_env(wd_path, sp, use_cwd=False)
             ctx.__enter__()
         except BaseException:
+            Worker._env_lock_owner = None
             Worker._env_serial_lock.release()
             raise
 
@@ -1164,6 +1220,7 @@ class Worker:
                 try:
                     ctx.__exit__(*exc)
                 finally:
+                    Worker._env_lock_owner = None
                     Worker._env_serial_lock.release()
                 return False
 

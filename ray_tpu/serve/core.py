@@ -243,6 +243,13 @@ class _DeploymentState:
             if _retry:
                 return self.submit(method, args, kwargs, _retry=False)
             raise
+        except BaseException:
+            # any other failure (e.g. argument serialization): the call
+            # never reached the replica, so the reservation must decay
+            # here or P2C routing skews away from it forever
+            with self._lock:
+                state.ongoing = max(0, state.ongoing - 1)
+            raise
         self._track_until_resolved(state, ref)
         return ref
 
@@ -282,6 +289,16 @@ class _DeploymentState:
                 # nothing was pinned yet: retry once on a replacement
                 return self.submit_sticky(method, args, kwargs,
                                           session=None, _retry=False)
+            raise
+        except BaseException:
+            # non-ActorError failure: release the reservation; an
+            # existing session stays pinned (the replica is healthy) but
+            # a just-opened token was never returned to the caller, so
+            # drop it
+            with self._lock:
+                state.ongoing = max(0, state.ongoing - 1)
+                if session is None:
+                    self._sticky.pop(token, None)
             raise
         self._track_until_resolved(state, ref)
         return ref, token

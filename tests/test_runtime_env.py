@@ -167,3 +167,58 @@ class TestActorEnv:
             ray_tpu.kill(a)
         finally:
             ray_tpu.shutdown()
+
+
+class TestNestedEnvDeadlock:
+    """Thread workers serialize env'd tasks under one lock; an env'd
+    task BLOCKING on another env'd task must raise, not hang — while
+    fire-and-forget nesting stays legal (advisor round-3 finding)."""
+
+    def test_blocking_on_nested_env_task_raises(self, tmp_path):
+        wd = str(tmp_path / "proj")
+        _write_module(wd, "nested_mod", "v")
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor")
+        try:
+            @ray_tpu.remote
+            def child():
+                import nested_mod
+                return nested_mod.VALUE
+
+            @ray_tpu.remote
+            def parent():
+                ref = child.options(
+                    runtime_env={"working_dir": wd}).remote()
+                return ray_tpu.get(ref, timeout=60)  # deadlock: detect
+
+            ref = parent.options(
+                runtime_env={"working_dir": wd}).remote()
+            with pytest.raises(RuntimeError, match="deadlock"):
+                ray_tpu.get(ref, timeout=60)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_fire_and_forget_nested_env_task_ok(self, tmp_path):
+        wd = str(tmp_path / "proj")
+        _write_module(wd, "nested_mod2", "ok")
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2, scheduler="tensor")
+        try:
+            @ray_tpu.remote
+            def child():
+                import nested_mod2
+                return nested_mod2.VALUE
+
+            @ray_tpu.remote
+            def parent():
+                # submit WITHOUT blocking: runs after parent releases
+                return child.options(
+                    runtime_env={"working_dir": wd}).remote()
+
+            inner = ray_tpu.get(ray_tpu.get(
+                parent.options(
+                    runtime_env={"working_dir": wd}).remote(),
+                timeout=60), timeout=60)
+            assert inner == "ok"
+        finally:
+            ray_tpu.shutdown()

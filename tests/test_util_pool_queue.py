@@ -72,6 +72,28 @@ class TestActorPool:
         with pytest.raises(StopIteration):
             pool.get_next()
 
+    def test_mixed_unordered_then_ordered(self, rt):
+        """get_next after get_next_unordered must not spin: the
+        ordered cursor skips indices the unordered path consumed
+        (advisor round-3 finding)."""
+        pool = ActorPool([PoolWorker.remote() for _ in range(2)])
+        for i in range(4):
+            pool.submit(lambda a, v: a.double.remote(v), i)
+        first = pool.get_next_unordered(timeout=30)
+        rest = [pool.get_next(timeout=30) for _ in range(3)]
+        assert sorted([first] + rest) == [0, 2, 4, 6]
+        assert not pool.has_next()
+
+    def test_ordered_get_drains_queued_submits(self, rt):
+        """A queued submit (pool smaller than the backlog) must drain
+        while get_next waits for an EARLIER index — _wait_any returns
+        finished actors to the pool without consuming results."""
+        pool = ActorPool([PoolWorker.remote()])
+        for i in range(6):
+            pool.submit(lambda a, v: a.slow_double.remote(v), i)
+        got = [pool.get_next(timeout=60) for _ in range(6)]
+        assert got == [0, 2, 4, 6, 8, 10]
+
 
 @ray_tpu.remote
 def _producer(q, items):
