@@ -19,29 +19,29 @@ from typing import Optional
 logger = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "allocator.cc")
-_SO = os.path.join(_DIR, "_allocator.so")
 
-_lib = None
+_libs: dict = {}
 _lib_lock = threading.Lock()
-_load_failed = False
+_load_failed: set = set()
 
 
-def _build() -> bool:
-    """g++ the allocator if the .so is missing or stale."""
+def _build(name: str) -> bool:
+    """g++ <name>.cc into _<name>.so if missing or stale."""
+    src = os.path.join(_DIR, f"{name}.cc")
+    so = os.path.join(_DIR, f"_{name}.so")
     try:
-        if os.path.exists(_SO) and \
-                os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        if os.path.exists(so) and \
+                os.path.getmtime(so) >= os.path.getmtime(src):
             return True
         # per-pid temp: concurrent builders (two drivers, parallel
         # pytest) must not install each other's half-written output
-        tmp = f"{_SO}.{os.getpid()}.tmp"
+        tmp = f"{so}.{os.getpid()}.tmp"
         try:
-            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
                    "-o", tmp]
             subprocess.run(cmd, check=True, capture_output=True,
                            timeout=120)
-            os.replace(tmp, _SO)
+            os.replace(tmp, so)
             return True
         finally:
             if os.path.exists(tmp):
@@ -51,40 +51,62 @@ def _build() -> bool:
         stderr = getattr(e, "stderr", None)
         if stderr:
             detail = ": " + stderr.decode(errors="replace").strip()[:500]
-        logger.warning("native allocator build failed (%s%s); using the "
-                       "Python fallback", e, detail)
+        logger.warning("native %s build failed (%s%s); using the "
+                       "Python fallback", name, e, detail)
         return False
+
+
+def load_native_lib(name: str) -> Optional[ctypes.CDLL]:
+    """Build-and-load a _native component by name, or None (fallback)."""
+    with _lib_lock:
+        if name in _libs:
+            return _libs[name]
+        if name in _load_failed:
+            return None
+        if not _build(name):
+            _load_failed.add(name)
+            return None
+        try:
+            lib = ctypes.CDLL(os.path.join(_DIR, f"_{name}.so"))
+        except OSError as e:
+            logger.warning("native %s load failed (%s)", name, e)
+            _load_failed.add(name)
+            return None
+        _libs[name] = lib
+        return lib
+
+
+def load_exchange_lib() -> Optional[ctypes.CDLL]:
+    """PRP shuffle kernels (exchange.cc), or None (numpy fallback)."""
+    lib = load_native_lib("exchange")
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        u64, u32 = ctypes.c_uint64, ctypes.c_uint32
+        vp = ctypes.c_void_p
+        lib.prp_gather.argtypes = [vp, vp, u32, u64, u64, u64, vp]
+        lib.prp_indices.argtypes = [vp, u64, u64, u64, vp]
+        lib._sigs_set = True  # AFTER signatures: other threads race here
+    return lib
 
 
 def load_allocator_lib() -> Optional[ctypes.CDLL]:
     """The compiled allocator library, or None (fallback)."""
-    global _lib, _load_failed
-    with _lib_lock:
-        if _lib is not None or _load_failed:
-            return _lib
-        if not _build():
-            _load_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError as e:
-            logger.warning("native allocator load failed (%s)", e)
-            _load_failed = True
-            return None
-        lib.arena_create.restype = ctypes.c_void_p
-        lib.arena_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
-        lib.arena_destroy.argtypes = [ctypes.c_void_p]
-        lib.arena_alloc.restype = ctypes.c_int64
-        lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.arena_free.restype = ctypes.c_int
-        lib.arena_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
-                                   ctypes.c_uint64]
-        lib.arena_free_bytes.restype = ctypes.c_uint64
-        lib.arena_free_bytes.argtypes = [ctypes.c_void_p]
-        lib.arena_num_holes.restype = ctypes.c_uint64
-        lib.arena_num_holes.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    lib = load_native_lib("allocator")
+    if lib is None or getattr(lib, "_sigs_set", False):
+        return lib
+    lib.arena_create.restype = ctypes.c_void_p
+    lib.arena_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.arena_destroy.argtypes = [ctypes.c_void_p]
+    lib.arena_alloc.restype = ctypes.c_int64
+    lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.arena_free.restype = ctypes.c_int
+    lib.arena_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                               ctypes.c_uint64]
+    lib.arena_free_bytes.restype = ctypes.c_uint64
+    lib.arena_free_bytes.argtypes = [ctypes.c_void_p]
+    lib.arena_num_holes.restype = ctypes.c_uint64
+    lib.arena_num_holes.argtypes = [ctypes.c_void_p]
+    lib._sigs_set = True  # AFTER signatures: other threads race here
+    return lib
 
 
 class NativeFreeList:

@@ -1422,6 +1422,12 @@ def _likely_large(value: Any) -> bool:
         return value.nbytes > GLOBAL_CONFIG.inline_object_max_bytes
     if isinstance(value, (bytes, bytearray, memoryview)):
         return len(value) > GLOBAL_CONFIG.inline_object_max_bytes
+    t = type(value)
+    if ((t.__module__ or "").split(".")[0] == "pyarrow"
+            and hasattr(value, "nbytes")):
+        # Arrow tables/arrays: data-plane blocks — workers must read
+        # them zero-copy from the arena, not over the task pipe
+        return value.nbytes > GLOBAL_CONFIG.inline_object_max_bytes
     try:
         import jax
         if isinstance(value, jax.Array):

@@ -78,7 +78,116 @@ def _map_task(fn, block):
 # ----------------------------------------------------------------------
 # all-to-all exchange (reference: AllToAllOperator — map tasks partition,
 # reduce tasks gather; sort samples boundaries first)
+#
+# Shuffle/repartition take a faster, mapper-free route on Arrow blocks:
+# row DESTINATIONS don't depend on row CONTENT, so each reducer computes
+# its own source indices from a seeded bijection (a cycle-walking
+# Feistel network over [0, n)) and gathers straight out of the source
+# blocks — which it reads zero-copy from shm. One fewer full pass of
+# the dataset through the object store than the reference's map+reduce
+# shuffle, and no O(num_in x num_out) piece objects.
 # ----------------------------------------------------------------------
+
+
+from ray_tpu.data._shuffle import prp_indices as _prp_indices
+from ray_tpu.data._shuffle import prp_take_table as _prp_take_table
+
+
+@ray_tpu.remote
+def _repartition_reduce_task(j, num_out, *blocks):
+    """Output block j of a repartition: the global row range
+    [bounds[j], bounds[j+1]) assembled from zero-copy slices of the
+    source blocks (read zero-copy from shm) — page traffic touches
+    only this reducer's own rows. No mapper stage."""
+    import numpy as np
+
+    from ray_tpu.data import block as _blk
+
+    if not all(_blk._is_arrow(b) for b in blocks):
+        rows = []
+        for b in blocks:
+            rows.extend(_blk.block_to_rows(b))
+        bounds = np.linspace(0, len(rows), num_out + 1).astype(int)
+        return rows[bounds[j]:bounds[j + 1]]
+
+    import pyarrow as pa
+
+    counts = [b.num_rows for b in blocks]
+    bounds = np.linspace(0, sum(counts), num_out + 1).astype(int)
+    lo, hi = int(bounds[j]), int(bounds[j + 1])
+    pieces = []
+    off = 0
+    for b, c in zip(blocks, counts):
+        s, e = max(lo - off, 0), min(hi - off, c)
+        if s < e:
+            pieces.append(b.slice(s, e - s))
+        off += c
+    if not pieces:
+        return blocks[0].slice(0, 0)
+    # concat of slices is a VIEW — compact, or pickling the output
+    # would ship every source block's whole buffer
+    return _blk.compact_table(pa.concat_tables(pieces))
+
+
+@ray_tpu.remote
+def _shuffle_map_task(block, seed, i):
+    """Stage A of the shuffle: uniformly permute the block IN PLACE
+    (one cache-friendly gather within the block) and return it whole —
+    reducers slice their stripes zero-copy, so there is no
+    O(num_in x num_out) piece-object fan and no page-traffic
+    amplification. The permutation indices come from the Feistel PRP,
+    an order of magnitude cheaper than materializing
+    Generator.permutation."""
+    from ray_tpu.data import block as _blk
+
+    n = _blk.block_rows(block)
+    if n <= 1:
+        return block
+    if _blk._is_arrow(block):
+        return _prp_take_table(block, 0, n, n, seed * 1_000_003 + i + 1)
+    idx = _prp_indices(0, n, n, seed * 1_000_003 + i + 1)
+    return [block[k] for k in idx]
+
+
+@ray_tpu.remote
+def _shuffle_reduce_task(seed, j, num_out, *permuted):
+    """Stage B: stripe j of every stage-A block (zero-copy slices),
+    concatenated, then one PRP permute interleaves rows from different
+    sources. Stage A made each row's stripe — hence its output block —
+    uniform random; stage B makes within-block order uniform: the same
+    guarantee as the reference's map/reduce random_shuffle."""
+    import numpy as np
+
+    from ray_tpu.data import block as _blk
+
+    if all(_blk._is_arrow(b) for b in permuted):
+        import pyarrow as pa
+
+        pieces = []
+        for b in permuted:
+            bb = np.linspace(0, b.num_rows, num_out + 1).astype(int)
+            s, e = int(bb[j]), int(bb[j + 1])
+            if s < e:
+                pieces.append(b.slice(s, e - s))
+        if not pieces:
+            return permuted[0].slice(0, 0)
+        tbl = pa.concat_tables(pieces)  # zero-copy view of the stripes
+        m = tbl.num_rows
+        if m > 1:
+            # compacts the scattered stripes, then one cache-local
+            # PRP gather interleaves them
+            return _prp_take_table(tbl, 0, m, m, seed + 7919 * (j + 1))
+        # <=1 row: still a VIEW of the stage-A blocks — compact, or the
+        # pickled return ships every source buffer
+        return _blk.compact_table(tbl)
+    rows = []
+    for b in permuted:
+        r = _blk.block_to_rows(b)
+        bb = np.linspace(0, len(r), num_out + 1).astype(int)
+        rows.extend(r[int(bb[j]):int(bb[j + 1])])
+    perm = _prp_indices(0, len(rows), max(len(rows), 1),
+                        seed + 7919 * (j + 1))
+    return [rows[i] for i in perm]
 
 @ray_tpu.remote
 def _sample_task(block, k, key=None):
@@ -326,6 +435,30 @@ def all_to_all(refs: List[Any], op: _LogicalOp) -> List[Any]:
     """Materialized exchange over block refs; returns output refs."""
     kind, arg = op.fn
     num_out = op.num_blocks or max(1, len(refs))
+    if kind in ("repartition", "shuffle"):
+        # content-independent exchange: destinations don't depend on
+        # row values, so there is no piece-object fan at all.
+        # repartition: reducers slice their global range straight out
+        # of the source blocks (zero-copy shm reads, no mapper).
+        # shuffle: stage A permutes each block in place, stage B
+        # reducers slice stripes zero-copy and interleave — two
+        # cache-local gathers total, no O(in x out) objects.
+        first = ray_tpu.get(refs[0]) if refs else None
+        from ray_tpu.data import block as _blk
+
+        if _blk._is_arrow(first):
+            if kind == "repartition":
+                out = [_repartition_reduce_task.remote(j, num_out, *refs)
+                       for j in range(num_out)]
+            else:
+                seed = arg
+                permuted = [_shuffle_map_task.remote(r, seed, i)
+                            for i, r in enumerate(refs)]
+                out = [_shuffle_reduce_task.remote(seed, j, num_out,
+                                                   *permuted)
+                       for j in range(num_out)]
+            ray_tpu.wait(out, num_returns=len(out), timeout=None)
+            return out
     if kind == "sort":
         key, desc = arg
         samples: List[Any] = []
@@ -500,16 +633,8 @@ class StreamingExecutor:
         source = self._source
         if source.make_block is not None:
             return source.make_block
-        if source.blocks is not None:
-            # pre-built driver-resident blocks (e.g. from_arrow Table
-            # slices): one object-store put each, tasks fetch by ref —
-            # a closure would re-ship the data with EVERY task
-            refs = [ray_tpu.put(b) for b in source.blocks]
-
-            def make_block(i: int, _refs=tuple(refs)):
-                return ray_tpu.get(_refs[i])
-
-            return make_block
+        if source.refs is not None or source.blocks is not None:
+            return None  # refs feed stages directly; no source tasks
         items = source.items
         per = -(-len(items) // source.num_blocks) if items else 0
         refs = [ray_tpu.put(items[i * per:(i + 1) * per])
@@ -585,6 +710,14 @@ class StreamingExecutor:
                 if stage.limit_remaining <= 0:
                     self._quenched = True
 
+        src_refs = self._source.refs
+        if src_refs is None and self._source.blocks is not None:
+            # pre-built driver-resident blocks (e.g. from_arrow Table
+            # slices): ONE object-store put each, then they ride the
+            # refs path (a get-inside-a-source-task would copy each
+            # block through the store a second time)
+            src_refs = [ray_tpu.put(b) for b in self._source.blocks]
+
         while not self._stopped:
             # admission: source tasks under both budgets (bounded memory);
             # a satisfied limit quenches all upstream admission
@@ -594,7 +727,23 @@ class StreamingExecutor:
                    and len(src.inflight) < self._max_inflight
                    and live_blocks() < self._buffer_blocks
                    and live_bytes() < self._buffer_bytes):
-                ref = _source_task.remote(make_block, src.fn, next_block)
+                if src_refs is not None:
+                    in_ref = src_refs[next_block]
+                    if src.fn is None:
+                        # pre-materialized block, nothing to compute:
+                        # pass the ref straight through (a source task
+                        # here would copy the block a second time)
+                        src.submitted += 1
+                        src.completed += 1
+                        route_output(0, next_block, in_ref)
+                        next_block += 1
+                        continue
+                    # fused map over a materialized ref: the ref rides
+                    # as a TASK ARG (zero-copy resolve in the worker)
+                    ref = _map_task.remote(src.fn, in_ref)
+                else:
+                    ref = _source_task.remote(make_block, src.fn,
+                                              next_block)
                 src.inflight[ref] = (next_block, time.perf_counter(), 0)
                 src.submitted += 1
                 next_block += 1

@@ -26,7 +26,7 @@ class _LogicalOp:
 
     def __init__(self, kind: str, *, name: str = "", fn=None,
                  num_blocks: int = 0, make_block=None, items=None,
-                 blocks=None, limit: int = 0, compute=None,
+                 blocks=None, refs=None, limit: int = 0, compute=None,
                  parent: Optional["_LogicalOp"] = None):
         self.kind = kind
         self.name = name or kind
@@ -35,6 +35,7 @@ class _LogicalOp:
         self.make_block = make_block
         self.items = items           # driver-resident source ROWS
         self.blocks = blocks         # driver-resident source BLOCKS
+        self.refs = refs             # already-materialized block refs
         self.limit = limit
         self.compute = compute       # None = tasks | ActorPoolStrategy
         self.parent = parent
@@ -105,18 +106,27 @@ class Dataset:
                                   parent=self._op))
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        from ray_tpu.data import block as blk
+
         return self.map_batches(
-            lambda block, _f=fn: [_f(x) for x in block],
+            lambda block, _f=fn: [_f(x)
+                                  for x in blk.iter_block_rows(block)],
             name=getattr(fn, "__name__", "map"))
 
     def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        from ray_tpu.data import block as blk
+
         return self.map_batches(
-            lambda block, _f=fn: [x for x in block if _f(x)],
+            lambda block, _f=fn: [x for x in blk.iter_block_rows(block)
+                                  if _f(x)],
             name=f"filter({getattr(fn, '__name__', 'fn')})")
 
     def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
+        from ray_tpu.data import block as blk
+
         return self.map_batches(
-            lambda block, _f=fn: [y for x in block for y in _f(x)],
+            lambda block, _f=fn: [y for x in blk.iter_block_rows(block)
+                                  for y in _f(x)],
             name=f"flat_map({getattr(fn, '__name__', 'fn')})")
 
     def limit(self, n: int) -> "Dataset":
@@ -347,15 +357,13 @@ class MaterializedDataset:
 
 
 def _refs_source(refs, name: str) -> _LogicalOp:
-    """Source over already-materialized block refs (post-exchange)."""
-    import ray_tpu
-
-    def make_block(i: int, _refs=tuple(refs)):
-        return ray_tpu.get(_refs[i])
-
+    """Source over already-materialized block refs (post-exchange).
+    The executor passes these through DIRECTLY (or as _map_task args
+    when a map fuses in) — re-reading them inside a source task would
+    copy every block through the object store a second time."""
     return _LogicalOp("read", name=f"{name}_out",
                       num_blocks=max(1, len(refs)),
-                      make_block=make_block)
+                      refs=list(refs))
 
 
 # ----------------------------------------------------------------------
