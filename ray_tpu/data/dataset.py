@@ -260,8 +260,15 @@ class Dataset:
                 segments[-1].append(op)
         for seg, a2a in zip(segments[:-1], exchanges):
             ex = StreamingExecutor([source] + seg)
-            refs = list(ex.run_refs())
-            out_refs = all_to_all(refs, a2a)
+            # the exchange consumes the STREAM: partition/sample tasks
+            # launch per block as the upstream segment produces it (no
+            # driver-side materialize barrier). A limit truncates the
+            # stream, so only a limit-free segment can predict its
+            # block count (0 = the exchange counts the drained stream)
+            truncates = any(o.kind == "limit" for o in seg)
+            out_refs = all_to_all(
+                ex.run_refs(), a2a,
+                default_num_out=0 if truncates else source.num_blocks)
             source = _refs_source(out_refs, a2a.name)
         return source, StreamingExecutor([source] + segments[-1],
                                          row_limit=limit)
