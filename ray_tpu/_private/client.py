@@ -366,8 +366,10 @@ class ClientWorker:
         self.job_id = JobID.from_random()  # provisional ids only
         self.alive = True
         self.client_id = uuid.uuid4().hex
+        from ray_tpu._private.protocol import make_hello
+
         self._conn = _Connect((host, port), authkey=authkey)
-        self._conn.send(("hello", "client", self.client_id))
+        self._conn.send(make_hello("client", self.client_id))
         self._send_lock = threading.Lock()
         self._replies: Dict[int, Tuple[threading.Event, list]] = {}
         self._req_seq = 0
@@ -381,6 +383,9 @@ class ClientWorker:
         self._waiter_wake = threading.Event()
         self._waiter_thread: Optional[threading.Thread] = None
         ready = self._conn.recv()
+        if isinstance(ready, tuple) and ready[:1] == ("error",):
+            # e.g. protocol-version rejection: surface the head's reason
+            raise ConnectionError(str(ready[1]))
         if ready != ("ready",):
             raise ConnectionError("head did not acknowledge the client "
                                   f"session (got {ready!r})")

@@ -125,6 +125,15 @@ _d("gcs_journal_path", str, "",
    "write-ahead journal for GCS table mutations (reference: Redis "
    "persistence); a restarted head replays it and re-adopts rejoining "
    "node daemons. Empty = no persistence (head is a SPOF)")
+_d("gcs_journal_compact_every", int, 1000,
+   "appended ops between journal snapshot-compactions (the WAL is "
+   "rewritten as one snapshot record, so a long-lived head's journal "
+   "stays bounded by table size, not mutation count); 0 disables")
+_d("gcs_journal_fsync", bool, False,
+   "fsync the journal after every append: survives MACHINE crash, not "
+   "just process crash, at per-mutation disk-latency cost (the "
+   "reference's Redis tier makes the same durability trade via its "
+   "appendfsync policy)")
 _d("daemon_rejoin_timeout_s", float, 20.0,
    "how long an orphaned node daemon (head connection lost without an "
    "exit) retries reconnecting to the head address before giving up "

@@ -100,12 +100,54 @@ class GcsJournal:
                 except Exception:
                     return offset
 
-    def append(self, op: Tuple) -> None:
+    def append(self, op: Tuple, fsync: bool = False) -> None:
+        import os
         import pickle
 
         with self._wlock:
             pickle.dump(op, self._f)
             self._f.flush()
+            if fsync:
+                # machine-crash durability (the default flush survives
+                # only process death — the page cache can lose acked
+                # mutations when the HOST dies)
+                os.fsync(self._f.fileno())
+
+    def rewrite(self, ops: List[Tuple]) -> None:
+        """Snapshot-compaction: atomically replace the WAL with `ops`
+        (one snapshot record + nothing else), bounding the journal by
+        table size instead of lifetime mutation count (reference: the
+        Redis tier's RDB-style compaction of its AOF)."""
+        import os
+        import pickle
+
+        with self._wlock:
+            tmp = f"{self.path}.{os.getpid()}.compact"
+            with open(tmp, "wb") as f:
+                for op in ops:
+                    pickle.dump(op, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            # the rename itself must be durable, or a machine crash
+            # after compaction loses the WHOLE journal the per-append
+            # fsyncs promised to keep
+            dfd = os.open(os.path.dirname(os.path.abspath(self.path))
+                          or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+            self._f.close()
+            self._f = open(self.path, "ab")
+
+    def size_bytes(self) -> int:
+        import os
+
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
 
     @staticmethod
     def replay(path: str) -> List[Tuple]:
@@ -151,6 +193,7 @@ class GcsService:
         # table for exactly this (restart/recovery) purpose
         self._actor_recovery: Dict[ActorID, bytes] = {}
         self._journal = journal
+        self._ops_since_compact = 0
         if journal is not None:
             self._replay(GcsJournal.replay(journal.path))
         # object directory: primary-copy location of objects resident in
@@ -173,7 +216,27 @@ class GcsService:
         journaled — live daemons re-register themselves."""
         for op in ops:
             kind = op[0]
-            if kind == "actor":
+            if kind == "snapshot":
+                # compaction record: authoritative table state at the
+                # time of the rewrite; later ops apply on top
+                _, actors, kv = op
+                self._actors.clear()
+                self._actor_names.clear()
+                self._actor_recovery.clear()
+                self._kv.clear()
+                for abin, name, ns, class_name, recovery, state in actors:
+                    actor_id = ActorID(abin)
+                    entry = ActorEntry(actor_id, name, ns, class_name,
+                                       None)
+                    entry.state = "ORPHANED" if state == "ALIVE" else state
+                    self._actors[actor_id] = entry
+                    if name:
+                        self._actor_names[(ns, name)] = actor_id
+                    if recovery is not None:
+                        self._actor_recovery[actor_id] = recovery
+                for ns, k, v in kv:
+                    self._kv[(ns, k)] = v
+            elif kind == "actor":
                 _, abin, name, ns, class_name, recovery = op
                 actor_id = ActorID(abin)
                 entry = ActorEntry(actor_id, name, ns, class_name, None)
@@ -205,8 +268,28 @@ class GcsService:
                         len(ops), len(self._actors), len(self._kv))
 
     def _log(self, op: Tuple) -> None:
-        if self._journal is not None:
-            self._journal.append(op)
+        if self._journal is None:
+            return
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self._journal.append(op, fsync=GLOBAL_CONFIG.gcs_journal_fsync)
+        every = GLOBAL_CONFIG.gcs_journal_compact_every
+        self._ops_since_compact += 1
+        if every and self._ops_since_compact >= every:
+            self.compact_journal()
+
+    def compact_journal(self) -> None:
+        """Rewrite the WAL as one snapshot of the journaled tables."""
+        if self._journal is None:
+            return
+        with self._lock:
+            actors = [(a.actor_id.binary(), a.name, a.namespace,
+                       a.class_name, self._actor_recovery.get(a.actor_id),
+                       a.state)
+                      for a in self._actors.values()]
+            kv = [(ns, k, v) for (ns, k), v in self._kv.items()]
+        self._journal.rewrite([("snapshot", actors, kv)])
+        self._ops_since_compact = 0
 
     def actor_recovery_blob(self, actor_id: ActorID) -> Optional[bytes]:
         with self._lock:

@@ -1,0 +1,47 @@
+"""Wire-protocol versioning for every control-plane handshake.
+
+Role of the reference's protobuf IDL version discipline (ray:
+src/ray/protobuf/ — schema evolution gives version-skew safety): this
+runtime speaks framed pickled tuples, so skew safety comes from an
+explicit protocol version carried in EVERY hello — head registration
+(daemons, clients), intra-node worker attach, and the peer object
+plane. A listener that sees a different version (or a pre-versioned
+tuple) rejects the dial with a clear error instead of failing later on
+a shape mismatch deep inside a message handler.
+
+The version bumps whenever any framed-tuple message shape changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+PROTOCOL_VERSION = 2  # v1 was the unversioned round-3 wire
+
+
+def make_hello(*fields) -> tuple:
+    """A versioned hello: ("hello", PROTOCOL_VERSION, *fields)."""
+    return ("hello", PROTOCOL_VERSION) + fields
+
+
+def split_hello(hello) -> Tuple[Optional[int], tuple]:
+    """(version, fields) of a received hello.
+
+    Version is None for malformed or pre-versioned senders (their
+    first field is never an int)."""
+    if not (isinstance(hello, tuple) and hello and hello[0] == "hello"):
+        return None, ()
+    if len(hello) >= 2 and isinstance(hello[1], int) \
+            and not isinstance(hello[1], bool):
+        return hello[1], tuple(hello[2:])
+    return None, tuple(hello[1:])
+
+
+def mismatch_error(listener: str, version: Optional[int]) -> tuple:
+    """The rejection reply a listener sends before closing the dial."""
+    got = "an unversioned (pre-v2) hello" if version is None \
+        else f"protocol v{version}"
+    return ("error",
+            f"protocol version mismatch: {listener} speaks "
+            f"v{PROTOCOL_VERSION}, peer sent {got}; run the same "
+            "ray_tpu version on every node/client")

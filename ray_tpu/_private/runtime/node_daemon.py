@@ -149,14 +149,16 @@ class NodeDaemon:
         # token "join" = self-started daemon (ray_tpu start --address):
         # declared resources travel too and the head ADOPTS the node.
         # The peer transfer address rides at the tuple tail.
+        from ray_tpu._private.protocol import make_hello
+
         if node_token == "join":
-            self._head.send(("hello", "join", os.getpid(),
-                             self.store.arena.name, dict(join_info or {}),
-                             tuple(self.peer_address)))
+            self._head.send(make_hello(
+                "join", os.getpid(), self.store.arena.name,
+                dict(join_info or {}), tuple(self.peer_address)))
         else:
-            self._head.send(("hello", node_token, os.getpid(),
-                             self.store.arena.name,
-                             tuple(self.peer_address)))
+            self._head.send(make_hello(
+                node_token, os.getpid(), self.store.arena.name,
+                tuple(self.peer_address)))
 
     # ------------------------------------------------------------------
     def _send_head(self, msg: tuple) -> None:
@@ -210,11 +212,20 @@ class NodeDaemon:
             except (EOFError, OSError):
                 conn.close()
                 continue
-            if not (isinstance(hello, tuple) and len(hello) == 3
-                    and hello[0] == "hello"):
+            from ray_tpu._private import protocol
+
+            ver, fields = protocol.split_hello(hello)
+            if len(fields) != 2:
                 conn.close()
                 continue
-            _, num, kind = hello
+            if ver != protocol.PROTOCOL_VERSION:
+                try:
+                    conn.send(protocol.mismatch_error("node daemon", ver))
+                except (OSError, ValueError):
+                    pass
+                conn.close()
+                continue
+            num, kind = fields
             with self._lock:
                 slot = self._slots.get(num)
             if slot is None:
@@ -321,9 +332,27 @@ class NodeDaemon:
                              name="ray_tpu_node_peer_serve").start()
 
     def _peer_serve(self, conn) -> None:
-        """One persistent connection per consuming peer: serve get
-        requests out of the local arena/spill tier."""
+        """One persistent connection per consuming peer: a versioned
+        hello first, then get requests served out of the local
+        arena/spill tier."""
+        from ray_tpu._private import protocol
+
         try:
+            try:
+                hello = conn.recv()
+            except (EOFError, OSError):
+                return
+            ver, _fields = protocol.split_hello(hello)
+            if ver != protocol.PROTOCOL_VERSION:
+                try:
+                    conn.send(protocol.mismatch_error("peer plane", ver))
+                except (OSError, ValueError):
+                    pass
+                return
+            try:
+                conn.send(("ok",))
+            except (OSError, ValueError):
+                return
             while not self._shutdown:
                 try:
                     msg = conn.recv()
@@ -362,12 +391,23 @@ class NodeDaemon:
             if entry is None:
                 entry = [None, threading.Lock()]
                 self._peer_conns[address] = entry
+        from ray_tpu._private import protocol
+
         for _attempt in (0, 1):
             with entry[1]:
                 try:
                     if entry[0] is None:
-                        entry[0] = Client(address,
-                                          authkey=self._peer_authkey)
+                        c = Client(address, authkey=self._peer_authkey)
+                        c.send(protocol.make_hello("peer"))
+                        ack = c.recv()
+                        if ack != ("ok",):
+                            # version rejection: log the peer's reason
+                            import logging
+                            logging.getLogger(__name__).error(
+                                "peer %s rejected us: %s", address, ack)
+                            c.close()
+                            return None
+                        entry[0] = c
                     conn = entry[0]
                     conn.send(("get", oid_bin))
                     if not conn.poll(timeout):
@@ -445,6 +485,13 @@ class NodeDaemon:
                     continue
                 break  # no head came back: the node dies
             kind = msg[0]
+            if kind == "error":
+                # e.g. protocol-version rejection of our hello: the
+                # head told us WHY — log it and die instead of retrying
+                import logging
+                logging.getLogger(__name__).error(
+                    "head rejected this node: %s", msg[1])
+                break
             if kind == "spawn":
                 self._spawn(msg[1])
             elif kind == "to_w":
@@ -544,10 +591,13 @@ class NodeDaemon:
                                       if s.actor_bin else None)}
                     for s in self._slots.values()
                     if s.proc is not None and s.proc.poll() is None}
+            from ray_tpu._private.protocol import make_hello
+
             try:
-                head.send(("hello", "rejoin", os.getpid(),
-                           self.store.arena.name, dict(self._node_info),
-                           tuple(self.peer_address), workers))
+                head.send(make_hello(
+                    "rejoin", os.getpid(), self.store.arena.name,
+                    dict(self._node_info), tuple(self.peer_address),
+                    workers))
             except (OSError, ValueError):
                 try:
                     head.close()

@@ -247,10 +247,20 @@ class ProcessWorkerPool:
             except (EOFError, OSError):
                 conn.close()
                 continue
-            if not (isinstance(hello, tuple) and hello[0] == "hello"):
+            from ray_tpu._private import protocol
+
+            ver, fields = protocol.split_hello(hello)
+            if len(fields) != 2:
                 conn.close()
                 continue
-            _, num, kind = hello
+            if ver != protocol.PROTOCOL_VERSION:
+                try:
+                    conn.send(protocol.mismatch_error("worker pool", ver))
+                except (OSError, ValueError):
+                    pass
+                conn.close()
+                continue
+            num, kind = fields
             with self._lock:
                 h = self._by_num.get(num)
             if h is None or h.dead:

@@ -514,10 +514,23 @@ class HeadServer:
             except (EOFError, OSError):
                 conn.close()
                 continue
-            if not (isinstance(hello, tuple) and len(hello) >= 2
-                    and hello[0] == "hello"):
+            from ray_tpu._private import protocol
+
+            ver, fields = protocol.split_hello(hello)
+            if not fields:
                 conn.close()
                 continue
+            if ver != protocol.PROTOCOL_VERSION:
+                # version skew: reject LOUDLY so the dialer sees why,
+                # instead of dying later on a message-shape mismatch
+                try:
+                    conn.send(protocol.mismatch_error("head", ver))
+                except (OSError, ValueError):
+                    pass
+                conn.close()
+                continue
+            # downstream parsers see the unversioned layout
+            hello = ("hello",) + fields
             token = hello[1]
             with self._lock:
                 slot = self._pending.pop(token, None)
