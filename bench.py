@@ -32,18 +32,19 @@ _E2E_CHILD = """
 import json, os, sys
 sys.path.insert(0, {repo!r})
 from ray_tpu._private import perf
-r = perf.e2e_task_throughput(n_tasks={n}, mode={mode!r}, scheduler="tensor")
+r = perf.e2e_task_throughput(n_tasks={n}, mode={mode!r}, scheduler="tensor",
+                             batched={batched}, best_of=3)
 print("E2E_JSON:" + json.dumps(r))
 """
 
 
-def _e2e_subprocess(n: int, mode: str) -> dict:
+def _e2e_subprocess(n: int, mode: str, batched: bool = False) -> dict:
     """Run one e2e measurement in a fresh interpreter (no jax/XLA heap
     from the device sections; CPU platform — the task path touches no
     accelerator)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     repo = os.path.dirname(os.path.abspath(__file__))
-    code = _E2E_CHILD.format(repo=repo, n=n, mode=mode)
+    code = _E2E_CHILD.format(repo=repo, n=n, mode=mode, batched=batched)
     out = subprocess.run([sys.executable, "-c", code], env=env,
                          capture_output=True, text=True, timeout=900)
     for line in out.stdout.splitlines():
@@ -95,22 +96,26 @@ def main() -> int:
     budgets = {}
     n_thread = 2_000 if smoke else 50_000
     n_proc = 500 if smoke else 20_000
-    for mode, n in (("thread", n_thread), ("process", n_proc)):
+    for label, mode, n, batched in (
+            ("thread", "thread", n_thread, False),
+            ("thread_batched", "thread", n_thread, True),
+            ("process", "process", n_proc, False),
+            ("process_batched", "process", n_proc, True)):
         try:
             # FRESH subprocess per mode: the north-star sections leave a
             # jax/XLA heap and device state behind, which costs the
             # in-process e2e measurement ~25% on small hosts
-            r = _e2e_subprocess(n, mode)
-            e2e[mode] = round(r["tasks_per_sec"], 1)
-            budgets[mode] = dict(r["budget_us"],
-                                 tasks_per_tick=r["tasks_per_tick"])
-            print(f"  e2e[{mode}]: {r['tasks_per_sec']:.0f} tasks/s "
+            r = _e2e_subprocess(n, mode, batched)
+            e2e[label] = round(r["tasks_per_sec"], 1)
+            budgets[label] = dict(r["budget_us"],
+                                  tasks_per_tick=r["tasks_per_tick"])
+            print(f"  e2e[{label}]: {r['tasks_per_sec']:.0f} tasks/s "
                   f"({n} tasks in {r['seconds']:.2f}s; "
                   f"budget {r['budget_us']} us/task, "
                   f"{r['tasks_per_tick']} tasks/tick)", file=sys.stderr)
         except Exception:
             traceback.print_exc()
-            e2e[mode] = None
+            e2e[label] = None
     out["e2e_tasks_per_sec"] = e2e
     out["e2e_budget_us"] = budgets
 

@@ -33,6 +33,13 @@ class EventBuffer:
         self._buf.append((time.perf_counter(), task_id, name,
                           event, node))
 
+    def record_batch(self, id_names, event: str, node: int = -1) -> None:
+        """One timestamp + one extend for a whole submit batch;
+        ``id_names`` yields (task_id, task_name) pairs."""
+        now = time.perf_counter()
+        self._buf.extend((now, tid, name, event, node)
+                         for tid, name in id_names)
+
     def snapshot(self) -> List[tuple]:
         return [(ts, tid if isinstance(tid, str) else tid.hex(),
                  name, event, node)

@@ -145,5 +145,11 @@ class ObjectRecoveryManager:
         return True
 
     def recover_all(self, object_ids: List[ObjectID]) -> None:
-        for oid in object_ids:
+        """Bulk entry (the get() path): ids whose producer is still
+        PENDING are the overwhelmingly common case (get right after
+        submit) and need no recovery — filter them under ONE
+        task-manager lock hold instead of walking the full per-object
+        recovery probe for each."""
+        for oid in self._worker.task_manager.filter_not_pending(
+                object_ids):
             self.maybe_recover(oid)

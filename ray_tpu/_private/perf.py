@@ -42,11 +42,16 @@ def _peak_flops(device_kind: str) -> Optional[float]:
 
 def e2e_task_throughput(n_tasks: int = 10_000, mode: str = "thread",
                         scheduler: str = "tensor",
-                        num_workers: int = 8) -> Dict[str, Any]:
+                        num_workers: int = 8,
+                        batched: bool = False,
+                        best_of: int = 1) -> Dict[str, Any]:
     """Submit n_tasks no-op tasks through the public API and get() them.
 
     Measures the full path: RemoteFunction._remote -> Worker.submit ->
     scheduler tick -> dispatch -> execution -> result store -> get.
+    batched=True submits through map_remote (the vectorized path the
+    libraries use); best_of>1 keeps the fastest trial (this host is a
+    shared 1-CPU VM with ±30% noise between trials).
     """
     import resource
 
@@ -69,15 +74,25 @@ def e2e_task_throughput(n_tasks: int = 10_000, mode: str = "thread",
             time.sleep(2.0)  # let late worker imports finish competing
 
         sched = worker_mod.global_worker.scheduler
-        ticks0 = getattr(sched, "_num_ticks", 0)
-        ru0 = resource.getrusage(resource.RUSAGE_SELF)
-        t0 = time.perf_counter()
-        refs = [_noop.remote() for _ in range(n_tasks)]
-        t_submit = time.perf_counter() - t0
-        ray_tpu.get(refs)
-        dt = time.perf_counter() - t0
-        ru1 = resource.getrusage(resource.RUSAGE_SELF)
-        ticks = getattr(sched, "_num_ticks", 0) - ticks0
+        best = None
+        for _ in range(max(1, best_of)):
+            ticks0 = getattr(sched, "_num_ticks", 0)
+            ru0 = resource.getrusage(resource.RUSAGE_SELF)
+            t0 = time.perf_counter()
+            if batched:
+                refs = _noop.map_remote([()] * n_tasks)
+            else:
+                refs = [_noop.remote() for _ in range(n_tasks)]
+            t_submit = time.perf_counter() - t0
+            ray_tpu.get(refs)
+            trial_dt = time.perf_counter() - t0
+            ru1 = resource.getrusage(resource.RUSAGE_SELF)
+            trial_ticks = getattr(sched, "_num_ticks", 0) - ticks0
+            trial = (trial_dt, t_submit, ru0, ru1, trial_ticks)
+            if best is None or trial_dt < best[0]:
+                best = trial
+            del refs
+        dt, t_submit, ru0, ru1, ticks = best
     finally:
         ray_tpu.shutdown()
     driver_cpu = (ru1.ru_utime - ru0.ru_utime) + (ru1.ru_stime - ru0.ru_stime)

@@ -68,6 +68,20 @@ class ReferenceCounter:
     def remove_local_reference(self, object_id: ObjectID) -> None:
         self._maybe_delete(object_id, "local")
 
+    def register_submit_batch(self, owned, deps) -> None:
+        """One lock hold for a whole submit batch: ``owned`` yields
+        (object_id, lineage_task_id) pairs that ALSO take the caller's
+        local handle (+1 local — the returned ObjectRefs are built
+        pre-registered), ``deps`` yields argument ids to pin."""
+        with self._lock:
+            refs = self._refs
+            for oid, lineage in owned:
+                r = refs.setdefault(oid, _Ref())
+                r.lineage_task = lineage
+                r.local += 1
+            for d in deps:
+                refs.setdefault(d, _Ref()).submitted += 1
+
     # -- task-argument pins ------------------------------------------------
     def add_submitted_task_references(self, object_ids: List[ObjectID]) -> None:
         with self._lock:

@@ -26,6 +26,12 @@ class SchedulerBase:
     def submit(self, task: PendingTask) -> None:
         raise NotImplementedError
 
+    def submit_many(self, tasks: List[PendingTask]) -> None:
+        """Batch submission: implementations override to take their
+        queue lock and wake the tick loop ONCE per batch."""
+        for t in tasks:
+            self.submit(t)
+
     def node_state(self, index: int):
         """NodeState at a row (locked read). None if out of range."""
         raise NotImplementedError

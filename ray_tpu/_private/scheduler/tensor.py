@@ -142,6 +142,16 @@ class TensorScheduler(SchedulerBase):
             if self._sleeping:
                 self._wake.notify()
 
+    def submit_many(self, tasks: List[PendingTask]) -> None:
+        """One lock acquire + one wakeup for the whole batch (the
+        per-submit lock/notify pair is most of submit()'s cost once
+        callers batch)."""
+        with self._wake:
+            self._submit_q.extend(tasks)
+            self._num_submitted += len(tasks)
+            if self._sleeping:
+                self._wake.notify()
+
     def notify_object_ready(self, object_id: ObjectID) -> None:
         with self._wake:
             self._ready_obj_q.append(object_id)

@@ -18,6 +18,7 @@ import collections
 import logging
 import os
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -75,6 +76,27 @@ class TaskManager:
     def add_pending(self, spec: TaskSpec, deps: List[ObjectID]) -> None:
         with self._lock:
             self._pending[spec.task_id] = (spec, deps)
+
+    def add_pending_batch(self, specs: List[TaskSpec]) -> None:
+        """One lock hold; deps must already be memoized on each spec."""
+        with self._lock:
+            pending = self._pending
+            for spec in specs:
+                pending[spec.task_id] = (spec, spec._deps_memo)
+
+    def filter_not_pending(self, object_ids: List[ObjectID]) -> List[ObjectID]:
+        """Ids whose producing task is NOT in flight (one lock hold) —
+        the recovery path's bulk pre-filter."""
+        with self._lock:
+            pending = self._pending
+            origin = self._pending_origin
+            out = []
+            for oid in object_ids:
+                tid = oid.task_id()
+                if tid in pending or origin.get(tid) in pending:
+                    continue
+                out.append(oid)
+            return out
 
     def rekey_pending(self, old_id: TaskID, spec: TaskSpec,
                       deps: List[ObjectID]) -> None:
@@ -188,6 +210,7 @@ class _WorkQueue:
     skipped whenever no thread is parked (under load none are)."""
 
     def __init__(self, nworkers: int, name: str = "ray_tpu_worker"):
+        self.num_threads = nworkers
         self._cv = threading.Condition()
         self._q: collections.deque = collections.deque()
         self._idle = 0
@@ -657,6 +680,50 @@ class Worker:
         self.scheduler.submit(pending)
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
 
+    def submit_task_batch(self, specs: List[TaskSpec]) -> List[List[ObjectRef]]:
+        """Vectorized submit: per-task work hoisted to per-batch — one
+        refcount lock hold, one task-manager lock hold, one scheduler
+        wakeup (reference: the lease-amortization idea of SURVEY §3.2's
+        hot-loops note, applied to the submit side). Per-task return
+        value shape matches submit_task."""
+        store_contains = self.memory_store.contains
+        owned: List[tuple] = []
+        all_deps: List[ObjectID] = []
+        for spec in specs:
+            # env packaging does GCS I/O — never under a refcount lock
+            if spec.runtime_env and "working_dir" in spec.runtime_env:
+                spec.runtime_env = self.prepare_runtime_env(
+                    spec.runtime_env)
+            for oid in spec.return_ids():
+                owned.append((oid, spec.task_id))
+            deps = (_top_level_deps(spec.args, spec.kwargs)
+                    if (spec.args or spec.kwargs) else [])
+            spec._deps_memo = deps
+            all_deps.extend(deps)
+        self.reference_counter.register_submit_batch(owned, all_deps)
+        self.task_manager.add_pending_batch(specs)
+        self.events.record_batch(((s.task_id, s.name) for s in specs),
+                                 "submitted")
+        pendings: List[PendingTask] = []
+        out: List[List[ObjectRef]] = []
+        for spec in specs:
+            unresolved = []
+            for d in spec._deps_memo:
+                if store_contains(d):
+                    continue
+                unresolved.append(d)
+                self.object_recovery.maybe_recover(d)
+            pendings.append(PendingTask(spec=spec, deps=unresolved,
+                                        execute=_noop_exec))
+            refs = []
+            for oid in spec.return_ids():
+                ref = ObjectRef(oid, self.worker_id, _register=False)
+                ref._weak = False  # counted in register_submit_batch
+                refs.append(ref)
+            out.append(refs)
+        self.scheduler.submit_many(pendings)
+        return out
+
     def cancel_task(self, ref: ObjectRef, force: bool = False) -> None:
         task_id = ref.task_id()
         if self.scheduler.cancel(task_id):
@@ -745,7 +812,11 @@ class Worker:
                 groups.setdefault(pool, []).append(pending)
             elif pool is None:
                 # host-thread execution: queue the whole tick's grants
-                # in one executor lock acquisition
+                # in one executor lock acquisition. One queue ITEM per
+                # task — pre-chunking per thread would lose work
+                # stealing and let a blocking task head-of-line its
+                # chunk (worst case: deadlock a producer queued behind
+                # its own consumer)
                 record(spec.task_id, spec.name, "dispatched",
                        pending.node_index)
                 local.append((self._execute_task, (pending,)))
@@ -1234,6 +1305,8 @@ class Worker:
         lists LOST deps now under lineage reconstruction — the caller
         re-queues the task to wait for them instead of blocking an
         executor thread (which the reconstruction itself may need)."""
+        if not spec.args and not spec.kwargs:
+            return (), {}, None, None
         dep_error = None
         requeue_deps: List[ObjectID] = []
 

@@ -861,33 +861,41 @@ class StreamingExecutor:
 
         while not self._stopped:
             # admission: source tasks under both budgets (bounded memory);
-            # a satisfied limit quenches all upstream admission
+            # a satisfied limit quenches all upstream admission.
+            # Admissible blocks collect first and submit as ONE batch
+            # (map_remote) — per-task submit bookkeeping is the
+            # dominant cost of small-block pipelines
             src = stages[0]
+            admit: List[int] = []
             while (not self._quenched
                    and next_block < num_blocks
-                   and len(src.inflight) < self._max_inflight
-                   and live_blocks() < self._buffer_blocks
+                   and len(src.inflight) + len(admit) < self._max_inflight
+                   and live_blocks() + len(admit) < self._buffer_blocks
                    and live_bytes() < self._buffer_bytes):
-                if src_refs is not None:
-                    in_ref = src_refs[next_block]
-                    if src.fn is None:
-                        # pre-materialized block, nothing to compute:
-                        # pass the ref straight through (a source task
-                        # here would copy the block a second time)
-                        src.submitted += 1
-                        src.completed += 1
-                        route_output(0, next_block, in_ref)
-                        next_block += 1
-                        continue
-                    # fused map over a materialized ref: the ref rides
-                    # as a TASK ARG (zero-copy resolve in the worker)
-                    ref = _map_task.remote(src.fn, in_ref)
-                else:
-                    ref = _source_task.remote(make_block, src.fn,
-                                              next_block)
-                src.inflight[ref] = (next_block, time.perf_counter(), 0)
-                src.submitted += 1
+                if src_refs is not None and src.fn is None:
+                    # pre-materialized block, nothing to compute:
+                    # pass the ref straight through (a source task
+                    # here would copy the block a second time)
+                    src.submitted += 1
+                    src.completed += 1
+                    route_output(0, next_block, src_refs[next_block])
+                    next_block += 1
+                    continue
+                admit.append(next_block)
                 next_block += 1
+            if admit:
+                now = time.perf_counter()
+                if src_refs is not None:
+                    # fused map over materialized refs: refs ride as
+                    # TASK ARGS (zero-copy resolve in the worker)
+                    refs = _map_task.map_remote(
+                        [(src.fn, src_refs[i]) for i in admit])
+                else:
+                    refs = _source_task.map_remote(
+                        [(make_block, src.fn, i) for i in admit])
+                for i, ref in zip(admit, refs):
+                    src.inflight[ref] = (i, now, 0)
+                src.submitted += len(admit)
 
             # downstream stages: feed from their input queues
             for pos, stage in enumerate(stages):
@@ -902,7 +910,9 @@ class StreamingExecutor:
                     continue
                 quenched_upstream = self._quenched and any(
                     s.kind == "limit" for s in stages[pos:])
-                while stage.inputs and len(stage.inflight) < \
+                feed: List[Tuple[int, Any]] = []
+                while stage.inputs and \
+                        len(stage.inflight) + len(feed) < \
                         self._max_inflight:
                     idx, in_ref = stage.inputs.popleft()
                     sizes.pop(in_ref, None)  # consumed: stop pinning
@@ -914,10 +924,16 @@ class StreamingExecutor:
                         stage.actor_load[a] += 1
                         ref = stage.actors[a].apply.remote(in_ref)
                         stage.inflight[ref] = (idx, time.perf_counter(), a)
+                        stage.submitted += 1
                     else:
-                        ref = _map_task.remote(stage.fn, in_ref)
-                        stage.inflight[ref] = (idx, time.perf_counter(), 0)
-                    stage.submitted += 1
+                        feed.append((idx, in_ref))
+                if feed:
+                    now = time.perf_counter()
+                    refs = _map_task.map_remote(
+                        [(stage.fn, r) for _i, r in feed])
+                    for (idx, _r), ref in zip(feed, refs):
+                        stage.inflight[ref] = (idx, now, 0)
+                    stage.submitted += len(feed)
 
             # emit in order
             while next_emit in emit_buf:
