@@ -77,8 +77,16 @@ def main() -> int:
     # group is skipped rather than aborting the whole benchmark.
     g = (benchmarks.build_north_star(10_000, 8) if smoke
          else benchmarks.build_north_star())
+    if not smoke:
+        try:
+            # discarded warm-up group: the first group after device
+            # bring-up has run 3-25x slow on cold tunnel state (r03
+            # recorded 0.449 ms for code that measures 0.175 ms warm)
+            benchmarks.run_graph(g, repeats=3)
+        except RuntimeError:
+            pass
     groups = []
-    for _ in range(1 if smoke else 3):
+    for _ in range(1 if smoke else 5):
         try:
             groups.append(benchmarks.run_graph(g, repeats=5))
         except RuntimeError:
@@ -135,6 +143,31 @@ def main() -> int:
     except Exception:
         traceback.print_exc()
         out["data_pipeline"] = None
+
+    # --- RLlib: IMPALA async rollout throughput ------------------------
+    try:
+        code = (
+            "import json, sys\n"
+            f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+            "from ray_tpu._private import perf\n"
+            f"r = perf.rl_rollout_throughput(iters={1 if smoke else 4})\n"
+            "print('RL_JSON:' + json.dumps(r))\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=900)
+        r = None
+        for line in p.stdout.splitlines():
+            if line.startswith("RL_JSON:"):
+                r = json.loads(line[len("RL_JSON:"):])
+        if r is None:
+            raise RuntimeError(f"rl child failed: {p.stderr[-1500:]}")
+        out["rl_rollout"] = r
+        print(f"  rl rollout: {r['env_steps_per_sec']:.0f} env-steps/s "
+              f"(IMPALA, return {r['episode_return_mean']})",
+              file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
+        out["rl_rollout"] = None
 
     # --- Data library: Arrow columnar MB/s -----------------------------
     try:

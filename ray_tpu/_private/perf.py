@@ -113,6 +113,42 @@ def e2e_task_throughput(n_tasks: int = 10_000, mode: str = "thread",
     }
 
 
+def rl_rollout_throughput(iters: int = 4) -> Dict[str, Any]:
+    """IMPALA's async pipeline under load: env-steps/s streamed from
+    runner actors through the object store into the V-trace learner
+    (VERDICT r3 #3's 'rollout-throughput line'). Run with
+    JAX_PLATFORMS=cpu — the policy is a toy MLP and stepping is host
+    work; a tunneled accelerator would measure RTT, not the pipeline."""
+    import ray_tpu
+    from ray_tpu.rllib import IMPALAConfig
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=8, scheduler="tensor")
+    try:
+        algo = IMPALAConfig(num_env_runners=4, num_envs_per_runner=8,
+                            rollout_len=64, updates_per_iter=8,
+                            seed=0).build()
+        algo.train()  # warm the jits + pipeline
+        steps = 0
+        secs = 0.0
+        returns = []
+        for _ in range(iters):
+            m = algo.train()
+            steps += m["num_env_steps"]
+            secs += m["num_env_steps"] / m["env_steps_per_sec"]
+            if m["num_episodes"]:
+                returns.append(m["episode_return_mean"])
+        algo.stop()
+    finally:
+        ray_tpu.shutdown()
+    return {
+        "env_steps_per_sec": round(steps / max(secs, 1e-9), 1),
+        "env_steps": steps,
+        "episode_return_mean": (round(sum(returns) / len(returns), 1)
+                                if returns else None),
+    }
+
+
 def data_pipeline_throughput(num_blocks: int = 100_000,
                              rows_per_block: int = 10,
                              num_workers: int = 8) -> Dict[str, Any]:

@@ -13,6 +13,8 @@ group; scaling the learner is a sharding annotation, not more actors.
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.env import CartPoleEnv  # noqa: F401
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 
-__all__ = ["PPOConfig", "PPO", "DQNConfig", "DQN", "CartPoleEnv"]
+__all__ = ["PPOConfig", "PPO", "DQNConfig", "DQN", "IMPALAConfig",
+           "IMPALA", "CartPoleEnv"]

@@ -1,0 +1,235 @@
+"""IMPALA — asynchronous env-runners streaming into a V-trace learner.
+
+Reference: ray: rllib/algorithms/impala/ (IMPALA/IMPALAConfig, the
+async EnvRunner -> Learner pipeline) and the V-trace off-policy
+correction of Espeholt et al. 2018. Semantics kept: runners sample
+CONTINUOUSLY with whatever params they last received — the learner
+consumes completed rollouts as they arrive (never waiting for a full
+fan-in) and hands the freshest params only to the runner it just
+drained. Staleness is bounded by the pipeline depth (one outstanding
+rollout per runner), and V-trace importance weights correct for it.
+
+TPU-first differences from the reference: the learner is ONE jitted
+program — V-trace itself runs on device as a `jax.lax.scan` (the
+reference computes corrections in torch on the learner host), so the
+whole update (correction + policy gradient + value + entropy) is a
+single XLA executable; scaling the learner is a sharding annotation,
+not a learner-group of processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import exceptions as rex
+from ray_tpu.rllib.ppo import _EnvRunner, _policy_apply, _policy_init
+
+
+def _make_update(lr: float, gamma: float, vf_coeff: float,
+                 ent_coeff: float, max_grad_norm: float,
+                 rho_bar: float, c_bar: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.chain(optax.clip_by_global_norm(max_grad_norm),
+                            optax.rmsprop(lr, decay=0.99, eps=1e-5))
+
+    def vtrace(behavior_logp, target_logp, values, last_value,
+               rewards, dones):
+        """V-trace targets + policy-gradient advantages, [T, B] in,
+        computed as one reverse lax.scan on device."""
+        rhos = jnp.exp(target_logp - behavior_logp)
+        clipped_rho = jnp.minimum(rhos, rho_bar)
+        cs = jnp.minimum(rhos, c_bar)
+        next_values = jnp.concatenate([values[1:], last_value[None]], 0)
+        discounts = gamma * (1.0 - dones)
+        deltas = clipped_rho * (rewards + discounts * next_values
+                                - values)
+
+        def step(acc, x):
+            delta, disc, c = x
+            acc = delta + disc * c * acc
+            return acc, acc
+
+        _, dvs = jax.lax.scan(step, jnp.zeros_like(last_value),
+                              (deltas, discounts, cs), reverse=True)
+        vs = values + dvs
+        vs_next = jnp.concatenate([vs[1:], last_value[None]], 0)
+        pg_adv = clipped_rho * (rewards + discounts * vs_next - values)
+        return vs, pg_adv
+
+    def loss_fn(params, obs, actions, behavior_logp, rewards, dones,
+                last_obs):
+        T, B = actions.shape
+        logits, values = _policy_apply(params, obs)  # [T, B, A], [T, B]
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, actions[..., None], axis=-1)[..., 0]
+        _, last_value = _policy_apply(params, last_obs)  # [B]
+        vs, pg_adv = vtrace(behavior_logp,
+                            jax.lax.stop_gradient(target_logp),
+                            jax.lax.stop_gradient(values),
+                            jax.lax.stop_gradient(last_value),
+                            rewards, dones)
+        pi_loss = -(jax.lax.stop_gradient(pg_adv) * target_logp).mean()
+        vf_loss = jnp.square(values - jax.lax.stop_gradient(vs)).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return total, (pi_loss, vf_loss, entropy)
+
+    @jax.jit
+    def update(params, opt_state, obs, actions, behavior_logp,
+               rewards, dones, last_obs):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, obs, actions, behavior_logp, rewards, dones,
+            last_obs)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, aux
+
+    return optimizer, update
+
+
+@dataclasses.dataclass
+class IMPALAConfig:
+    env_maker: Any = None            # seed -> env (default CartPole)
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_len: int = 64
+    hidden: int = 32
+    lr: float = 5e-3
+    gamma: float = 0.99
+    vf_coeff: float = 0.5
+    ent_coeff: float = 0.01
+    max_grad_norm: float = 40.0
+    rho_bar: float = 1.0             # V-trace rho clip
+    c_bar: float = 1.0               # V-trace c clip
+    updates_per_iter: int = 8        # rollouts consumed per train()
+    sample_timeout_s: float = 120.0
+    seed: int = 0
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALA:
+    """Async actor-learner: the learner drains whichever runner
+    finishes first, updates, and re-arms ONLY that runner with fresh
+    params — the others keep sampling with params at most one pipeline
+    slot stale (bounded staleness, corrected by V-trace)."""
+
+    def __init__(self, config: IMPALAConfig):
+        import jax
+
+        self.config = config
+        if config.env_maker is not None:
+            self._env_maker = config.env_maker
+        else:
+            from ray_tpu.rllib.env import CartPoleEnv
+
+            self._env_maker = lambda seed: CartPoleEnv(seed)
+        env = self._env_maker(0)
+        self._obs_dim = env.observation_dim
+        self._num_actions = env.num_actions
+        self.params = _policy_init(jax.random.PRNGKey(config.seed),
+                                   self._obs_dim, self._num_actions,
+                                   config.hidden)
+        self._optimizer, self._update = _make_update(
+            config.lr, config.gamma, config.vf_coeff, config.ent_coeff,
+            config.max_grad_norm, config.rho_bar, config.c_bar)
+        self.opt_state = self._optimizer.init(self.params)
+        self.iteration = 0
+        from ray_tpu.rllib.runner_group import RunnerGroup
+
+        cfg = config
+        self._group = RunnerGroup(
+            _EnvRunner,
+            lambda seed: (self._env_maker, cfg.num_envs_per_runner,
+                          cfg.rollout_len, seed),
+            cfg.num_env_runners, cfg.seed)
+        self._params_ref = ray_tpu.put(self.params)
+        # prime the pipeline: one outstanding rollout per runner
+        self._inflight: Dict[Any, int] = {}
+        for i in range(cfg.num_env_runners):
+            self._arm(i)
+
+    # -- async plumbing -------------------------------------------------
+    def _arm(self, i: int) -> None:
+        """One outstanding sample on runner i with the CURRENT params."""
+        try:
+            ref = self._group.runners[i].sample.remote(self._params_ref)
+        except rex.ActorError:
+            self._group.respawn(i)
+            ref = self._group.runners[i].sample.remote(self._params_ref)
+        self._inflight[ref] = i
+
+    def _next_batch(self):
+        """The first completed rollout from ANY runner; a dead runner
+        respawns and re-arms without stalling the learner."""
+        deadline = time.monotonic() + self.config.sample_timeout_s
+        while True:
+            if not self._inflight:
+                raise rex.RayTpuError("no env runners in flight")
+            timeout = max(0.1, deadline - time.monotonic())
+            ready, _ = ray_tpu.wait(list(self._inflight),
+                                    num_returns=1, timeout=timeout)
+            if not ready:
+                raise rex.RayTpuError(
+                    "no rollout arrived within sample_timeout_s")
+            ref = ready[0]
+            i = self._inflight.pop(ref)
+            try:
+                return ray_tpu.get(ref), i
+            except rex.ActorError:
+                self._group.respawn(i)
+                self._arm(i)
+
+    # -- training -------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        """One iteration: consume updates_per_iter rollouts as they
+        stream in; each consumption re-arms ONLY its producer."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        losses: List[float] = []
+        ep_returns: List[float] = []
+        env_steps = 0
+        t0 = time.perf_counter()
+        for _ in range(cfg.updates_per_iter):
+            batch, i = self._next_batch()
+            self.params, self.opt_state, loss, _aux = self._update(
+                self.params, self.opt_state,
+                jnp.asarray(batch["obs"]),
+                jnp.asarray(batch["actions"]),
+                jnp.asarray(batch["logp"]),
+                jnp.asarray(batch["rewards"]),
+                jnp.asarray(batch["dones"]),
+                jnp.asarray(batch["last_obs"]))
+            losses.append(float(loss))
+            ep_returns.extend(batch["episode_returns"])
+            env_steps += batch["actions"].size
+            # freshest params go to the runner just drained; the rest
+            # keep streaming with their (bounded-stale) copy
+            self._params_ref = ray_tpu.put(self.params)
+            self._arm(i)
+        dt = time.perf_counter() - t0
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (float(np.mean(ep_returns))
+                                    if ep_returns else float("nan")),
+            "num_episodes": len(ep_returns),
+            "num_env_steps": env_steps,
+            "env_steps_per_sec": env_steps / max(dt, 1e-9),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+        }
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        self._group.stop()

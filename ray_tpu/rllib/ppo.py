@@ -112,6 +112,9 @@ class _EnvRunner:
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
             "last_values": np.asarray(last_val),
+            # the observation AFTER the rollout: off-policy learners
+            # (IMPALA) bootstrap it under the TARGET params
+            "last_obs": np.copy(self.obs),
             "episode_returns": list(self.episode_returns),
         }
 
