@@ -62,10 +62,14 @@ from ray_tpu._private.ids import ObjectID
 
 class _WorkerSlot:
     __slots__ = ("num", "proc", "conn", "ctrl", "pid", "returns", "gets",
-                 "actor_bin")
+                 "actor_bin", "send_lock")
 
     def __init__(self, num: int):
         self.num = num
+        # serializes writes to conn: the run loop and deferred
+        # peer-pull reply threads both send here, and interleaved
+        # Connection frames corrupt the worker's stream
+        self.send_lock = threading.Lock()
         self.proc: Optional[subprocess.Popen] = None
         self.conn = None
         self.ctrl = None
@@ -73,12 +77,100 @@ class _WorkerSlot:
         # task_id binary -> [return oid binaries] for in-flight payloads,
         # so sealed shm returns can be rewritten on "done"
         self.returns: Dict[bytes, list] = {}
-        # req_ids of get RPCs forwarded to the head, whose replies may
-        # carry ("node_shm", oid) markers to rewrite as arena locations
-        self.gets: set = set()
+        # req_id -> purpose ("get" | "arg") of get RPCs forwarded to
+        # the head, whose replies may carry ("node_shm", oid) markers
+        # to rewrite as arena locations / peer pulls (purpose sets the
+        # pull priority: a blocking get outranks task-arg prefetch)
+        self.gets: Dict[int, str] = {}
         # dedicated actor workers record their actor id (from the
         # actor_create payload) so a RESTARTED head can re-adopt them
         self.actor_bin: Optional[bytes] = None
+
+
+class PullManager:
+    """Priority-ordered peer pulls (reference: the object manager's
+    PullManager, src/ray/object_manager/pull_manager.cc — get > wait >
+    task-arg request priority, bounded concurrent transfers).
+
+    Every peer pull enqueues here; a fixed pool of puller threads
+    drains the heap strictly by (priority, arrival). A blocking user
+    get therefore jumps ahead of queued task-argument prefetches, and
+    per-link memory stays bounded by num_threads transfers x one
+    chunk."""
+
+    PRIO_GET, PRIO_WAIT, PRIO_ARG = 0, 1, 2
+
+    def __init__(self, transfer, num_threads: int = 2):
+        import collections
+
+        self._transfer = transfer      # (address, oid_bin) -> bool
+        self._heap: list = []
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._stop = False
+        # duplicate pulls of one object COALESCE: only the first
+        # enqueues a transfer, later callers wait on its outcome — two
+        # threads racing begin_adopt for the same oid would otherwise
+        # corrupt a shared spill temp file or misreport "lost"
+        self._inflight: Dict[bytes, list] = {}
+        # bounded observability ring (a daemon lives for days)
+        self.serviced: Any = collections.deque(maxlen=1024)
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"ray_tpu_pull_{i}")
+            for i in range(num_threads)]
+        for t in self._threads:
+            t.start()
+
+    def pull(self, address, oid_bin: bytes, priority: int) -> bool:
+        """Blocking: enqueue (or join the in-flight pull of the same
+        object) and wait for the outcome."""
+        import heapq
+
+        done = threading.Event()
+        slot = [False]
+        with self._cv:
+            waiters = self._inflight.get(oid_bin)
+            if waiters is not None:
+                waiters.append((done, slot))
+            else:
+                self._inflight[oid_bin] = []
+                self._seq += 1
+                heapq.heappush(self._heap, (priority, self._seq,
+                                            tuple(address), oid_bin,
+                                            done, slot))
+                self._cv.notify()
+        done.wait()
+        return slot[0]
+
+    def _run(self) -> None:
+        import heapq
+
+        while True:
+            with self._cv:
+                while not self._heap and not self._stop:
+                    self._cv.wait()
+                if self._stop:
+                    return
+                prio, _seq, address, oid_bin, done, slot = heapq.heappop(
+                    self._heap)
+                self.serviced.append((prio, oid_bin))
+            try:
+                ok = bool(self._transfer(address, oid_bin))
+            except BaseException:
+                ok = False
+            with self._cv:
+                waiters = self._inflight.pop(oid_bin, [])
+            slot[0] = ok
+            done.set()
+            for d, s in waiters:
+                s[0] = ok
+                d.set()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
 
 
 class NodeDaemon:
@@ -139,6 +231,7 @@ class NodeDaemon:
         self.peer_address = (local_ip, self._peer_listener.address[1])
         self._peer_conns: Dict[tuple, Any] = {}
         self._peer_lock = threading.Lock()
+        self.pulls = PullManager(self.pull_from_peer)
         threading.Thread(target=self._peer_accept_loop, daemon=True,
                          name="ray_tpu_node_peer_accept").start()
 
@@ -278,7 +371,8 @@ class NodeDaemon:
                             (oid_bin, ("remote_shm", loc[2])))
                 return msg
             if op == "get":
-                oid_bins, timeout = args
+                oid_bins, timeout = args[0], args[1]
+                purpose = args[2] if len(args) > 2 else "get"
                 locs = []
                 for b in oid_bins:
                     loc = self.store.locate(ObjectID(b))
@@ -286,8 +380,9 @@ class NodeDaemon:
                         # something not arena-resident (unsealed, spilled,
                         # exception, or remote): the head decides; its
                         # reply may point back here via node_shm markers
-                        slot.gets.add(req_id)
-                        return msg
+                        slot.gets[req_id] = purpose
+                        return ("rpc", req_id, "get",
+                                (oid_bins, timeout))
                     locs.append(("shm", loc[0], loc[1]))
                 self._to_worker(slot, ("reply", req_id, True, locs))
                 return None
@@ -331,10 +426,14 @@ class NodeDaemon:
                              daemon=True,
                              name="ray_tpu_node_peer_serve").start()
 
-    def _peer_serve(self, conn) -> None:
+    PEER_CHUNK = 1 << 20  # ~1 MB frames (reference: ObjectBufferPool)
+
+    def _peer_serve(self, conn) -> None:  # noqa: D401
         """One persistent connection per consuming peer: a versioned
         hello first, then get requests served out of the local
-        arena/spill tier."""
+        arena/spill tier in ~1 MB frames — a multi-GB object never
+        materializes as one message on either side (reference:
+        src/ray/object_manager/ chunked push via ObjectBufferPool)."""
         from ray_tpu._private import protocol
 
         try:
@@ -361,11 +460,7 @@ class NodeDaemon:
                 if not (isinstance(msg, tuple) and msg
                         and msg[0] == "get"):
                     return
-                sobj = self.store.get_serialized(ObjectID(msg[1]))
-                try:
-                    conn.send((True, sobj.to_bytes()) if sobj is not None
-                              else (False, None))
-                except (OSError, ValueError):
+                if not self._peer_send_object(conn, ObjectID(msg[1])):
                     return
         finally:
             try:
@@ -373,10 +468,69 @@ class NodeDaemon:
             except Exception:
                 pass
 
+    def _peer_send_object(self, conn, oid: ObjectID) -> bool:
+        """("meta", total) + raw ~1 MB frames; arena objects stream
+        zero-copy from the pinned range, spilled objects stream from
+        their file. Returns False on a dead connection."""
+        CH = self.PEER_CHUNK
+        view = self.store.acquire_raw(oid)
+        if view is not None:
+            try:
+                total = len(view)
+                conn.send(("meta", total))
+                for off in range(0, total, CH):
+                    conn.send_bytes(view[off:off + CH])
+                return True
+            except (OSError, ValueError):
+                return False
+            finally:
+                view.release()
+                self.store.release_raw(oid)
+        spilled = self.store.spilled_path(oid)
+        if spilled is not None:
+            path, total = spilled
+            try:
+                f = open(path, "rb")
+            except OSError as e:
+                # nothing streamed yet: a miss reply keeps the
+                # connection usable
+                try:
+                    conn.send(("miss", str(e)))
+                    return True
+                except (OSError, ValueError):
+                    return False
+            try:
+                conn.send(("meta", total))
+                while True:
+                    chunk = f.read(CH)
+                    if not chunk:
+                        break
+                    conn.send_bytes(chunk)
+                return True
+            except OSError:
+                # MID-STREAM failure: the chunk protocol is now
+                # desynchronized — kill the connection deliberately
+                # (injecting a control frame would reach the receiver
+                # as a corrupt chunk); the puller redials fresh
+                return False
+            finally:
+                f.close()
+        try:
+            conn.send(("miss", None))
+            return True
+        except (OSError, ValueError):
+            return False
+
     def pull_from_peer(self, address: tuple,
-                       oid_bin: bytes) -> Optional[bytes]:
-        """Pull an object's framed bytes straight from the producing
-        node's daemon. Connections cache per peer with a per-peer lock
+                       oid_bin: bytes) -> bool:
+        """Pull an object from the producing node's daemon into THIS
+        node's store, ~1 MB frames at a time: arena-resident when it
+        fits, streamed straight to the spill tier when it doesn't — a
+        >arena-sized object transfers without either side holding it
+        whole (reference: PullManager + ObjectBufferPool chunking).
+        Returns True when the object is locally resident afterwards.
+
+        Connections cache per peer with a per-peer lock
         (a stalled peer must not wedge pulls from OTHER peers), replies
         are awaited under the transfer timeout, and a dead cached
         connection gets ONE fresh redial — after that the producer is
@@ -393,6 +547,9 @@ class NodeDaemon:
                 self._peer_conns[address] = entry
         from ray_tpu._private import protocol
 
+        oid = ObjectID(oid_bin)
+        if self.store.contains(oid):
+            return True  # a concurrent pull already landed it
         for _attempt in (0, 1):
             with entry[1]:
                 try:
@@ -406,14 +563,17 @@ class NodeDaemon:
                             logging.getLogger(__name__).error(
                                 "peer %s rejected us: %s", address, ack)
                             c.close()
-                            return None
-                        entry[0] = c
+                            return False
+                    entry[0] = c if entry[0] is None else entry[0]
                     conn = entry[0]
                     conn.send(("get", oid_bin))
                     if not conn.poll(timeout):
                         raise OSError("peer reply timed out")
-                    ok, data = conn.recv()
-                    return data if ok else None
+                    reply = conn.recv()
+                    if reply[0] == "miss":
+                        return False
+                    total = reply[1]
+                    return self._recv_object(conn, oid, total, timeout)
                 except (OSError, EOFError, ValueError):
                     # drop the (possibly dead) connection; the second
                     # attempt dials fresh
@@ -423,30 +583,84 @@ class NodeDaemon:
                     except Exception:
                         pass
                     entry[0] = None
-        return None
+        return False
 
-    def _localize(self, loc: tuple) -> tuple:
+    def _recv_object(self, conn, oid: ObjectID, total: int,
+                     timeout: float) -> bool:
+        """Drain the chunk frames into the local store: straight into a
+        pre-created arena range (recv_bytes_into — no intermediate
+        buffer) or appended to a spill file when the arena can't hold
+        it. Per-transfer transient memory is ONE chunk."""
+        CH = self.PEER_CHUNK
+        kind, target = self.store.begin_adopt(oid, total)
+        view = target if kind == "arena" else None
+        try:
+            pos = 0
+            while pos < total:
+                n = min(CH, total - pos)
+                if not conn.poll(timeout):
+                    raise OSError("peer chunk timed out")
+                if view is not None:
+                    got = conn.recv_bytes_into(view[pos:pos + n])
+                else:
+                    chunk = conn.recv_bytes(CH)
+                    got = len(chunk)
+                    target.write(chunk)
+                if got != n:
+                    raise OSError(
+                        f"short peer chunk: {got} != {n} at {pos}")
+                pos += n
+        except BaseException:
+            if view is not None:
+                view.release()
+            self.store.abort_adopt(oid, kind,
+                                   None if kind == "arena" else target)
+            raise
+        if view is not None:
+            view.release()
+        self.store.finish_adopt(oid, total, kind,
+                                None if kind == "arena" else target)
+        return True
+
+    def _localize(self, loc: tuple, priority: int = 0) -> tuple:
         """Rewrite a head get-reply entry: ("node_shm", oid) points at
         THIS node's store (zero-copy arena location / spill restore);
         ("peer", oid, address) directs a DIRECT pull from the producing
-        node's daemon — the bytes never touch the head."""
+        node's daemon — the bytes never touch the head. Peer pulls go
+        through the priority pull manager (get > wait > task-arg) and
+        land in the LOCAL store, so the worker reads the result
+        zero-copy from the arena (or from the spill file for objects
+        bigger than the arena)."""
         if not (isinstance(loc, tuple) and loc):
             return loc
         if loc[0] == "peer":
-            data = self.pull_from_peer(loc[2], loc[1])
-            if data is not None:
-                return ("inline", data)
-            return self._lost(ObjectID(loc[1]))
+            oid = ObjectID(loc[1])
+            if self.pulls.pull(loc[2], loc[1], priority):
+                return self._local_loc(oid)
+            return self._lost(oid)
         if loc[0] != "node_shm":
             return loc
-        oid = ObjectID(loc[1])
+        return self._local_loc(ObjectID(loc[1]))
+
+    def _local_loc(self, oid: ObjectID) -> tuple:
+        """A worker-readable location for a locally-resident object."""
         arena_loc = self.store.locate(oid)
         if arena_loc is not None:
             return ("shm", arena_loc[0], arena_loc[1])
-        sobj = self.store.get_serialized(oid)  # spilled -> restore
+        spilled = self.store.spilled_path(oid)
+        if spilled is not None:
+            # same host: the worker reads the spill file itself — a
+            # >arena-sized object never rides the pipe as one message
+            return ("spill_file", spilled[0], spilled[1])
+        sobj = self.store.get_serialized(oid)
         if sobj is not None:
             return ("inline", sobj.to_bytes())
         return self._lost(oid)
+
+    def _localize_reply(self, slot, req_id, locs, priority: int) -> None:
+        self._to_worker(slot, ("reply", req_id, True,
+                               [self._localize(lc, priority)
+                                for lc in locs]))
 
     def _lost(self, oid: ObjectID) -> tuple:
         import cloudpickle
@@ -457,7 +671,8 @@ class NodeDaemon:
 
     def _to_worker(self, slot: _WorkerSlot, msg: tuple) -> None:
         try:
-            slot.conn.send(msg)
+            with slot.send_lock:
+                slot.conn.send(msg)
         except (OSError, ValueError):
             pass
 
@@ -513,11 +728,25 @@ class NodeDaemon:
                                 slot.returns[p["task_id"]] = list(rids)
                     elif (payload[0] == "reply"
                           and payload[1] in slot.gets):
-                        slot.gets.discard(payload[1])
+                        purpose = slot.gets.pop(payload[1])
                         if payload[2]:
+                            prio = (PullManager.PRIO_ARG
+                                    if purpose == "arg"
+                                    else PullManager.PRIO_GET)
+                            locs = payload[3]
+                            if any(isinstance(lc, tuple) and lc
+                                   and lc[0] == "peer" for lc in locs):
+                                # peer pulls can take seconds: NEVER on
+                                # the head-message run loop (it carries
+                                # task dispatch + pings for the node)
+                                threading.Thread(
+                                    target=self._localize_reply,
+                                    args=(slot, payload[1], locs, prio),
+                                    daemon=True).start()
+                                continue
                             payload = ("reply", payload[1], True,
-                                       [self._localize(loc)
-                                        for loc in payload[3]])
+                                       [self._localize(lc, prio)
+                                        for lc in locs])
                     self._to_worker(slot, payload)
             elif kind == "to_ctrl":
                 with self._lock:

@@ -309,6 +309,29 @@ class _WorkerRunner:
             _, offset, nbytes = loc
             view = self.arena.view(offset, nbytes)
             return deserialize(SerializedObject.from_bytes(view))
+        if loc[0] == "spill_file":
+            # same-host spill tier: objects bigger than the arena are
+            # read straight off their file (mmap — the page cache
+            # backs the buffers; nothing rides the daemon pipe)
+            import mmap
+
+            _, path, nbytes = loc
+            with open(path, "rb") as f:
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            try:
+                return deserialize(
+                    SerializedObject.from_bytes(memoryview(mm)))
+            finally:
+                # deserialize COPIES out-of-band buffers it keeps?
+                # No — views may reference mm; keep mm alive by NOT
+                # closing when buffers escaped. CPython: closing a
+                # mapped mmap with exported views raises BufferError —
+                # treat that as "value borrowed the pages" and leak the
+                # mapping to the GC instead.
+                try:
+                    mm.close()
+                except BufferError:
+                    pass
         raise ValueError(f"bad location {loc[0]!r}")
 
     # -- control thread ----------------------------------------------------
@@ -483,7 +506,9 @@ class _WorkerRunner:
         if isinstance(v, _PullValue):
             from ray_tpu import exceptions as rex
 
-            locs = self.rpc("get", ([v.oid_bin], None))
+            # purpose "arg": a task-argument prefetch — the daemon's
+            # pull manager ranks it below blocking user gets
+            locs = self.rpc("get", ([v.oid_bin], None, "arg"))
             loc = locs[0]
             if loc[0] == "exc":
                 exc = cloudpickle.loads(loc[1])

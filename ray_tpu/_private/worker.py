@@ -877,7 +877,8 @@ class Worker:
     def add_remote_cluster_node(self, num_cpus: float = 4.0,
                                 num_tpus: float = 0.0,
                                 num_workers: Optional[int] = None,
-                                resources: Optional[Dict[str, float]] = None):
+                                resources: Optional[Dict[str, float]] = None,
+                                object_store_memory: Optional[int] = None):
         """Add a node backed by a NODE DAEMON process with its OWN shm
         arena, connected over TCP (localhost stands in for the DCN) —
         the real multi-host topology, unlike add_cluster_node's
@@ -907,7 +908,8 @@ class Worker:
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.runtime.node_daemon",
              host, str(port), token,
-             str(GLOBAL_CONFIG.object_store_memory),
+             str(object_store_memory
+                 or GLOBAL_CONFIG.object_store_memory),
              str(GLOBAL_CONFIG.inline_object_max_bytes),
              info, str(GLOBAL_CONFIG.daemon_rejoin_timeout_s)],
             env=env, close_fds=True)

@@ -75,16 +75,19 @@ class Cluster:
     def add_node(self, num_cpus: float = 4.0, num_tpus: float = 0.0,
                  num_workers: Optional[int] = None,
                  resources: Optional[Dict[str, float]] = None,
-                 remote: bool = False) -> ClusterNode:
+                 remote: bool = False,
+                 object_store_memory: Optional[int] = None) -> ClusterNode:
         """``remote=True`` backs the node with a NODE DAEMON process
         owning its own shm arena, reached over TCP — the true multi-host
         topology (localhost stands in for the DCN); the default shares
-        the head process's arena (virtual same-host node)."""
+        the head process's arena (virtual same-host node).
+        object_store_memory sizes the remote node's arena."""
         w = worker_mod.get_worker()
         if remote:
             entry = w.add_remote_cluster_node(
                 num_cpus=num_cpus, num_tpus=num_tpus,
-                num_workers=num_workers, resources=resources)
+                num_workers=num_workers, resources=resources,
+                object_store_memory=object_store_memory)
         else:
             entry = w.add_cluster_node(num_cpus=num_cpus, num_tpus=num_tpus,
                                        num_workers=num_workers,

@@ -245,3 +245,125 @@ class TestObjectDirectoryLifecycle:
         assert w.gcs.object_location_get(oid) is not None
         del ref
         assert wait_for(lambda: w.gcs.object_location_get(oid) is None)
+
+
+class TestChunkedPeerTransfer:
+    """VERDICT r3 #4: ~1 MB framed peer transfers with a bounded
+    in-flight window and get > wait > task-arg pull priority
+    (reference: src/ray/object_manager/ PullManager/ObjectBufferPool)."""
+
+    def test_large_object_transfers_under_small_arena(self):
+        """A >256 MB object moves B -> C although NEITHER node's arena
+        can hold it: the producer spills, serves its spill file in
+        1 MB frames, and the consumer streams straight to ITS spill
+        tier — transient memory per link is one chunk."""
+        from ray_tpu.cluster_utils import Cluster
+
+        ray_tpu.shutdown()
+        small = 128 * 1024 * 1024  # arena; object is ~2.1x this
+        c = Cluster(initialize_head=True,
+                    head_node_args=dict(num_cpus=2, num_workers=2,
+                                        scheduler="tensor"))
+        try:
+            c.add_node(num_cpus=2, remote=True, resources={"b": 2.0},
+                       object_store_memory=small)
+            c.add_node(num_cpus=2, remote=True, resources={"c": 2.0},
+                       object_store_memory=small)
+            c.wait_for_nodes()
+
+            n = (270 * 1024 * 1024) // 8  # ~270 MB of int64
+
+            @ray_tpu.remote(resources={"b": 1.0})
+            def produce():
+                return np.arange(n, dtype=np.int64)
+
+            @ray_tpu.remote(resources={"c": 1.0})
+            def consume(x):
+                return int(x[0]), int(x[-1]), len(x)
+
+            out = ray_tpu.get(consume.remote(produce.remote()),
+                              timeout=600)
+            assert out == (0, n - 1, n)
+        finally:
+            c.shutdown()
+
+    def test_pull_priority_get_preempts_task_arg(self):
+        """PullManager ordering: with the puller busy, a later-queued
+        blocking GET is serviced before earlier-queued task-arg
+        prefetches."""
+        import threading
+        import time as _t
+
+        from ray_tpu._private.runtime.node_daemon import PullManager
+
+        gate = threading.Event()
+        order = []
+
+        def transfer(address, oid_bin):
+            gate.wait(timeout=30)
+            order.append(oid_bin)
+            return True
+
+        pm = PullManager(transfer, num_threads=1)
+        try:
+            # occupy the single puller
+            t0 = threading.Thread(
+                target=pm.pull, args=(("h", 1), b"busy",
+                                      PullManager.PRIO_ARG))
+            t0.start()
+            _t.sleep(0.1)
+            # queue: two ARG prefetches, then a blocking GET, then WAIT
+            ts = []
+            for oid, prio in ((b"arg1", PullManager.PRIO_ARG),
+                              (b"arg2", PullManager.PRIO_ARG),
+                              (b"get1", PullManager.PRIO_GET),
+                              (b"wait1", PullManager.PRIO_WAIT)):
+                th = threading.Thread(target=pm.pull,
+                                      args=(("h", 1), oid, prio))
+                th.start()
+                ts.append(th)
+                _t.sleep(0.05)
+            gate.set()
+            for th in [t0] + ts:
+                th.join(timeout=30)
+            # busy first (already popped), then strict priority order
+            assert order == [b"busy", b"get1", b"wait1", b"arg1",
+                             b"arg2"], order
+            assert pm.serviced[0][1] == b"busy"
+        finally:
+            pm.stop()
+
+    def test_duplicate_pulls_coalesce(self):
+        """Concurrent pulls of ONE object run a single transfer; every
+        caller observes its outcome (racing begin_adopt for the same
+        oid would corrupt a shared spill temp file)."""
+        import threading
+        import time as _t
+
+        from ray_tpu._private.runtime.node_daemon import PullManager
+
+        gate = threading.Event()
+        calls = []
+
+        def transfer(address, oid_bin):
+            calls.append(oid_bin)
+            gate.wait(timeout=30)
+            return True
+
+        pm = PullManager(transfer, num_threads=2)
+        try:
+            results = []
+            ts = [threading.Thread(
+                target=lambda: results.append(
+                    pm.pull(("h", 1), b"same", PullManager.PRIO_GET)))
+                for _ in range(4)]
+            for t in ts:
+                t.start()
+            _t.sleep(0.2)
+            gate.set()
+            for t in ts:
+                t.join(timeout=30)
+            assert calls == [b"same"]       # ONE transfer
+            assert results == [True] * 4    # every caller sees it
+        finally:
+            pm.stop()
