@@ -9,13 +9,15 @@ framework actors, reporting through the same train.report session API.
 """
 
 from ray_tpu.tune.tuner import (ASHAScheduler,  # noqa: F401
+                                HyperBandScheduler, MedianStoppingRule,
                                 PopulationBasedTraining, ResultGrid,
                                 TrialResult, TuneConfig, Tuner, choice,
                                 get_checkpoint, grid_search, loguniform,
                                 report, uniform)
 
 __all__ = [
-    "Tuner", "TuneConfig", "ASHAScheduler", "PopulationBasedTraining",
+    "Tuner", "TuneConfig", "ASHAScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
     "ResultGrid", "TrialResult", "grid_search", "choice", "uniform",
     "loguniform", "report", "get_checkpoint",
 ]

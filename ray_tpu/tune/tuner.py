@@ -190,6 +190,103 @@ class ASHAScheduler:
 
 
 # ----------------------------------------------------------------------
+# Median stopping (reference: tune/schedulers/median_stopping_rule.py)
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MedianStoppingRule:
+    """Stop a trial whose RUNNING MEAN falls below the median of the
+    other trials' running means at the same step (after a grace
+    period, once enough trials report) — the Google Vizier rule the
+    reference implements."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    grace_period: int = 4
+    min_samples_required: int = 3
+
+    def __post_init__(self):
+        self._histories: Dict[int, List[float]] = {}
+
+    def on_result(self, trial_id: int, iteration: int,
+                  value: float) -> str:
+        sign = 1.0 if self.mode == "max" else -1.0
+        hist = self._histories.setdefault(trial_id, [])
+        hist.append(sign * value)
+        if iteration < self.grace_period:
+            return "continue"
+        others = [sum(h[:iteration]) / min(len(h), iteration)
+                  for tid, h in self._histories.items()
+                  if tid != trial_id and h]
+        if len(others) < self.min_samples_required:
+            return "continue"
+        others.sort()
+        median = others[len(others) // 2]
+        mine = sum(hist) / len(hist)
+        return "stop" if mine < median else "continue"
+
+
+# ----------------------------------------------------------------------
+# HyperBand (reference: tune/schedulers/hyperband.py)
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class HyperBandScheduler:
+    """Bracketed successive halving: trials round-robin into brackets
+    whose FIRST cut comes at different budgets (bracket s starts
+    culling at max_t / eta^s), trading exploration breadth against
+    per-trial budget; within a bracket, each rung keeps the top 1/eta
+    by reported score and stops the rest (the async promotion rule, as
+    in the reference's time-multiplexed brackets)."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    max_t: int = 81
+    eta: int = 3
+
+    def __post_init__(self):
+        # integer bracket count: log() float error drops the most
+        # aggressive bracket for exact powers (e.g. max_t=243, eta=3)
+        self.num_brackets = 1
+        while self.eta ** self.num_brackets <= self.max_t:
+            self.num_brackets += 1
+        # bracket s: milestones r0*eta^k with r0 = max_t / eta^s
+        self._milestones: Dict[int, List[int]] = {}
+        for s in range(self.num_brackets):
+            r = max(1, self.max_t // (self.eta ** s))
+            ms = []
+            while r < self.max_t:
+                ms.append(r)
+                r *= self.eta
+            self._milestones[s] = ms
+        self._bracket_of: Dict[int, int] = {}
+        self._rungs: Dict[Tuple[int, int], List[float]] = {}
+        self._next = 0
+
+    def bracket_of(self, trial_id: int) -> int:
+        s = self._bracket_of.get(trial_id)
+        if s is None:
+            s = self._next % self.num_brackets
+            self._next += 1
+            self._bracket_of[trial_id] = s
+        return s
+
+    def on_result(self, trial_id: int, iteration: int,
+                  value: float) -> str:
+        sign = 1.0 if self.mode == "max" else -1.0
+        s = self.bracket_of(trial_id)
+        for m in self._milestones[s]:
+            if iteration == m:
+                rung = self._rungs.setdefault((s, m), [])
+                rung.append(sign * value)
+                rung.sort(reverse=True)
+                k = max(1, len(rung) // self.eta)
+                if sign * value < rung[k - 1]:
+                    return "stop"
+        return "continue"
+
+
+# ----------------------------------------------------------------------
 # PBT (reference: tune/schedulers/pbt.py PopulationBasedTraining)
 # ----------------------------------------------------------------------
 

@@ -207,3 +207,91 @@ class TestTunerRestore:
         assert runs_total == 6  # only the two lost trials re-ran
         best = grid2.get_best_result("score", "max")
         assert best.metrics["score"] == 8
+
+
+class TestMedianStopping:
+    def test_plateaued_trial_stopped_at_median(self, rt):
+        """A trial whose running mean sits below the median of its
+        peers is killed after the grace period (reference:
+        MedianStoppingRule / Vizier)."""
+        import time
+
+        def trainable(config):
+            for i in range(1, 11):
+                tune.report({"score": config["quality"] * i})
+                time.sleep(0.03)
+
+        sched = tune.MedianStoppingRule(metric="score", mode="max",
+                                        grace_period=3,
+                                        min_samples_required=2)
+        grid = tune.Tuner(
+            trainable,
+            param_space={"quality": tune.grid_search([8, 9, 10, 1])},
+            tune_config=tune.TuneConfig(metric="score", mode="max",
+                                        scheduler=sched,
+                                        max_concurrent_trials=4),
+        ).fit()
+        stopped = [r for r in grid if r.terminated_early]
+        finished = [r for r in grid if not r.terminated_early]
+        assert any(r.config["quality"] == 1 for r in stopped), (
+            [(r.config, r.terminated_early) for r in grid])
+        assert any(r.config["quality"] == 10 for r in finished)
+
+    def test_unit_median_rule(self):
+        """Deterministic seam check: below-median running mean stops."""
+        sched = tune.MedianStoppingRule(grace_period=2,
+                                        min_samples_required=2)
+        for it in range(1, 5):
+            assert sched.on_result(1, it, 10.0) == "continue"
+            assert sched.on_result(2, it, 9.0) == "continue"
+        # trial 3's mean (1.0) is below the median of {10, 9}
+        sched.on_result(3, 1, 1.0)
+        assert sched.on_result(3, 2, 1.0) == "stop"
+
+
+class TestHyperBand:
+    def test_brackets_cut_at_different_budgets(self):
+        """Bracket 0's first rung sits at max_t/eta^0... bracket s
+        cuts EARLIER — the budget/breadth trade HyperBand adds over
+        one ASHA ladder."""
+        sched = tune.HyperBandScheduler(max_t=9, eta=3)
+        assert sched.num_brackets == 3
+        assert sched._milestones[0] == []        # full budget, no cut
+        assert sched._milestones[1] == [3]       # one cut at 3
+        assert sched._milestones[2] == [1, 3]    # cuts at 1 and 3
+        # round-robin assignment
+        assert [sched.bracket_of(i) for i in range(6)] == [0, 1, 2,
+                                                           0, 1, 2]
+
+    def test_hyperband_promotes_good_and_stops_bad(self, rt):
+        """Within a bracket, top-1/eta at each rung promote; the rest
+        stop. A full-budget bracket-0 trial always finishes."""
+        import time
+
+        def trainable(config):
+            for i in range(1, 10):
+                tune.report({"score": config["quality"] * i})
+                time.sleep(0.02)
+
+        sched = tune.HyperBandScheduler(metric="score", mode="max",
+                                        max_t=9, eta=3)
+        grid = tune.Tuner(
+            trainable,
+            # 6 trials -> brackets [0,1,2,0,1,2]; highs first so rung
+            # cutoffs are established before the lows arrive
+            param_space={"quality": tune.grid_search(
+                [10, 10, 10, 1, 1, 1])},
+            tune_config=tune.TuneConfig(metric="score", mode="max",
+                                        scheduler=sched,
+                                        max_concurrent_trials=3),
+        ).fit()
+        assert len(grid) == 6
+        stopped = [r for r in grid if r.terminated_early]
+        finished = [r for r in grid if not r.terminated_early]
+        # a low-quality trial in a cutting bracket (1 or 2) died early
+        assert any(r.config["quality"] == 1 for r in stopped)
+        # the best finishes, and bracket-0 trials NEVER stop early
+        assert any(r.config["quality"] == 10 for r in finished)
+        for r in grid:
+            if sched._bracket_of.get(r.trial_id) == 0:
+                assert not r.terminated_early
