@@ -494,11 +494,15 @@ def llm_decode_throughput(smoke: bool = False) -> dict:
         batch, new_tokens, pages = 2, 16, 64
     else:
         # serving-shaped model: head_dim 128 keeps the Pallas kernel on
-        # full-width lanes
+        # full-width lanes. 64 continuous-batch slots x 128 new tokens:
+        # the r3 config (32x64) left the MXU under-fed — the decode
+        # matmuls scale near-linearly to 64 slots on this chip
+        # (10.2k -> 17.9k tok/s measured) and longer decodes amortize
+        # the per-burst host work
         mcfg = TransformerConfig(vocab_size=32000, d_model=1024,
                                  n_layers=8, n_heads=8, n_kv_heads=4,
                                  d_ff=2816, max_seq_len=2048)
-        batch, new_tokens, pages = 32, 64, 512
+        batch, new_tokens, pages = 64, 128, 1024
     model = Transformer(mcfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]

@@ -107,6 +107,23 @@ def make_free_list(size: int, align: int = _ALIGN):
         return PyFreeList(size, align)
 
 
+class _QuietSharedMemory(shared_memory.SharedMemory):
+    """SharedMemory whose finalizer tolerates live zero-copy exports.
+
+    A user legitimately holding an arena-backed array (Arrow column,
+    numpy view) past store shutdown makes mmap.close() raise
+    BufferError; stdlib __del__ re-raises it as an unraisable warning
+    on every GC. The mapping simply stays until the views die (the OS
+    reclaims at process exit either way) — that's the documented
+    zero-copy contract, not an error."""
+
+    def __del__(self):
+        try:
+            super().__del__()
+        except BufferError:
+            pass
+
+
 class ShmArena:
     """A named shared-memory segment + free-list allocator (native C++
     core via ray_tpu/_native, Python fallback).
@@ -117,7 +134,7 @@ class ShmArena:
 
     def __init__(self, size: int, name: Optional[str] = None,
                  create: bool = True):
-        self._shm = shared_memory.SharedMemory(
+        self._shm = _QuietSharedMemory(
             name=name, create=create, size=size if create else 0)
         if not create:
             # Python <=3.12 registers attached segments with the

@@ -14,7 +14,7 @@ import math
 import random as _random
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import ray_tpu
 
@@ -157,6 +157,16 @@ class _TrialActor:
 # ASHA (reference: AsyncHyperBandScheduler)
 # ----------------------------------------------------------------------
 
+def _rung_cut(rung: List[float], signed_value: float,
+              reduction_factor: int) -> str:
+    """Async rung rule shared by ASHA and HyperBand: record the
+    result, keep the top 1/reduction_factor, stop the rest."""
+    rung.append(signed_value)
+    rung.sort(reverse=True)
+    k = max(1, len(rung) // reduction_factor)
+    return "stop" if signed_value < rung[k - 1] else "continue"
+
+
 @dataclasses.dataclass
 class ASHAScheduler:
     metric: Optional[str] = None
@@ -180,11 +190,8 @@ class ASHAScheduler:
         for m in self._milestones:
             if iteration == m:
                 rung = self._rungs.setdefault(m, [])
-                rung.append(sign * value)
-                rung.sort(reverse=True)
-                k = max(1, len(rung) // self.reduction_factor)
-                cutoff = rung[k - 1]
-                if sign * value < cutoff:
+                if _rung_cut(rung, sign * value,
+                             self.reduction_factor) == "stop":
                     return "stop"
         return "continue"
 
@@ -220,8 +227,9 @@ class MedianStoppingRule:
                   if tid != trial_id and h]
         if len(others) < self.min_samples_required:
             return "continue"
-        others.sort()
-        median = others[len(others) // 2]
+        import statistics
+
+        median = statistics.median(others)
         mine = sum(hist) / len(hist)
         return "stop" if mine < median else "continue"
 
@@ -278,10 +286,7 @@ class HyperBandScheduler:
         for m in self._milestones[s]:
             if iteration == m:
                 rung = self._rungs.setdefault((s, m), [])
-                rung.append(sign * value)
-                rung.sort(reverse=True)
-                k = max(1, len(rung) // self.eta)
-                if sign * value < rung[k - 1]:
+                if _rung_cut(rung, sign * value, self.eta) == "stop":
                     return "stop"
         return "continue"
 
