@@ -126,10 +126,15 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     _worker.get_worker().cancel_task(ref, force=force)
 
 
-def method(num_returns: int = 1):
-    """Decorator to set per-method defaults on actor methods."""
+def method(num_returns: int = 1, concurrency_group: str = None):
+    """Decorator to set per-method defaults on actor methods.
+    ``concurrency_group`` routes the method to a NAMED thread pool
+    declared via ``@remote(concurrency_groups={...})`` (reference:
+    ray.method(concurrency_group=...))."""
     def deco(f):
         f.__ray_tpu_num_returns__ = num_returns
+        if concurrency_group is not None:
+            f.__ray_tpu_concurrency_group__ = concurrency_group
         return f
     return deco
 

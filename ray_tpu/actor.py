@@ -47,6 +47,10 @@ _ACTOR_OPTIONS.update(dict(
     max_pending_calls=-1,
     lifetime=None,  # None | "detached"
     namespace="default",
+    # named thread pools with independent queues (reference:
+    # concurrency_groups={"io": 2}); methods route with
+    # @ray_tpu.method(concurrency_group="io")
+    concurrency_groups=None,
 ))
 
 
@@ -87,6 +91,14 @@ class _ActorRuntime:
         self.state = ActorState.PENDING_CREATION
         self.instance = None
         self.inbox: "queue.Queue[Optional[_Call]]" = queue.Queue()
+        # named concurrency groups: each group gets its OWN queue and
+        # thread pool — a saturated "compute" group never blocks "io"
+        # methods (reference: core_worker concurrency groups)
+        groups = dict(opts.get("concurrency_groups") or {})
+        self._group_inboxes: Dict[str, "queue.Queue[Optional[_Call]]"] = {
+            g: queue.Queue() for g in groups}
+        self._group_sizes: Dict[str, int] = {
+            g: max(1, int(n)) for g, n in groups.items()}
         self.init_done = threading.Event()
         self.death_cause: Optional[BaseException] = None
         self.num_restarts = 0
@@ -148,6 +160,16 @@ class _ActorRuntime:
                                      name=f"actor-{self.actor_id.hex()[:8]}-{i}")
                 t.start()
                 self._threads.append(t)
+            for group, n in self._group_sizes.items():
+                inbox = self._group_inboxes[group]
+                for i in range(n):
+                    t = threading.Thread(
+                        target=self._group_main, args=(inbox,),
+                        daemon=True,
+                        name=(f"actor-{self.actor_id.hex()[:8]}"
+                              f"-{group}-{i}"))
+                    t.start()
+                    self._threads.append(t)
 
     def _run_init(self) -> bool:
         env_saved = self._env_apply()
@@ -189,6 +211,16 @@ class _ActorRuntime:
                 return
         while not self._stopped.is_set():
             call = self.inbox.get()
+            if call is None:
+                break
+            self._execute_call(call)
+
+    def _group_main(self, inbox: "queue.Queue[Optional[_Call]]"):
+        self.init_done.wait()
+        if self.state == ActorState.DEAD:
+            return
+        while not self._stopped.is_set():
+            call = inbox.get()
             if call is None:
                 break
             self._execute_call(call)
@@ -330,13 +362,14 @@ class _ActorRuntime:
 
     def _drain_with_error(self):
         err = self.death_cause or rex.ActorDiedError(actor_id=self.actor_id)
-        while True:
-            try:
-                call = self.inbox.get_nowait()
-            except queue.Empty:
-                break
-            if call is not None:
-                self._store_error(call, err)
+        for inbox in (self.inbox, *self._group_inboxes.values()):
+            while True:
+                try:
+                    call = inbox.get_nowait()
+                except queue.Empty:
+                    break
+                if call is not None:
+                    self._store_error(call, err)
 
     # -- submission (from handles) ----------------------------------------
     def submit(self, call: _Call):
@@ -344,11 +377,24 @@ class _ActorRuntime:
             self._store_error(call, self.death_cause
                               or rex.ActorDiedError(actor_id=self.actor_id))
             return
+        inbox = self.inbox
+        if self._group_inboxes:
+            fn = getattr(self.cls, call.method_name, None)
+            group = getattr(fn, "__ray_tpu_concurrency_group__", None)
+            if group is not None:
+                named = self._group_inboxes.get(group)
+                if named is None:
+                    self._store_error(call, ValueError(
+                        f"method {call.method_name!r} routes to unknown "
+                        f"concurrency group {group!r}; declared: "
+                        f"{sorted(self._group_inboxes)}"))
+                    return
+                inbox = named
         limit = self.opts.get("max_pending_calls", -1)
-        if limit > 0 and self.inbox.qsize() >= limit:
+        if limit > 0 and inbox.qsize() >= limit:
             raise rex.PendingCallsLimitExceeded(
-                f"actor has {self.inbox.qsize()} pending calls (limit {limit})")
-        self.inbox.put(call)
+                f"actor has {inbox.qsize()} pending calls (limit {limit})")
+        inbox.put(call)
 
     # -- death / restart ---------------------------------------------------
     def stop(self, no_restart: bool = True,
@@ -374,6 +420,9 @@ class _ActorRuntime:
         self._stopped.set()
         for _ in self._threads:
             self.inbox.put(None)
+        for g, n in self._group_sizes.items():
+            for _ in range(n):
+                self._group_inboxes[g].put(None)
         self._drain_with_error()
         # lifetime-held resources released at death
         if self._explicit_resources:
@@ -726,6 +775,9 @@ class _ProcessActorRuntime(_ActorRuntime):
             self._stopped.set()
             for _ in self._threads:
                 self.inbox.put(None)
+            for g, n in self._group_sizes.items():
+                for _ in range(n):
+                    self._group_inboxes[g].put(None)
             self._drain_with_error()
             if self._explicit_resources:
                 self.worker.scheduler.notify_task_finished(
@@ -881,7 +933,32 @@ class ActorClass:
         new._options = merged
         return new
 
+    def _validate_concurrency_groups(self) -> None:
+        """Fail at CALL time, not deep in actor bootstrap (a bootstrap
+        raise would leave the creation object pending forever)."""
+        groups = self._options.get("concurrency_groups")
+        if not groups:
+            return
+        if any(inspect.iscoroutinefunction(m) for _, m in
+               inspect.getmembers(self._cls, inspect.isfunction)):
+            # async actors run one event loop; group-tagged calls would
+            # land in queues no loop drains
+            raise ValueError(
+                "concurrency_groups are not supported on ASYNC actors: "
+                "async methods already interleave on one event loop "
+                "(use max_concurrency to bound them)")
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        if GLOBAL_CONFIG.worker_mode == "process":
+            # process-actor rounds share one reply slot; concurrent
+            # group threads would cross-wire results
+            raise ValueError(
+                "concurrency_groups require thread-mode actors; "
+                "process-mode actors execute calls through a single "
+                "ordered round-trip")
+
     def remote(self, *args, **kwargs) -> ActorHandle:
+        self._validate_concurrency_groups()
         worker = worker_mod.get_worker()
         if getattr(worker, "is_client", False):
             return worker.create_actor(self._cls, self._options, args,

@@ -11,11 +11,12 @@ JSON HTTP ingress.
 
 from ray_tpu.serve.core import (Application, AutoscalingConfig,  # noqa: F401
                                 Deployment, DeploymentHandle, deployment,
-                                get_app_handle, run, shutdown, start_http,
+                                get_app_handle, get_multiplexed_model_id,
+                                multiplexed, run, shutdown, start_http,
                                 status)
 
 __all__ = [
     "deployment", "run", "shutdown", "status", "get_app_handle",
     "Deployment", "DeploymentHandle", "Application", "start_http",
-    "AutoscalingConfig",
+    "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
 ]

@@ -95,6 +95,53 @@ def deployment(cls=None, *, name: Optional[str] = None,
 # replicas + router
 # ----------------------------------------------------------------------
 
+import contextvars
+
+# the model id of the REQUEST being handled (reference:
+# serve.get_multiplexed_model_id inside a multiplexed deployment)
+_current_model_id: "contextvars.ContextVar" = contextvars.ContextVar(
+    "ray_tpu_serve_model_id", default=None)
+
+
+def get_multiplexed_model_id() -> Optional[str]:
+    """Inside a deployment method: the multiplexed_model_id the caller
+    set via handle.options(multiplexed_model_id=...), else None."""
+    return _current_model_id.get()
+
+
+def multiplexed(max_num_models_per_replica: int = 3):
+    """Decorator for a per-replica model LOADER method (reference:
+    @serve.multiplexed): results cache per model id in an LRU bounded
+    by max_num_models_per_replica — the replica holds at most that
+    many models, evicting least-recently-used."""
+    def deco(loader):
+        import collections
+        import functools
+
+        attr = f"_ray_tpu_mux_{loader.__name__}"
+
+        @functools.wraps(loader)
+        def wrapped(self, model_id):
+            cache = getattr(self, attr, None)
+            if cache is None:
+                cache = collections.OrderedDict()
+                setattr(self, attr, cache)
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            # evict BEFORE loading: the cap is a MEMORY bound, and a
+            # cap+1 transient peak is exactly what OOMs model replicas
+            while len(cache) >= max_num_models_per_replica:
+                cache.popitem(last=False)  # evict LRU
+            model = loader(self, model_id)
+            cache[model_id] = model
+            return model
+
+        wrapped.__ray_tpu_multiplexed__ = True
+        return wrapped
+    return deco
+
+
 @ray_tpu.remote
 class _Replica:
     def __init__(self, cls_blob, init_args, init_kwargs):
@@ -103,14 +150,19 @@ class _Replica:
         cls = cloudpickle.loads(cls_blob)
         self.instance = cls(*init_args, **init_kwargs)
 
-    def handle_request(self, method: str, args, kwargs):
+    def handle_request(self, method: str, args, kwargs,
+                       model_id: Optional[str] = None):
         target = (self.instance if method == "__call__"
                   else getattr(self.instance, method))
         if method == "__call__" and not callable(target):
             raise TypeError("deployment is not callable; use "
                             "handle.<method>.remote()")
         fn = target if method != "__call__" else self.instance.__call__
-        return fn(*args, **kwargs)
+        token = _current_model_id.set(model_id)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _current_model_id.reset(token)
 
 
 class _ReplicaState:
@@ -137,6 +189,12 @@ class _DeploymentState:
         self._lock = threading.Lock()
         self._replicas: List[_ReplicaState] = []
         self._sticky: Dict[str, _ReplicaState] = {}  # session -> replica
+        # model-multiplex affinity: model id -> replicas that served it
+        # (reference: the router prefers replicas with the model warm);
+        # bounded LRU over model ids
+        import collections as _collections
+        self._model_replicas: "_collections.OrderedDict" = \
+            _collections.OrderedDict()
         self._stop = threading.Event()
         auto = dep.autoscaling_config
         self._scale_to(auto.min_replicas if auto else dep.num_replicas)
@@ -192,26 +250,51 @@ class _DeploymentState:
                     victim = idle.pop()
                     self._replicas.remove(victim)
                     victims.append(victim)
+            if victims:
+                self._prune_affinity_locked()
         for state in victims:
             try:
                 ray_tpu.kill(state.actor)
             except Exception:
                 pass
 
-    def _pick(self) -> _ReplicaState:
+    def _pick(self, model_id: Optional[str] = None) -> _ReplicaState:
         """Power-of-two-choices on tracked ongoing requests. RESERVES
         the chosen replica (ongoing += 1) under the same lock hold —
         otherwise the autoscaler could classify it idle and kill it in
-        the window before the caller's increment."""
+        the window before the caller's increment. A multiplexed
+        model_id prefers the least-loaded replica that served that
+        model before (warm cache), falling back to P2C."""
         with self._lock:
             if not self._replicas:
                 raise rex.RayTpuError(
                     f"deployment {self.dep.name} has no replicas")
-            if len(self._replicas) == 1:
-                chosen = self._replicas[0]
-            else:
-                a, b = random.sample(self._replicas, 2)
-                chosen = a if a.ongoing <= b.ongoing else b
+            chosen = None
+            if model_id is not None:
+                warm = [r for r in self._model_replicas.get(model_id, ())
+                        if r in self._replicas]
+                if warm:
+                    cand = min(warm, key=lambda r: r.ongoing)
+                    # affinity yields under load: a saturated warm
+                    # replica must not cap one model's throughput at a
+                    # single replica while others idle — fall back to
+                    # P2C (the pick below records the new replica warm)
+                    idlest = min(r.ongoing for r in self._replicas)
+                    if cand.ongoing <= idlest + 2:
+                        chosen = cand
+            if chosen is None:
+                if len(self._replicas) == 1:
+                    chosen = self._replicas[0]
+                else:
+                    a, b = random.sample(self._replicas, 2)
+                    chosen = a if a.ongoing <= b.ongoing else b
+            if model_id is not None:
+                served = self._model_replicas.setdefault(model_id, [])
+                if chosen not in served:
+                    served.append(chosen)
+                self._model_replicas.move_to_end(model_id)
+                while len(self._model_replicas) > 1024:
+                    self._model_replicas.popitem(last=False)
             chosen.ongoing += 1
             return chosen
 
@@ -230,10 +313,12 @@ class _DeploymentState:
         except Exception:
             _dec()
 
-    def submit(self, method: str, args, kwargs, _retry: bool = True):
-        state = self._pick()
+    def submit(self, method: str, args, kwargs, _retry: bool = True,
+               model_id: Optional[str] = None):
+        state = self._pick(model_id)
         try:
-            ref = state.actor.handle_request.remote(method, args, kwargs)
+            ref = state.actor.handle_request.remote(method, args, kwargs,
+                                                    model_id)
         except rex.ActorError:
             # replica died: release the reservation, replace it, retry
             # once on another
@@ -241,7 +326,8 @@ class _DeploymentState:
                 state.ongoing = max(0, state.ongoing - 1)
             self._replace(state)
             if _retry:
-                return self.submit(method, args, kwargs, _retry=False)
+                return self.submit(method, args, kwargs, _retry=False,
+                                   model_id=model_id)
             raise
         except BaseException:
             # any other failure (e.g. argument serialization): the call
@@ -314,6 +400,19 @@ class _DeploymentState:
             except ValueError:
                 return  # already replaced
             self._replicas.append(self._spawn())
+            self._prune_affinity_locked()
+
+    def _prune_affinity_locked(self) -> None:
+        """Drop dead replicas from the model-affinity lists (they are
+        filtered on read, but replica churn would otherwise grow them
+        — and their actor handles — without bound)."""
+        live = set(map(id, self._replicas))
+        for m, lst in list(self._model_replicas.items()):
+            kept = [r for r in lst if id(r) in live]
+            if kept:
+                self._model_replicas[m] = kept
+            else:
+                del self._model_replicas[m]
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -327,8 +426,18 @@ class DeploymentHandle:
     ray_tpu.get() them (the reference returns DeploymentResponse;
     .result() ≙ get)."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, model_id: Optional[str] = None):
         self.deployment_name = name
+        self._model_id = model_id
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        """A handle whose calls carry a multiplexed model id: the
+        router prefers replicas with that model warm, and the replica
+        reads it via serve.get_multiplexed_model_id() (reference:
+        handle.options(multiplexed_model_id=...))."""
+        return DeploymentHandle(self.deployment_name,
+                                model_id=multiplexed_model_id)
 
     def _state(self) -> _DeploymentState:
         c = _controller
@@ -338,7 +447,8 @@ class DeploymentHandle:
         return c.deployments[self.deployment_name]
 
     def remote(self, *args, **kwargs):
-        return self._state().submit("__call__", args, kwargs)
+        return self._state().submit("__call__", args, kwargs,
+                                    model_id=self._model_id)
 
     def result_of(self, *args, timeout: Optional[float] = 30.0, **kwargs):
         return ray_tpu.get(self.remote(*args, **kwargs), timeout=timeout)
@@ -349,7 +459,7 @@ class DeploymentHandle:
         return _MethodCaller(self, method)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name,))
+        return (DeploymentHandle, (self.deployment_name, self._model_id))
 
 
 def name_missing(c: "_Controller", name: str) -> bool:
@@ -362,7 +472,9 @@ class _MethodCaller:
         self._method = method
 
     def remote(self, *args, **kwargs):
-        return self._handle._state().submit(self._method, args, kwargs)
+        return self._handle._state().submit(
+            self._method, args, kwargs,
+            model_id=self._handle._model_id)
 
 
 # ----------------------------------------------------------------------

@@ -169,3 +169,80 @@ def test_actor_refs_as_args(ray_start_regular):
 
     a = Adder.remote()
     assert ray_tpu.get(a.add.remote(ref, 1)) == 42
+
+
+class TestConcurrencyGroups:
+    """Named concurrency groups (reference: ray actor
+    concurrency_groups + ray.method(concurrency_group=...)): each
+    group is its own queue + thread pool, so a saturated group never
+    blocks another's methods."""
+
+    def test_groups_isolate_blocking_methods(self, ray_start_regular):
+        import threading
+        import time
+
+        release = threading.Event()
+
+        @ray_tpu.remote(concurrency_groups={"io": 1, "compute": 2})
+        class Split:
+            @ray_tpu.method(concurrency_group="io")
+            def slow_io(self):
+                release.wait(timeout=30)
+                return "io"
+
+            @ray_tpu.method(concurrency_group="compute")
+            def fast(self, x):
+                return x * 2
+
+            def default_lane(self):
+                return "default"
+
+        a = Split.remote()
+        blocked = a.slow_io.remote()
+        t0 = time.monotonic()
+        # compute + default methods complete WHILE io is wedged
+        assert ray_tpu.get(a.fast.remote(21), timeout=30) == 42
+        assert ray_tpu.get(a.default_lane.remote(), timeout=30) == "default"
+        assert time.monotonic() - t0 < 10
+        release.set()
+        assert ray_tpu.get(blocked, timeout=30) == "io"
+        ray_tpu.kill(a)
+
+    def test_group_width_bounds_parallelism(self, ray_start_regular):
+        import threading
+
+        gate = threading.Event()
+        active = []
+        lock = threading.Lock()
+
+        @ray_tpu.remote(concurrency_groups={"pool": 2})
+        class Width:
+            @ray_tpu.method(concurrency_group="pool")
+            def work(self, i):
+                with lock:
+                    active.append(i)
+                gate.wait(timeout=30)
+                return i
+
+        a = Width.remote()
+        refs = [a.work.remote(i) for i in range(4)]
+        deadline = __import__("time").monotonic() + 10
+        while len(active) < 2 and __import__("time").monotonic() < deadline:
+            __import__("time").sleep(0.02)
+        __import__("time").sleep(0.2)
+        assert len(active) == 2  # pool width 2: third call queues
+        gate.set()
+        assert sorted(ray_tpu.get(refs, timeout=30)) == [0, 1, 2, 3]
+        ray_tpu.kill(a)
+
+    def test_unknown_group_fails_loudly(self, ray_start_regular):
+        @ray_tpu.remote(concurrency_groups={"io": 1})
+        class Bad:
+            @ray_tpu.method(concurrency_group="nope")
+            def f(self):
+                return 1
+
+        a = Bad.remote()
+        with pytest.raises(ValueError, match="unknown concurrency group"):
+            ray_tpu.get(a.f.remote(), timeout=30)
+        ray_tpu.kill(a)

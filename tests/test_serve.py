@@ -153,3 +153,74 @@ class TestAutoscaling:
                 break
             time.sleep(0.05)
         assert serve.status()["Slow"]["replicas"] == 1
+
+
+class TestMultiplexedModels:
+    """Model multiplexing (reference: @serve.multiplexed +
+    handle.options(multiplexed_model_id=...) + router model
+    affinity): replicas hold a bounded LRU of loaded models and the
+    router prefers a warm replica."""
+
+    def test_loader_lru_and_model_id(self, rt):
+        @serve.deployment(num_replicas=1)
+        class Mux:
+            def __init__(self):
+                self.loads = []
+
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                self.loads.append(model_id)
+                return f"model-{model_id}"
+
+            def __call__(self, x):
+                mid = serve.get_multiplexed_model_id()
+                model = self.get_model(mid)
+                return (model, mid, list(self.loads))
+
+        h = serve.run(Mux.bind())
+        m, mid, loads = ray_tpu.get(
+            h.options(multiplexed_model_id="a").remote(1), timeout=60)
+        assert (m, mid) == ("model-a", "a")
+        # warm hit: no reload
+        _, _, loads = ray_tpu.get(
+            h.options(multiplexed_model_id="a").remote(1), timeout=60)
+        assert loads == ["a"]
+        # b, c load; a evicts (LRU cap 2); a again -> reload
+        for mid2 in ("b", "c", "a"):
+            ray_tpu.get(h.options(
+                multiplexed_model_id=mid2).remote(1), timeout=60)
+        _, _, loads = ray_tpu.get(
+            h.options(multiplexed_model_id="a").remote(1), timeout=60)
+        assert loads == ["a", "b", "c", "a"]
+        serve.shutdown()
+
+    def test_router_prefers_warm_replica(self, rt):
+        import os
+
+        @serve.deployment(num_replicas=3)
+        class Who:
+            @serve.multiplexed(max_num_models_per_replica=4)
+            def get_model(self, model_id):
+                return model_id
+
+            def __call__(self):
+                self.get_model(serve.get_multiplexed_model_id())
+                return id(self)
+
+        h = serve.run(Who.bind())
+        hm = h.options(multiplexed_model_id="m1")
+        first = ray_tpu.get(hm.remote(), timeout=60)
+        # the SAME replica serves subsequent m1 requests (affinity)
+        for _ in range(6):
+            assert ray_tpu.get(hm.remote(), timeout=60) == first
+        serve.shutdown()
+
+    def test_no_model_id_is_none(self, rt):
+        @serve.deployment
+        class Plain:
+            def __call__(self):
+                return serve.get_multiplexed_model_id()
+
+        h = serve.run(Plain.bind())
+        assert ray_tpu.get(h.remote(), timeout=60) is None
+        serve.shutdown()
