@@ -378,18 +378,21 @@ class _ActorRuntime:
                               or rex.ActorDiedError(actor_id=self.actor_id))
             return
         inbox = self.inbox
-        if self._group_inboxes:
-            fn = getattr(self.cls, call.method_name, None)
-            group = getattr(fn, "__ray_tpu_concurrency_group__", None)
-            if group is not None:
-                named = self._group_inboxes.get(group)
-                if named is None:
-                    self._store_error(call, ValueError(
-                        f"method {call.method_name!r} routes to unknown "
-                        f"concurrency group {group!r}; declared: "
-                        f"{sorted(self._group_inboxes)}"))
-                    return
-                inbox = named
+        fn = getattr(self.cls, call.method_name, None)
+        group = getattr(fn, "__ray_tpu_concurrency_group__", None)
+        if group is not None:
+            # the tag promises isolation: an undeclared group must fail
+            # loudly even when NO groups were declared (a silently
+            # serialized "io" method is exactly the bug the tag exists
+            # to prevent)
+            named = self._group_inboxes.get(group)
+            if named is None:
+                self._store_error(call, ValueError(
+                    f"method {call.method_name!r} routes to unknown "
+                    f"concurrency group {group!r}; declared: "
+                    f"{sorted(self._group_inboxes)}"))
+                return
+            inbox = named
         limit = self.opts.get("max_pending_calls", -1)
         if limit > 0 and inbox.qsize() >= limit:
             raise rex.PendingCallsLimitExceeded(

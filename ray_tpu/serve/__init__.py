@@ -12,11 +12,12 @@ JSON HTTP ingress.
 from ray_tpu.serve.core import (Application, AutoscalingConfig,  # noqa: F401
                                 Deployment, DeploymentHandle, deployment,
                                 get_app_handle, get_multiplexed_model_id,
-                                multiplexed, run, shutdown, start_http,
-                                status)
+                                multiplexed, run, shutdown, start_grpc,
+                                start_http, status)
 
 __all__ = [
     "deployment", "run", "shutdown", "status", "get_app_handle",
     "Deployment", "DeploymentHandle", "Application", "start_http",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
+    "start_grpc",
 ]

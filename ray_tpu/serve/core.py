@@ -119,23 +119,29 @@ def multiplexed(max_num_models_per_replica: int = 3):
         import functools
 
         attr = f"_ray_tpu_mux_{loader.__name__}"
+        lock_attr = f"{attr}_lock"
 
         @functools.wraps(loader)
         def wrapped(self, model_id):
-            cache = getattr(self, attr, None)
-            if cache is None:
-                cache = collections.OrderedDict()
-                setattr(self, attr, cache)
-            if model_id in cache:
-                cache.move_to_end(model_id)
-                return cache[model_id]
-            # evict BEFORE loading: the cap is a MEMORY bound, and a
-            # cap+1 transient peak is exactly what OOMs model replicas
-            while len(cache) >= max_num_models_per_replica:
-                cache.popitem(last=False)  # evict LRU
-            model = loader(self, model_id)
-            cache[model_id] = model
-            return model
+            # replicas serve concurrently (max_concurrency > 1): the
+            # cache and its MEMORY-bound eviction must be serialized or
+            # two cold loads race past the cap check. dict.setdefault
+            # is GIL-atomic, so lazy init needs no module-level lock
+            # (which would also make the deployment class unpicklable)
+            d = self.__dict__
+            lock = d.setdefault(lock_attr, threading.Lock())
+            with lock:
+                cache = d.setdefault(attr, collections.OrderedDict())
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                # evict BEFORE loading: the cap is a MEMORY bound, and
+                # a cap+1 transient peak is exactly what OOMs replicas
+                while len(cache) >= max_num_models_per_replica:
+                    cache.popitem(last=False)  # evict LRU
+                model = loader(self, model_id)
+                cache[model_id] = model
+                return model
 
         wrapped.__ray_tpu_multiplexed__ = True
         return wrapped
@@ -160,7 +166,15 @@ class _Replica:
         fn = target if method != "__call__" else self.instance.__call__
         token = _current_model_id.set(model_id)
         try:
-            return fn(*args, **kwargs)
+            result = fn(*args, **kwargs)
+            import inspect as _inspect
+            if _inspect.isgenerator(result):
+                # the actor runtime would materialize it AFTER this
+                # finally reset the model-id contextvar — a generator
+                # body reading get_multiplexed_model_id() must run in
+                # scope
+                result = list(result)
+            return result
         finally:
             _current_model_id.reset(token)
 
@@ -486,6 +500,7 @@ class _Controller:
         self.deployments: Dict[str, _DeploymentState] = {}
         self.ingress_name: Optional[str] = None
         self.http_server = None
+        self.grpc_server = None
 
     def deploy_app(self, app: Application) -> DeploymentHandle:
         handle = self._deploy_node(app)
@@ -515,6 +530,9 @@ class _Controller:
         if self.http_server is not None:
             self.http_server.shutdown()
             self.http_server = None
+        if self.grpc_server is not None:
+            self.grpc_server.stop(grace=None)
+            self.grpc_server = None
 
 
 # ----------------------------------------------------------------------
@@ -530,7 +548,13 @@ def run(app: Application) -> DeploymentHandle:
         return _controller.deploy_app(app)
 
 
-def get_app_handle(name: str) -> DeploymentHandle:
+def get_app_handle(name: Optional[str] = None) -> DeploymentHandle:
+    """Handle for a deployment by name, or for the APP INGRESS (the
+    deployment run() was last called with) when name is omitted."""
+    if name is None:
+        if _controller is None or _controller.ingress_name is None:
+            raise rex.RayTpuError("no application is running")
+        name = _controller.ingress_name
     if _controller is None or name not in _controller.deployments:
         raise rex.RayTpuError(f"no deployment named {name!r}")
     return DeploymentHandle(name)
@@ -553,6 +577,29 @@ def shutdown() -> None:
         if _controller is not None:
             _controller.shutdown()
             _controller = None
+
+
+def _sticky_stream_frames(state: _DeploymentState, prompt,
+                          max_new_tokens, start_timeout: float = 60.0,
+                          poll_timeout: float = 120.0):
+    """Token-burst frames of the replica-sticky streaming protocol
+    (start_stream / next_tokens until done) — the ONE driver both the
+    HTTP SSE route and the gRPC PredictStream wrap. Sticky: every poll
+    must hit the replica holding the stream; the session releases on
+    EVERY exit path, including a consumer that stops iterating."""
+    ref, token = state.submit_sticky(
+        "start_stream", (prompt, max_new_tokens), {})
+    try:
+        sid = ray_tpu.get(ref, timeout=start_timeout)
+        while True:
+            ref, _ = state.submit_sticky("next_tokens", (sid,), {},
+                                         session=token)
+            r = ray_tpu.get(ref, timeout=poll_timeout)
+            yield r
+            if r.get("done"):
+                return
+    finally:
+        state.end_sticky(token)
 
 
 # ----------------------------------------------------------------------
@@ -597,42 +644,39 @@ def start_http(port: int = 0) -> int:
         def _do_stream(self, name: str) -> None:
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length) if length else b"null"
-            state = token = None
             try:
                 payload = json.loads(body) or {}
-                state = get_app_handle(name)._state()
-                # sticky: every poll must hit the replica holding the
-                # stream — load-balanced polls would land on replicas
-                # that never heard of it
-                ref, token = state.submit_sticky(
-                    "start_stream",
-                    (payload.get("prompt"),
-                     payload.get("max_new_tokens")), {})
-                sid = ray_tpu.get(ref, timeout=60)
+                frames = _sticky_stream_frames(
+                    get_app_handle(name)._state(),
+                    payload.get("prompt"),
+                    payload.get("max_new_tokens"))
+                # pull the FIRST burst before committing to SSE: a
+                # failed stream start must answer 500 JSON, not a
+                # half-open event stream
+                first = next(frames, None)
             except Exception as e:  # noqa: BLE001
-                if state is not None and token is not None:
-                    state.end_sticky(token)
                 self._json_response(500, {"error": str(e)})
                 return
-            try:   # sticky session releases on EVERY exit, including a
-                   # client that hangs up during the header write
+            try:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.end_headers()  # no Content-Length: stream to close
-                while True:
-                    ref, _ = state.submit_sticky(
-                        "next_tokens", (sid,), {}, session=token)
-                    r = ray_tpu.get(ref, timeout=120)
+
+                def emit(r) -> None:
                     self.wfile.write(
                         f"data: {json.dumps(r)}\n\n".encode())
                     self.wfile.flush()
-                    if r.get("done"):
-                        return
+
+                if first is not None:
+                    emit(first)
+                for r in frames:
+                    emit(r)
             except Exception as e:  # noqa: BLE001
                 # a final error event: the client must be able to tell
                 # a server-side failure from a complete stream or a
                 # network drop (best effort; the socket may be gone)
+                frames.close()  # releases the sticky session
                 try:
                     self.wfile.write(
                         f"data: {json.dumps({'error': str(e), 'done': True})}"
@@ -641,8 +685,6 @@ def start_http(port: int = 0) -> int:
                 except Exception:
                     pass
                 return
-            finally:
-                state.end_sticky(token)
 
         def log_message(self, *a):
             pass
@@ -654,5 +696,84 @@ def start_http(port: int = 0) -> int:
         global _controller
         if _controller is None:
             _controller = _Controller()
+        if _controller.http_server is not None:
+            # a second start must not orphan a live listener that
+            # shutdown() could never reach
+            _controller.http_server.shutdown()
         _controller.http_server = httpd
     return httpd.server_port
+
+
+# ----------------------------------------------------------------------
+# gRPC ingress (reference: serve's gRPC proxy — grpc_util/
+# grpcServiceProxy; here a generic-handler service speaking JSON
+# payloads, so no codegen toolchain is required: the wire contract is
+# the method names below + JSON bytes, and a .proto schema could land
+# behind the same names without touching callers of start_grpc)
+# ----------------------------------------------------------------------
+
+GRPC_SERVICE = "ray_tpu.serve.Ingress"
+
+
+def start_grpc(port: int = 0, max_workers: int = 8) -> int:
+    """gRPC ingress on 127.0.0.1:
+
+    /ray_tpu.serve.Ingress/Predict (unary): request bytes = JSON
+    {"deployment"?: name, "input": payload, "multiplexed_model_id"?:
+    id} -> reply JSON {"result": ...} (the app ingress serves when
+    deployment is omitted).
+
+    /ray_tpu.serve.Ingress/PredictStream (server-streaming): request
+    JSON {"deployment"?, "prompt", "max_new_tokens"?} -> one JSON
+    frame per token burst, same replica-sticky poll protocol as the
+    HTTP SSE route. Returns the bound port."""
+    from concurrent import futures as _futures
+
+    import grpc
+
+    def _handle_of(payload):
+        name = (payload or {}).get("deployment")
+        return get_app_handle(name) if name else get_app_handle()
+
+    def predict(request: bytes, context) -> bytes:
+        try:
+            payload = json.loads(request or b"null") or {}
+            handle = _handle_of(payload)
+            mid = payload.get("multiplexed_model_id")
+            if mid is not None:
+                handle = handle.options(multiplexed_model_id=mid)
+            result = ray_tpu.get(handle.remote(payload.get("input")),
+                                 timeout=30)
+            return json.dumps({"result": result}).encode()
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def predict_stream(request: bytes, context):
+        try:
+            payload = json.loads(request or b"null") or {}
+            state = _handle_of(payload)._state()
+            for r in _sticky_stream_frames(
+                    state, payload.get("prompt"),
+                    payload.get("max_new_tokens")):
+                yield json.dumps(r).encode()
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    handler = grpc.method_handlers_generic_handler(GRPC_SERVICE, {
+        "Predict": grpc.unary_unary_rpc_method_handler(predict),
+        "PredictStream": grpc.unary_stream_rpc_method_handler(
+            predict_stream),
+    })
+    server = grpc.server(_futures.ThreadPoolExecutor(
+        max_workers=max_workers))
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    with _lock:
+        global _controller
+        if _controller is None:
+            _controller = _Controller()
+        if _controller.grpc_server is not None:
+            _controller.grpc_server.stop(grace=None)
+        _controller.grpc_server = server
+    return bound

@@ -224,3 +224,78 @@ class TestMultiplexedModels:
         h = serve.run(Plain.bind())
         assert ray_tpu.get(h.remote(), timeout=60) is None
         serve.shutdown()
+
+
+class TestGrpcIngress:
+    """JSON-over-gRPC ingress (reference: serve's gRPC proxy): a
+    generic-handler service — Predict (unary) and PredictStream
+    (server-streaming, replica-sticky poll protocol)."""
+
+    def test_predict_unary(self, rt):
+        grpc = pytest.importorskip("grpc")
+
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def __call__(self, x):
+                return {"echo": x}
+
+        serve.run(Echo.bind())
+        port = serve.start_grpc()
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = chan.unary_unary("/ray_tpu.serve.Ingress/Predict")
+        reply = json.loads(call(json.dumps({"input": [1, 2]}).encode()))
+        assert reply == {"result": {"echo": [1, 2]}}
+        # named deployment + multiplexed model id
+        reply = json.loads(call(json.dumps(
+            {"deployment": "Echo", "input": "hi"}).encode()))
+        assert reply == {"result": {"echo": "hi"}}
+        chan.close()
+        serve.shutdown()
+
+    def test_predict_error_maps_to_status(self, rt):
+        grpc = pytest.importorskip("grpc")
+
+        @serve.deployment
+        class Boom:
+            def __call__(self, x):
+                raise ValueError("grpc kapow")
+
+        serve.run(Boom.bind())
+        port = serve.start_grpc()
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = chan.unary_unary("/ray_tpu.serve.Ingress/Predict")
+        with pytest.raises(grpc.RpcError) as err:
+            call(json.dumps({"input": 1}).encode())
+        assert "kapow" in err.value.details()
+        chan.close()
+        serve.shutdown()
+
+    def test_predict_stream(self, rt):
+        grpc = pytest.importorskip("grpc")
+
+        @serve.deployment
+        class Tok:
+            def __init__(self):
+                self.streams = {}
+
+            def start_stream(self, prompt, max_new_tokens=None):
+                self.streams["s1"] = list(prompt or "abc")
+                return "s1"
+
+            def next_tokens(self, sid):
+                toks = self.streams[sid]
+                if not toks:
+                    return {"tokens": [], "done": True}
+                return {"tokens": [toks.pop(0)], "done": not toks}
+
+        serve.run(Tok.bind())
+        port = serve.start_grpc()
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = chan.unary_stream("/ray_tpu.serve.Ingress/PredictStream")
+        frames = [json.loads(f) for f in
+                  call(json.dumps({"prompt": "xyz"}).encode())]
+        toks = [t for fr in frames for t in fr["tokens"]]
+        assert toks == ["x", "y", "z"]
+        assert frames[-1]["done"]
+        chan.close()
+        serve.shutdown()
