@@ -236,8 +236,6 @@ def main() -> int:
         traceback.print_exc()
         out["llm_decode"] = None
 
-    import os
-
     # context: process-worker throughput is HOST-core bound (N worker
     # processes on a 1-core host serialize on IPC); report the cores so
     # the number reads honestly

@@ -99,6 +99,19 @@ class _ActorRuntime:
             g: queue.Queue() for g in groups}
         self._group_sizes: Dict[str, int] = {
             g: max(1, int(n)) for g, n in groups.items()}
+        # method -> target inbox (or the unknown-group ValueError),
+        # resolved ONCE: submit() sits on the actor-call hot path and
+        # routing is static at class+options time
+        self._route: Dict[str, Any] = {}
+        for mname, m in inspect.getmembers(cls, callable):
+            g = getattr(m, "__ray_tpu_concurrency_group__", None)
+            if g is None:
+                continue
+            target = self._group_inboxes.get(g)
+            self._route[mname] = target if target is not None else \
+                ValueError(
+                    f"method {mname!r} routes to unknown concurrency "
+                    f"group {g!r}; declared: {sorted(groups)}")
         self.init_done = threading.Event()
         self.death_cause: Optional[BaseException] = None
         self.num_restarts = 0
@@ -378,21 +391,17 @@ class _ActorRuntime:
                               or rex.ActorDiedError(actor_id=self.actor_id))
             return
         inbox = self.inbox
-        fn = getattr(self.cls, call.method_name, None)
-        group = getattr(fn, "__ray_tpu_concurrency_group__", None)
-        if group is not None:
-            # the tag promises isolation: an undeclared group must fail
-            # loudly even when NO groups were declared (a silently
-            # serialized "io" method is exactly the bug the tag exists
-            # to prevent)
-            named = self._group_inboxes.get(group)
-            if named is None:
-                self._store_error(call, ValueError(
-                    f"method {call.method_name!r} routes to unknown "
-                    f"concurrency group {group!r}; declared: "
-                    f"{sorted(self._group_inboxes)}"))
+        if self._route:
+            target = self._route.get(call.method_name)
+            if isinstance(target, ValueError):
+                # the tag promises isolation: an undeclared group must
+                # fail loudly even when NO groups were declared (a
+                # silently serialized "io" method is exactly the bug
+                # the tag exists to prevent)
+                self._store_error(call, target)
                 return
-            inbox = named
+            if target is not None:
+                inbox = target
         limit = self.opts.get("max_pending_calls", -1)
         if limit > 0 and inbox.qsize() >= limit:
             raise rex.PendingCallsLimitExceeded(

@@ -124,24 +124,54 @@ def multiplexed(max_num_models_per_replica: int = 3):
         @functools.wraps(loader)
         def wrapped(self, model_id):
             # replicas serve concurrently (max_concurrency > 1): the
-            # cache and its MEMORY-bound eviction must be serialized or
-            # two cold loads race past the cap check. dict.setdefault
-            # is GIL-atomic, so lazy init needs no module-level lock
-            # (which would also make the deployment class unpicklable)
+            # cache and its MEMORY-bound eviction serialize under a
+            # lock, but the LOAD itself runs outside it (a cold load
+            # takes seconds for real models and must not block warm
+            # hits). A placeholder event reserves the slot so the cap
+            # is never exceeded and duplicate loads coalesce.
+            # dict.setdefault is GIL-atomic, so lazy init needs no
+            # module-level lock (which would also make the deployment
+            # class unpicklable).
             d = self.__dict__
             lock = d.setdefault(lock_attr, threading.Lock())
-            with lock:
-                cache = d.setdefault(attr, collections.OrderedDict())
-                if model_id in cache:
-                    cache.move_to_end(model_id)
-                    return cache[model_id]
-                # evict BEFORE loading: the cap is a MEMORY bound, and
-                # a cap+1 transient peak is exactly what OOMs replicas
-                while len(cache) >= max_num_models_per_replica:
-                    cache.popitem(last=False)  # evict LRU
+            while True:
+                with lock:
+                    cache = d.setdefault(attr, collections.OrderedDict())
+                    entry = cache.get(model_id)
+                    if entry is not None and not isinstance(
+                            entry, threading.Event):
+                        cache.move_to_end(model_id)
+                        return entry
+                    if entry is None:
+                        # evict BEFORE loading: the cap is a MEMORY
+                        # bound; a cap+1 peak is exactly what OOMs.
+                        # In-flight loaders are never evicted (their
+                        # waiters hold the event) — oldest LOADED
+                        # models go first
+                        while len(cache) >= max_num_models_per_replica:
+                            victim = next(
+                                (k for k, v in cache.items()
+                                 if not isinstance(v, threading.Event)),
+                                None)
+                            if victim is None:
+                                break  # all mid-load: cap waits on them
+                            cache.pop(victim)
+                        placeholder = threading.Event()
+                        cache[model_id] = placeholder
+                        break
+                # another thread is loading this model: wait, re-check
+                entry.wait(timeout=600)
+            try:
                 model = loader(self, model_id)
+            except BaseException:
+                with lock:
+                    cache.pop(model_id, None)
+                placeholder.set()
+                raise
+            with lock:
                 cache[model_id] = model
-                return model
+            placeholder.set()
+            return model
 
         wrapped.__ray_tpu_multiplexed__ = True
         return wrapped
@@ -529,6 +559,7 @@ class _Controller:
         self.deployments.clear()
         if self.http_server is not None:
             self.http_server.shutdown()
+            self.http_server.server_close()  # release the listen socket
             self.http_server = None
         if self.grpc_server is not None:
             self.grpc_server.stop(grace=None)
@@ -700,6 +731,7 @@ def start_http(port: int = 0) -> int:
             # a second start must not orphan a live listener that
             # shutdown() could never reach
             _controller.http_server.shutdown()
+            _controller.http_server.server_close()
         _controller.http_server = httpd
     return httpd.server_port
 

@@ -245,10 +245,28 @@ class TestGrpcIngress:
         call = chan.unary_unary("/ray_tpu.serve.Ingress/Predict")
         reply = json.loads(call(json.dumps({"input": [1, 2]}).encode()))
         assert reply == {"result": {"echo": [1, 2]}}
-        # named deployment + multiplexed model id
+        # named deployment
         reply = json.loads(call(json.dumps(
             {"deployment": "Echo", "input": "hi"}).encode()))
         assert reply == {"result": {"echo": "hi"}}
+        chan.close()
+        serve.shutdown()
+
+    def test_predict_forwards_model_id(self, rt):
+        grpc = pytest.importorskip("grpc")
+
+        @serve.deployment
+        class Mid:
+            def __call__(self, x):
+                return serve.get_multiplexed_model_id()
+
+        serve.run(Mid.bind())
+        port = serve.start_grpc()
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = chan.unary_unary("/ray_tpu.serve.Ingress/Predict")
+        reply = json.loads(call(json.dumps(
+            {"input": 1, "multiplexed_model_id": "m-7"}).encode()))
+        assert reply == {"result": "m-7"}
         chan.close()
         serve.shutdown()
 
