@@ -13,8 +13,12 @@ group; scaling the learner is a sharding annotation, not more actors.
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.env import CartPoleEnv  # noqa: F401
-from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.impala import (APPO, APPOConfig,  # noqa: F401
+                                  IMPALA, IMPALAConfig)
+from ray_tpu.rllib.offline import (BC, BCConfig,  # noqa: F401
+                                   collect_episodes)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 
 __all__ = ["PPOConfig", "PPO", "DQNConfig", "DQN", "IMPALAConfig",
-           "IMPALA", "CartPoleEnv"]
+           "IMPALA", "APPOConfig", "APPO", "BCConfig", "BC",
+           "collect_episodes", "CartPoleEnv"]

@@ -32,7 +32,8 @@ from ray_tpu.rllib.ppo import _EnvRunner, _policy_apply, _policy_init
 
 def _make_update(lr: float, gamma: float, vf_coeff: float,
                  ent_coeff: float, max_grad_norm: float,
-                 rho_bar: float, c_bar: float):
+                 rho_bar: float, c_bar: float,
+                 clip: float = 0.0):
     import jax
     import jax.numpy as jnp
     import optax
@@ -77,7 +78,18 @@ def _make_update(lr: float, gamma: float, vf_coeff: float,
                             jax.lax.stop_gradient(values),
                             jax.lax.stop_gradient(last_value),
                             rewards, dones)
-        pi_loss = -(jax.lax.stop_gradient(pg_adv) * target_logp).mean()
+        adv = jax.lax.stop_gradient(pg_adv)
+        if clip:
+            # APPO: PPO's clipped surrogate on the V-trace-corrected
+            # advantages (reference: rllib/algorithms/appo/ — the
+            # async PPO variant riding the IMPALA architecture)
+            ratio = jnp.exp(target_logp - behavior_logp)
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            pi_loss = -surr.mean()
+        else:
+            pi_loss = -(adv * target_logp).mean()
         vf_loss = jnp.square(values - jax.lax.stop_gradient(vs)).mean()
         entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
         total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
@@ -110,6 +122,7 @@ class IMPALAConfig:
     max_grad_norm: float = 40.0
     rho_bar: float = 1.0             # V-trace rho clip
     c_bar: float = 1.0               # V-trace c clip
+    clip: float = 0.0                # >0: APPO's clipped surrogate
     updates_per_iter: int = 8        # rollouts consumed per train()
     sample_timeout_s: float = 120.0
     seed: int = 0
@@ -142,7 +155,8 @@ class IMPALA:
                                    config.hidden)
         self._optimizer, self._update = _make_update(
             config.lr, config.gamma, config.vf_coeff, config.ent_coeff,
-            config.max_grad_norm, config.rho_bar, config.c_bar)
+            config.max_grad_norm, config.rho_bar, config.c_bar,
+            clip=config.clip)
         self.opt_state = self._optimizer.init(self.params)
         self.iteration = 0
         from ray_tpu.rllib.runner_group import RunnerGroup
@@ -233,3 +247,19 @@ class IMPALA:
     def stop(self) -> None:
         self._inflight.clear()
         self._group.stop()
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    """Async PPO (reference: rllib/algorithms/appo/): the IMPALA
+    architecture — async runners, V-trace correction — with PPO's
+    clipped surrogate objective on the corrected advantages."""
+
+    clip: float = 0.2
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    pass

@@ -1,0 +1,156 @@
+"""Offline RL: episode datasets + behavior cloning.
+
+Reference surface: rllib's offline stack (ray: rllib/offline/ —
+dataset readers/writers feeding offline algorithms like BC/CQL/MARWIL
+through ray.data). Minimum-viable parity, TPU-first: transitions live
+in a ray_tpu.data Dataset (so recording, shuffling, and ingestion ride
+the columnar data plane), and the BC learner is one jitted
+negative-log-likelihood update on the same policy network the online
+algorithms use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.ppo import _policy_apply, _policy_init
+
+
+def collect_episodes(env_maker, policy_fn, num_episodes: int,
+                     seed: int = 0):
+    """Roll ``policy_fn(obs) -> action`` in the env and return a
+    ray_tpu.data Dataset of transition rows {obs, action, reward,
+    done} (reference: rllib output writers producing SampleBatch
+    datasets)."""
+    from ray_tpu import data
+
+    rows: List[Dict[str, Any]] = []
+    for ep in range(num_episodes):
+        env = env_maker(seed + ep)
+        obs = env.reset()
+        done = False
+        while not done:
+            action = int(policy_fn(obs))
+            nobs, reward, done = env.step(action)
+            rows.append({"obs": [float(x) for x in obs],
+                         "action": action,
+                         "reward": float(reward),
+                         "done": bool(done)})
+            obs = nobs
+    return data.from_items(rows, parallelism=max(1, num_episodes // 4))
+
+
+@dataclasses.dataclass
+class BCConfig:
+    """Behavior cloning from a transition dataset (reference:
+    rllib/algorithms/bc/)."""
+
+    dataset: Any = None              # ray_tpu.data Dataset of rows
+    env_maker: Any = None            # for evaluate(); default CartPole
+    hidden: int = 32
+    lr: float = 1e-2
+    batch_size: int = 256
+    seed: int = 0
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    """Supervised imitation: maximize log pi(action | obs) over the
+    dataset. One jitted update; the policy network is the SAME MLP the
+    online algorithms train, so a cloned policy drops into their
+    evaluation path."""
+
+    def __init__(self, config: BCConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        if config.dataset is None:
+            raise ValueError("BCConfig.dataset is required")
+        self.config = config
+        if config.env_maker is not None:
+            self._env_maker = config.env_maker
+        else:
+            from ray_tpu.rllib.env import CartPoleEnv
+
+            self._env_maker = lambda seed: CartPoleEnv(seed)
+        env = self._env_maker(0)
+        self._obs_dim = env.observation_dim
+        self._num_actions = env.num_actions
+        self.params = _policy_init(jax.random.PRNGKey(config.seed),
+                                   self._obs_dim, self._num_actions,
+                                   config.hidden)
+        optimizer = optax.adam(config.lr)
+        self.opt_state = optimizer.init(self.params)
+
+        def loss_fn(params, obs, actions):
+            logits, _v = _policy_apply(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, actions[:, None],
+                                       axis=-1)[:, 0]
+            return nll.mean()
+
+        @jax.jit
+        def update(params, opt_state, obs, actions):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs,
+                                                      actions)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = update
+        # jit ONCE: evaluate() in a loop must hit the compile cache
+        self._apply = jax.jit(_policy_apply)
+        self.iteration = 0
+        # materialize ONCE into arrays; epochs reshuffle indices
+        rows = config.dataset.take_all()
+        self._obs = np.asarray([r["obs"] for r in rows], np.float32)
+        self._actions = np.asarray([r["action"] for r in rows], np.int32)
+        self._rng = np.random.default_rng(config.seed)
+
+    def train(self) -> Dict[str, Any]:
+        """One epoch over the dataset in shuffled minibatches."""
+        import jax.numpy as jnp
+
+        n = len(self._obs)
+        idx = self._rng.permutation(n)
+        bs = self.config.batch_size
+        losses = []
+        for i in range(0, n, bs):
+            mb = idx[i:i + bs]
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state,
+                jnp.asarray(self._obs[mb]),
+                jnp.asarray(self._actions[mb]))
+            losses.append(float(loss))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "num_samples": n,
+                "loss": float(np.mean(losses))}
+
+    def evaluate(self, num_episodes: int = 10,
+                 seed: int = 10_000) -> Dict[str, Any]:
+        """Greedy rollouts of the cloned policy."""
+        import jax.numpy as jnp
+
+        apply = self._apply
+        returns = []
+        for ep in range(num_episodes):
+            env = self._env_maker(seed + ep)
+            obs = env.reset()
+            done = False
+            total = 0.0
+            while not done:
+                logits, _v = apply(self.params,
+                                   jnp.asarray(obs, jnp.float32))
+                obs, r, done = env.step(int(np.argmax(logits)))
+                total += r
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": num_episodes}

@@ -148,19 +148,28 @@ def multiplexed(max_num_models_per_replica: int = 3):
                         # In-flight loaders are never evicted (their
                         # waiters hold the event) — oldest LOADED
                         # models go first
+                        stalled = None
                         while len(cache) >= max_num_models_per_replica:
                             victim = next(
                                 (k for k, v in cache.items()
                                  if not isinstance(v, threading.Event)),
                                 None)
                             if victim is None:
-                                break  # all mid-load: cap waits on them
+                                # EVERY slot is mid-load: the cap must
+                                # hold, so wait for one to finish and
+                                # re-enter (no placeholder inserted)
+                                stalled = next(iter(cache.values()))
+                                break
                             cache.pop(victim)
-                        placeholder = threading.Event()
-                        cache[model_id] = placeholder
-                        break
-                # another thread is loading this model: wait, re-check
-                entry.wait(timeout=600)
+                        if stalled is None:
+                            placeholder = threading.Event()
+                            cache[model_id] = placeholder
+                            break
+                    else:
+                        stalled = entry
+                # a loader is in flight (this model's, or — at cap —
+                # someone else's): wait outside the lock, re-check
+                stalled.wait(timeout=600)
             try:
                 model = loader(self, model_id)
             except BaseException:
