@@ -15,10 +15,15 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.env import CartPoleEnv  # noqa: F401
 from ray_tpu.rllib.impala import (APPO, APPOConfig,  # noqa: F401
                                   IMPALA, IMPALAConfig)
+from ray_tpu.rllib.multi_agent import (IndependentCartPoles,  # noqa: F401
+                                       MultiAgentEnv, MultiAgentPPO,
+                                       MultiAgentPPOConfig)
 from ray_tpu.rllib.offline import (BC, BCConfig,  # noqa: F401
                                    collect_episodes)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 
 __all__ = ["PPOConfig", "PPO", "DQNConfig", "DQN", "IMPALAConfig",
            "IMPALA", "APPOConfig", "APPO", "BCConfig", "BC",
-           "collect_episodes", "CartPoleEnv"]
+           "collect_episodes", "CartPoleEnv", "MultiAgentEnv",
+           "MultiAgentPPOConfig", "MultiAgentPPO",
+           "IndependentCartPoles"]

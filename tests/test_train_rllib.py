@@ -365,3 +365,63 @@ class TestOfflineBC:
         # random play scores ~20; a competent clone of this expert
         # scores far higher
         assert ev["episode_return_mean"] > 60, ev
+
+
+class TestMultiAgent:
+    """Multi-agent stack (reference: rllib MultiAgentEnv +
+    multi_agent(policies=..., policy_mapping_fn=...)): per-agent
+    transitions route to their policy's learner; agents may share one
+    policy (parameter sharing) or train separate ones."""
+
+    def test_shared_policy_improves(self, rt):
+        from ray_tpu.rllib import MultiAgentPPOConfig
+
+        algo = MultiAgentPPOConfig(num_env_runners=2,
+                                   num_envs_per_runner=4,
+                                   rollout_len=128, seed=0).build()
+        try:
+            first = None
+            best = 0.0
+            for _ in range(16):
+                m = algo.train()
+                if m["num_episodes"]:
+                    if first is None:
+                        first = m["episode_return_mean"]
+                    best = max(best, m["episode_return_mean"])
+                if first is not None and best > 2.0 * max(first, 15):
+                    break
+            assert first is not None
+            assert best > max(first, 15) * 1.5, (first, best)
+            assert "loss_shared" in m
+        finally:
+            algo.stop()
+
+    def test_per_agent_policies_train_separately(self, rt):
+        from ray_tpu.rllib import MultiAgentPPOConfig
+        import numpy as np
+
+        algo = MultiAgentPPOConfig(
+            policies={"p0": (4, 2), "p1": (4, 2)},
+            policy_mapping_fn=lambda aid: "p0" if aid == "a0" else "p1",
+            num_env_runners=1, num_envs_per_runner=2,
+            rollout_len=32, seed=1).build()
+        try:
+            p0_before = [np.asarray(x) for x in
+                         __import__("jax").tree_util.tree_leaves(
+                             algo.params["p0"])]
+            m = algo.train()
+            assert "loss_p0" in m and "loss_p1" in m
+            p0_after = __import__("jax").tree_util.tree_leaves(
+                algo.params["p0"])
+            assert any(not np.array_equal(a, np.asarray(b))
+                       for a, b in zip(p0_before, p0_after))
+        finally:
+            algo.stop()
+
+    def test_unknown_policy_mapping_fails_loudly(self, rt):
+        from ray_tpu.rllib import MultiAgentPPOConfig
+
+        with pytest.raises(ValueError, match="undeclared"):
+            MultiAgentPPOConfig(
+                policies={"only": (4, 2)},
+                policy_mapping_fn=lambda aid: "typo").build()
