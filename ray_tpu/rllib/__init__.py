@@ -12,6 +12,9 @@ group; scaling the learner is a sharding annotation, not more actors.
 """
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.connectors import (Connector,  # noqa: F401
+                                      ConnectorPipeline, Lambda,
+                                      ObsNormalizer)
 from ray_tpu.rllib.env import CartPoleEnv  # noqa: F401
 from ray_tpu.rllib.impala import (APPO, APPOConfig,  # noqa: F401
                                   IMPALA, IMPALAConfig)
@@ -26,4 +29,5 @@ __all__ = ["PPOConfig", "PPO", "DQNConfig", "DQN", "IMPALAConfig",
            "IMPALA", "APPOConfig", "APPO", "BCConfig", "BC",
            "collect_episodes", "CartPoleEnv", "MultiAgentEnv",
            "MultiAgentPPOConfig", "MultiAgentPPO",
-           "IndependentCartPoles"]
+           "IndependentCartPoles", "Connector", "ConnectorPipeline",
+           "Lambda", "ObsNormalizer"]

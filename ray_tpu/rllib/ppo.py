@@ -55,11 +55,15 @@ def _policy_init(rng, obs_dim: int, num_actions: int, hidden: int):
 @ray_tpu.remote
 class _EnvRunner:
     def __init__(self, env_maker, num_envs: int, rollout_len: int,
-                 seed: int):
+                 seed: int, connectors=None):
         import jax
 
         self.envs = [env_maker(seed * 1000 + i) for i in range(num_envs)]
         self.obs = np.stack([e.reset() for e in self.envs])
+        # env-to-module connector pipeline (rllib ConnectorV2 analog):
+        # observations transform before the module forward AND before
+        # buffering, so the learner sees exactly what the policy saw
+        self.connectors = connectors
         self.rollout_len = rollout_len
         self.episode_returns: List[float] = []
         self.running = np.zeros(len(self.envs))
@@ -68,15 +72,24 @@ class _EnvRunner:
         # trace/compile cache every rollout
         self._apply = jax.jit(_policy_apply)
 
-    def sample(self, params) -> Dict[str, Any]:
+    def sample(self, params, connector_state=None) -> Dict[str, Any]:
         """One rollout with the given policy params: batch arrays +
-        completed-episode returns."""
+        completed-episode returns (+ this runner's connector-state
+        delta when a pipeline is configured)."""
         import jax.numpy as jnp
 
         apply = self._apply
+        pipeline = self.connectors
+        prior = connector_state
+        delta = None
+        if pipeline is not None:
+            if prior is None:
+                prior = pipeline.init_state()
+            delta = pipeline.init_state()
         T, N = self.rollout_len, len(self.envs)
-        obs_buf = np.zeros((T, N, self.envs[0].observation_dim),
-                           np.float32)
+        # obs_buf allocates from the FIRST transformed batch: a
+        # connector may change the observation shape
+        obs_buf = None
         act_buf = np.zeros((T, N), np.int32)
         logp_buf = np.zeros((T, N), np.float32)
         val_buf = np.zeros((T, N), np.float32)
@@ -85,14 +98,20 @@ class _EnvRunner:
         self.episode_returns = []
 
         for t in range(T):
-            logits, value = apply(params, jnp.asarray(self.obs))
+            step_obs = self.obs
+            if pipeline is not None:
+                step_obs, delta = pipeline.observe_and_transform(
+                    self.obs, prior, delta)
+            if obs_buf is None:
+                obs_buf = np.zeros((T,) + np.shape(step_obs), np.float32)
+            logits, value = apply(params, jnp.asarray(step_obs))
             logits = np.asarray(logits)
             value = np.asarray(value)
             # sample from the categorical
             u = self.rng.gumbel(size=logits.shape)
             actions = np.argmax(logits + u, axis=-1)
             logp_all = logits - _logsumexp(logits)
-            obs_buf[t] = self.obs
+            obs_buf[t] = step_obs
             act_buf[t] = actions
             logp_buf[t] = logp_all[np.arange(N), actions]
             val_buf[t] = value
@@ -107,16 +126,23 @@ class _EnvRunner:
                     nobs = env.reset()
                 self.obs[i] = nobs
 
-        _, last_val = apply(params, jnp.asarray(self.obs))
-        return {
+        last_obs = self.obs
+        if pipeline is not None:
+            last_obs = pipeline.transform(
+                self.obs, pipeline.effective(prior, delta))
+        _, last_val = apply(params, jnp.asarray(last_obs))
+        out = {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
             "last_values": np.asarray(last_val),
             # the observation AFTER the rollout: off-policy learners
             # (IMPALA) bootstrap it under the TARGET params
-            "last_obs": np.copy(self.obs),
+            "last_obs": np.copy(last_obs),
             "episode_returns": list(self.episode_returns),
         }
+        if pipeline is not None:
+            out["connector_state"] = delta  # DELTA only; driver merges
+        return out
 
 
 def _logsumexp(x):
@@ -199,6 +225,11 @@ class PPOConfig:
     max_grad_norm: float = 0.5
     num_epochs: int = 4
     minibatches: int = 4
+    # env-to-module connector pipeline (reference: ConnectorV2):
+    # list of rllib.connectors.Connector applied to observations in
+    # every runner; stateful connectors merge exactly after each
+    # collect round
+    obs_connectors: Any = None
     seed: int = 0
 
     def build(self) -> "PPO":
@@ -229,10 +260,18 @@ class PPO:
         self.iteration = 0
         from ray_tpu.rllib.runner_group import RunnerGroup
         cfg2 = self.config
+        self._pipeline = None
+        self._connector_state = None
+        if cfg2.obs_connectors:
+            from ray_tpu.rllib.connectors import ConnectorPipeline
+
+            self._pipeline = ConnectorPipeline(list(cfg2.obs_connectors))
+            self._connector_state = self._pipeline.init_state()
+        pipeline = self._pipeline
         self._group = RunnerGroup(
             _EnvRunner,
             lambda seed: (self._env_maker, cfg2.num_envs_per_runner,
-                          cfg2.rollout_len, seed),
+                          cfg2.rollout_len, seed, pipeline),
             cfg2.num_env_runners, cfg2.seed)
 
     @property
@@ -241,10 +280,22 @@ class PPO:
 
     def _collect(self) -> List[Dict[str, Any]]:
         """Fan the current params out, gather rollouts; dead runners
-        respawn and re-sample (rllib/runner_group.py)."""
+        respawn and re-sample (rllib/runner_group.py). Connector-state
+        deltas merge exactly (parallel Welford) and the merged state
+        ships with the NEXT round's params."""
         params_ref = ray_tpu.put(self.params)
-        return self._group.collect(
-            lambda r: r.sample.remote(params_ref))
+        cstate = self._connector_state
+        batches = self._group.collect(
+            lambda r: r.sample.remote(params_ref, cstate))
+        if self._pipeline is not None:
+            deltas = [b["connector_state"] for b in batches
+                      if "connector_state" in b]
+            if deltas:
+                # prior + disjoint per-runner deltas: exact parallel-
+                # Welford combine, identical to one single stream
+                self._connector_state = self._pipeline.merge(
+                    [self._connector_state] + deltas)
+        return batches
 
     def train(self) -> Dict[str, Any]:
         """One iteration: sample -> GAE -> minibatched PPO epochs."""
