@@ -197,9 +197,56 @@ class Dataset:
             total = total + builtins.sum(blk.iter_block_rows(b))
         return total
 
-    def iter_batches(self) -> Iterator[Any]:
-        """Blocks in their native format (lists or pyarrow Tables)."""
-        yield from self._execute()
+    def iter_batches(self, *, batch_size: Optional[int] = None,
+                     batch_format: str = "default") -> Iterator[Any]:
+        """Batches in the requested format (reference:
+        Dataset.iter_batches): by default, blocks in their native
+        format (lists or pyarrow Tables); batch_size re-slices blocks
+        (batches do not cross block boundaries); batch_format
+        "pyarrow"/"pandas"/"numpy" converts each batch."""
+        from ray_tpu.data import block as blk
+
+        for b in self._execute():
+            if batch_size is None:
+                yield blk.to_batch_format(b, batch_format)
+                continue
+            n = blk.block_rows(b)
+            for i in builtins.range(0, n, batch_size):
+                piece = blk.block_slice(b, i, min(i + batch_size, n))
+                yield blk.to_batch_format(piece, batch_format)
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = None,
+                           dtypes=None, device=None) -> Iterator[Any]:
+        """Batches as dicts of torch tensors (reference:
+        Dataset.iter_torch_batches) — numpy columns convert zero-copy
+        via torch.from_numpy; dtypes maps column name -> torch dtype."""
+        import torch
+
+        def to_tensor(v):
+            if v.dtype.kind in "iufb":
+                # zero-copy views out of the shm arena are read-only;
+                # torch requires writable memory, so only those copy
+                t = torch.from_numpy(v if v.flags.writeable
+                                     else v.copy())
+            else:
+                t = torch.as_tensor(v.tolist())
+            if device is not None:
+                t = t.to(device)
+            return t
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy"):
+            if not isinstance(batch, dict):
+                # scalar-row blocks become one unnamed tensor
+                yield to_tensor(batch)
+                continue
+            out = {}
+            for k, v in batch.items():
+                t = to_tensor(v)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t
+            yield out
 
     def iter_rows(self) -> Iterator[Any]:
         from ray_tpu.data import block as blk

@@ -213,3 +213,39 @@ class TestAllToAll:
             assert counts == {"alpha": 20, "beta": 20, "gamma": 20}
         finally:
             ray_tpu.shutdown()
+
+
+class TestBatchIteration:
+    """iter_batches(batch_size/batch_format) + iter_torch_batches
+    (reference: Dataset.iter_batches / iter_torch_batches)."""
+
+    def test_iter_batches_sizes_and_format(self, rt):
+        pa = pytest.importorskip("pyarrow")
+        t = pa.table({"x": list(range(100))})
+        sizes = [b.num_rows for b in
+                 data.from_arrow(t, parallelism=4).iter_batches(
+                     batch_size=8, batch_format="pyarrow")]
+        assert sum(sizes) == 100 and max(sizes) <= 8
+        np_batches = list(data.from_arrow(t, parallelism=2).iter_batches(
+            batch_format="numpy"))
+        assert all(isinstance(b, dict) and b["x"].dtype.kind == "i"
+                   for b in np_batches)
+
+    def test_iter_torch_batches(self, rt):
+        torch = pytest.importorskip("torch")
+        pa = pytest.importorskip("pyarrow")
+        import numpy as np
+
+        t = pa.table({"x": np.arange(40, dtype=np.int64),
+                      "y": np.arange(40, dtype=np.float32) / 2})
+        total = 0
+        for b in data.from_arrow(t, parallelism=2).iter_torch_batches(
+                batch_size=16, dtypes={"y": torch.float64}):
+            assert isinstance(b["x"], torch.Tensor)
+            assert b["y"].dtype == torch.float64
+            total += len(b["x"])
+        assert total == 40
+        # scalar-row datasets yield plain tensors
+        out = list(data.range(10, parallelism=2).iter_torch_batches())
+        assert all(isinstance(x, torch.Tensor) for x in out)
+        assert sum(int(x.sum()) for x in out) == sum(range(10))
