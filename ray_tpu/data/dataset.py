@@ -207,10 +207,16 @@ class Dataset:
         from ray_tpu.data import block as blk
 
         for b in self._execute():
+            n = blk.block_rows(b)
+            if n == 0:
+                # empty blocks (e.g. a filter that drained one) yield
+                # NOTHING in every mode — an empty list block can't
+                # honor a dict-of-columns contract, and batch_size
+                # already skips them
+                continue
             if batch_size is None:
                 yield blk.to_batch_format(b, batch_format)
                 continue
-            n = blk.block_rows(b)
             for i in builtins.range(0, n, batch_size):
                 piece = blk.block_slice(b, i, min(i + batch_size, n))
                 yield blk.to_batch_format(piece, batch_format)

@@ -231,6 +231,17 @@ class TestBatchIteration:
         assert all(isinstance(b, dict) and b["x"].dtype.kind == "i"
                    for b in np_batches)
 
+    def test_empty_blocks_skipped(self, rt):
+        """A filter that drains blocks must not leak empty non-dict
+        batches into numpy/torch iteration."""
+        pytest.importorskip("torch")
+        ds = data.from_items([{"x": i} for i in range(10)],
+                             parallelism=5).filter(lambda r: r["x"] == 3)
+        got = list(ds.iter_torch_batches())
+        assert len(got) == 1 and int(got[0]["x"][0]) == 3
+        np_batches = list(ds.iter_batches(batch_format="numpy"))
+        assert all(isinstance(b, dict) for b in np_batches)
+
     def test_iter_torch_batches(self, rt):
         torch = pytest.importorskip("torch")
         pa = pytest.importorskip("pyarrow")
