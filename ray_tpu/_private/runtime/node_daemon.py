@@ -87,6 +87,139 @@ class _WorkerSlot:
         self.actor_bin: Optional[bytes] = None
 
 
+PEER_CHUNK = 1 << 20  # ~1 MB frames (reference: ObjectBufferPool)
+
+
+def _drain_frames(conn, total: int, timeout: float, sink_view=None,
+                  sink_write=None) -> None:
+    """The ONE chunk-protocol receive loop (exact ~1 MB frames until
+    `total`): into a buffer view (recv_bytes_into, no copy) or through
+    a write callback (spill files). Raises OSError on timeout/short
+    frames — both fetch modes share this, so protocol changes can't
+    desynchronize them."""
+    pos = 0
+    while pos < total:
+        n = min(PEER_CHUNK, total - pos)
+        if not conn.poll(timeout):
+            raise OSError("peer chunk timed out")
+        if sink_view is not None:
+            got = conn.recv_bytes_into(sink_view[pos:pos + n])
+        else:
+            chunk = conn.recv_bytes(PEER_CHUNK)
+            got = len(chunk)
+            sink_write(chunk)
+        if got != n:
+            raise OSError(f"short peer chunk: {got} != {n} at {pos}")
+        pos += n
+
+
+def recv_object_into_store(conn, store, oid: ObjectID, total: int,
+                           timeout: float) -> bool:
+    """Drain the chunk frames into the given store: straight into a
+    pre-created arena range (recv_bytes_into — no intermediate buffer)
+    or appended to a spill file when the arena can't hold it.
+    Per-transfer transient memory is ONE chunk. Shared by daemons AND
+    the head (both adopt peer streams into their own ShmObjectStore)."""
+    kind, target = store.begin_adopt(oid, total)
+    view = target if kind == "arena" else None
+    try:
+        _drain_frames(conn, total, timeout, sink_view=view,
+                      sink_write=None if view is not None
+                      else target.write)
+    except BaseException:
+        if view is not None:
+            view.release()
+        store.abort_adopt(oid, kind,
+                          None if kind == "arena" else target)
+        raise
+    if view is not None:
+        view.release()
+    store.finish_adopt(oid, total, kind,
+                       None if kind == "arena" else target)
+    return True
+
+
+def _peer_dial(address, authkey: bytes, oid: ObjectID, timeout: float):
+    """Dial a daemon's peer listener, handshake, request oid; returns
+    (conn, total_bytes) or None on any failure/miss (incl. a stale
+    authkey after a head restart — AuthenticationError is ProcessError,
+    NOT OSError). Caller closes."""
+    from multiprocessing import AuthenticationError
+
+    from ray_tpu._private import protocol
+
+    try:
+        conn = Client(tuple(address), authkey=authkey)
+    except (OSError, EOFError, ValueError, AuthenticationError):
+        return None
+    try:
+        conn.send(protocol.make_hello("peer"))
+        if conn.recv() != ("ok",):
+            conn.close()
+            return None
+        conn.send(("get", oid.binary()))
+        if not conn.poll(timeout):
+            conn.close()
+            return None
+        reply = conn.recv()
+        if reply[0] == "miss":
+            conn.close()
+            return None
+        return conn, reply[1]
+    except (OSError, EOFError, ValueError, AuthenticationError):
+        try:
+            conn.close()
+        except Exception:
+            pass
+        return None
+
+
+def peer_pull_once(address, authkey: bytes, store, oid: ObjectID,
+                   timeout: float) -> bool:
+    """One-shot chunked pull of an object from a node daemon's peer
+    listener into `store` (the HEAD's fetch path — daemons keep cached
+    per-peer connections instead, see NodeDaemon.pull_from_peer).
+    Returns True when the object is locally resident afterwards."""
+    if store.contains(oid):
+        return True
+    dialed = _peer_dial(address, authkey, oid, timeout)
+    if dialed is None:
+        return False
+    conn, total = dialed
+    try:
+        return recv_object_into_store(conn, store, oid, total, timeout)
+    except (OSError, EOFError, ValueError):
+        return False
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+def peer_pull_bytes(address, authkey: bytes, oid: ObjectID,
+                    timeout: float) -> Optional[bytearray]:
+    """Chunked pull into ONE preallocated buffer (for heads with no
+    shm arena — thread mode): the frames land via recv_bytes_into, so
+    neither side ever materializes the object as a single pickled
+    message and the daemon's control link stays untouched."""
+    dialed = _peer_dial(address, authkey, oid, timeout)
+    if dialed is None:
+        return None
+    conn, total = dialed
+    try:
+        buf = bytearray(total)
+        _drain_frames(conn, total, timeout, sink_view=memoryview(buf))
+        return buf
+    except (OSError, EOFError, ValueError):
+        return None
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
 class PullManager:
     """Priority-ordered peer pulls (reference: the object manager's
     PullManager, src/ray/object_manager/pull_manager.cc — get > wait >
@@ -426,8 +559,6 @@ class NodeDaemon:
                              daemon=True,
                              name="ray_tpu_node_peer_serve").start()
 
-    PEER_CHUNK = 1 << 20  # ~1 MB frames (reference: ObjectBufferPool)
-
     def _peer_serve(self, conn) -> None:  # noqa: D401
         """One persistent connection per consuming peer: a versioned
         hello first, then get requests served out of the local
@@ -472,7 +603,7 @@ class NodeDaemon:
         """("meta", total) + raw ~1 MB frames; arena objects stream
         zero-copy from the pinned range, spilled objects stream from
         their file. Returns False on a dead connection."""
-        CH = self.PEER_CHUNK
+        CH = PEER_CHUNK
         view = self.store.acquire_raw(oid)
         if view is not None:
             try:
@@ -587,40 +718,8 @@ class NodeDaemon:
 
     def _recv_object(self, conn, oid: ObjectID, total: int,
                      timeout: float) -> bool:
-        """Drain the chunk frames into the local store: straight into a
-        pre-created arena range (recv_bytes_into — no intermediate
-        buffer) or appended to a spill file when the arena can't hold
-        it. Per-transfer transient memory is ONE chunk."""
-        CH = self.PEER_CHUNK
-        kind, target = self.store.begin_adopt(oid, total)
-        view = target if kind == "arena" else None
-        try:
-            pos = 0
-            while pos < total:
-                n = min(CH, total - pos)
-                if not conn.poll(timeout):
-                    raise OSError("peer chunk timed out")
-                if view is not None:
-                    got = conn.recv_bytes_into(view[pos:pos + n])
-                else:
-                    chunk = conn.recv_bytes(CH)
-                    got = len(chunk)
-                    target.write(chunk)
-                if got != n:
-                    raise OSError(
-                        f"short peer chunk: {got} != {n} at {pos}")
-                pos += n
-        except BaseException:
-            if view is not None:
-                view.release()
-            self.store.abort_adopt(oid, kind,
-                                   None if kind == "arena" else target)
-            raise
-        if view is not None:
-            view.release()
-        self.store.finish_adopt(oid, total, kind,
-                                None if kind == "arena" else target)
-        return True
+        return recv_object_into_store(conn, self.store, oid, total,
+                                      timeout)
 
     def _localize(self, loc: tuple, priority: int = 0) -> tuple:
         """Rewrite a head get-reply entry: ("node_shm", oid) points at

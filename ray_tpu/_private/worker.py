@@ -378,6 +378,9 @@ class Worker:
         # stays flat when a direct peer path exists)
         self.transfer_stats: Dict[str, int] = {"head_relayed_bytes": 0,
                                                "head_relayed_objects": 0}
+        # single-flight head-side peer pulls (oid -> completion event)
+        self._head_pull_lock = threading.Lock()
+        self._head_pulls: Dict[ObjectID, threading.Event] = {}
 
         # placement groups (bundle reservation over the scheduler)
         from ray_tpu._private.placement_groups import PlacementGroupManager
@@ -524,12 +527,91 @@ class Worker:
         elif isinstance(value, RemotePlaceholder):
             from ray_tpu._private.serialization import (SerializedObject,
                                                         deserialize)
-            data = self.fetch_object_bytes(object_id, value.node_index)
-            if data is None:
-                raise rex.ObjectLostError(object_id.hex())
-            value = deserialize(SerializedObject.from_bytes(data))
+            node_index = value.node_index
+            value = self._pull_remote_value(object_id, node_index)
+            if value is None:
+                # control-channel blob fetch (daemons without a peer
+                # plane / pull failure)
+                data = self.fetch_object_bytes(object_id, node_index)
+                if data is None:
+                    raise rex.ObjectLostError(object_id.hex())
+                value = deserialize(SerializedObject.from_bytes(data))
             entry.value = value  # memoize: later reads are local
         return value
+
+    def _pull_remote_value(self, object_id: ObjectID,
+                           node_index: int) -> Optional[Any]:
+        """Chunked peer pull of a remote-resident object into the
+        HEAD's own store, then a local read — a multi-GB result never
+        rides the daemon CONTROL link as one message (that link also
+        carries dispatch and pings). None = peer plane unavailable;
+        the caller falls back to the control-channel blob fetch."""
+        peer = self.peer_address_of(node_index)
+        if peer is None or self._head_server is None:
+            return None
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.runtime.node_daemon import peer_pull_bytes
+        from ray_tpu._private.serialization import (SerializedObject,
+                                                    deserialize)
+
+        timeout = GLOBAL_CONFIG.object_transfer_timeout_s
+        authkey = self._head_server.authkey
+        if self.shm_store is not None:
+            # single-flight per object: two user threads racing the
+            # same pull would both begin_adopt — in the spill case onto
+            # the SAME temp file (one pid), interleaving writes into a
+            # corrupted object
+            with self._head_pull_lock:
+                ev = self._head_pulls.get(object_id)
+                if ev is None:
+                    self._head_pulls[object_id] = ev = threading.Event()
+                    leader = True
+                else:
+                    leader = False
+            if not leader:
+                ev.wait(timeout)
+                if not self.shm_store.contains(object_id):
+                    return None  # leader failed: fall back
+                return self._read_pulled(object_id)
+            try:
+                return self._leader_pull(peer, authkey, object_id,
+                                         timeout)
+            finally:
+                with self._head_pull_lock:
+                    self._head_pulls.pop(object_id, None)
+                ev.set()
+        # thread-mode head: no arena to adopt into — stream the frames
+        # into ONE buffer and deserialize from it (no shared sink, so
+        # no single-flight needed beyond memoization upstream)
+        buf = peer_pull_bytes(peer, authkey, object_id, timeout)
+        if buf is None:
+            return None
+        self.transfer_stats["head_peer_pulled_objects"] = \
+            self.transfer_stats.get("head_peer_pulled_objects", 0) + 1
+        return deserialize(SerializedObject.from_bytes(memoryview(buf)))
+
+    def _leader_pull(self, peer, authkey: bytes, object_id: ObjectID,
+                     timeout: float) -> Optional[Any]:
+        from ray_tpu._private.runtime.node_daemon import peer_pull_once
+
+        if not peer_pull_once(peer, authkey, self.shm_store, object_id,
+                              timeout):
+            return None
+        self.transfer_stats["head_peer_pulled_objects"] = \
+            self.transfer_stats.get("head_peer_pulled_objects", 0) + 1
+        return self._read_pulled(object_id)
+
+    def _read_pulled(self, object_id: ObjectID) -> Optional[Any]:
+        from ray_tpu._private.serialization import (
+            deserialize, deserialize_with_release)
+
+        sobj, pinned = self.shm_store.get_serialized_for_view(object_id)
+        if sobj is None:
+            return None
+        if pinned:
+            return deserialize_with_release(
+                sobj, lambda oid=object_id: self.shm_store.unpin(oid))
+        return deserialize(sobj)  # spill read: copied bytes
 
     def fetch_object_bytes(self, object_id: ObjectID,
                            node_index: int) -> Optional[bytes]:

@@ -367,3 +367,28 @@ class TestChunkedPeerTransfer:
             assert results == [True] * 4    # every caller sees it
         finally:
             pm.stop()
+
+
+class TestHeadPeerPull:
+    def test_head_fetch_rides_peer_plane(self, cluster):
+        """A head-side get of a remote-resident object streams through
+        the CHUNKED peer plane into the head's own store — not as one
+        blob over the daemon control link (which also carries dispatch
+        and pings)."""
+        cluster.add_node(num_cpus=2, remote=True, resources={"away": 2.0})
+        cluster.wait_for_nodes()
+        w = worker_mod.get_worker()
+
+        @ray_tpu.remote(resources={"away": 1.0})
+        def produce():
+            return np.arange(3_000_000, dtype=np.int64)  # ~24 MB
+
+        ref = produce.remote()
+        ray_tpu.wait([ref], timeout=60.0)
+        relayed0 = w.transfer_stats["head_relayed_bytes"]
+        pulled0 = w.transfer_stats.get("head_peer_pulled_objects", 0)
+        val = ray_tpu.get(ref, timeout=120)
+        assert int(val[-1]) == 2_999_999
+        assert w.transfer_stats.get("head_peer_pulled_objects", 0) \
+            == pulled0 + 1
+        assert w.transfer_stats["head_relayed_bytes"] == relayed0
