@@ -281,6 +281,50 @@ class Dataset:
 
         return datasource.to_pandas(self)
 
+    def schema(self):
+        """Column names of the first non-empty block (reference:
+        Dataset.schema at minimum fidelity): a pyarrow.Schema for
+        Arrow datasets, the sorted key list for dict-row datasets,
+        None for scalar rows / empty datasets."""
+        from ray_tpu.data import block as blk
+
+        for b in self._execute():
+            if blk.block_rows(b) == 0:
+                continue  # e.g. a filter drained this block: scan on
+            if blk._is_arrow(b):
+                return b.schema
+            rows = blk.block_to_rows(b)
+            if rows and isinstance(rows[0], dict):
+                return sorted(rows[0].keys())
+            return None
+        return None
+
+    def split(self, n: int) -> List["Dataset"]:
+        """n datasets over contiguous slices of this one's blocks
+        (reference: Dataset.split — a materializing operation; the
+        splits are full Datasets and keep transforming lazily)."""
+        if n < 1:
+            raise ValueError("split needs n >= 1")
+        refs = self.materialize().block_refs
+        out = []
+        for i in builtins.range(n):
+            # near-even distribution: ceil-division would exhaust the
+            # refs early and hand later splits zero blocks
+            lo = (i * len(refs)) // n
+            hi = ((i + 1) * len(refs)) // n
+            out.append(Dataset(_refs_source(refs[lo:hi], f"split_{i}")))
+        return out
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenation of this dataset and `others` (reference:
+        Dataset.union). A materializing barrier here: every input
+        executes to block refs, and the union is a new lazy Dataset
+        over their concatenation (input order preserved)."""
+        refs: List[Any] = list(self.materialize().block_refs)
+        for o in others:
+            refs.extend(o.materialize().block_refs)
+        return Dataset(_refs_source(refs, "union"))
+
     def materialize(self) -> "MaterializedDataset":
         """Run the pipeline, keeping blocks in the object store as refs
         (the reference's ds.materialize())."""
@@ -422,7 +466,7 @@ def _refs_source(refs, name: str) -> _LogicalOp:
     when a map fuses in) — re-reading them inside a source task would
     copy every block through the object store a second time."""
     return _LogicalOp("read", name=f"{name}_out",
-                      num_blocks=max(1, len(refs)),
+                      num_blocks=len(refs),  # 0 = an EMPTY dataset
                       refs=list(refs))
 
 

@@ -260,3 +260,31 @@ class TestBatchIteration:
         out = list(data.range(10, parallelism=2).iter_torch_batches())
         assert all(isinstance(x, torch.Tensor) for x in out)
         assert sum(int(x.sum()) for x in out) == sum(range(10))
+
+
+class TestSplitUnionSchema:
+    """Dataset.split / union / schema (reference: the same names on
+    ray.data.Dataset; split and union materialize, the results stay
+    lazy Datasets)."""
+
+    def test_split_partitions_blocks(self, rt):
+        parts = data.range(100, parallelism=10).split(3)
+        assert len(parts) == 3
+        seen = [x for p in parts for x in p.take_all()]
+        assert sorted(seen) == list(range(100))
+        # splits keep transforming lazily
+        assert parts[0].map(lambda x: x * 2).count() > 0
+
+    def test_union_concatenates_in_order(self, rt):
+        a = data.range(5, parallelism=2)
+        b = data.from_items([10, 11, 12], parallelism=1)
+        out = a.union(b).take_all()
+        assert out == [0, 1, 2, 3, 4, 10, 11, 12]
+
+    def test_schema(self, rt):
+        pa = pytest.importorskip("pyarrow")
+        t = pa.table({"a": [1], "b": ["x"]})
+        sch = data.from_arrow(t, parallelism=1).schema()
+        assert sch.names == ["a", "b"]
+        assert data.from_items([{"k": 1, "j": 2}]).schema() == ["j", "k"]
+        assert data.range(5).schema() is None
