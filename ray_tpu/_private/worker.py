@@ -1595,6 +1595,23 @@ def _likely_large(value: Any) -> bool:
 
 
 def _detect_tpu_count() -> float:
+    # a cpu-pinned run (tests, bench subprocesses) must NEVER touch the
+    # accelerator plugin: jax.devices() initializes it, and a degraded
+    # chip tunnel then hangs every ray_tpu.init() indefinitely. The
+    # env var alone is unreliable — the axon plugin rewrites it at jax
+    # import (see tests/conftest.py) — so also consult jax.config when
+    # jax is already imported
+    import sys as _sys
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return 0.0
+    if "jax" in _sys.modules:
+        try:
+            cfg = _sys.modules["jax"].config.jax_platforms
+            if cfg and str(cfg).strip().lower() == "cpu":
+                return 0.0
+        except Exception:
+            pass
     try:
         import jax
         return float(len([d for d in jax.devices()
