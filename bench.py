@@ -54,9 +54,50 @@ def _e2e_subprocess(n: int, mode: str, batched: bool = False) -> dict:
         f"e2e child produced no result: {out.stderr[-2000:]}")
 
 
+def _chip_preflight(timeout_s: float = 180.0) -> str:
+    """Probe the accelerator in a KILLABLE subprocess: a degraded chip
+    tunnel hangs jax backend init indefinitely, and an unbounded hang
+    here would zero out the whole benchmark record. Returns "chip",
+    "cpu-only" (probe ran, no accelerator — an ordinary CPU host), or
+    "unreachable" (probe hung/failed — the tunnel diagnosis)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return "cpu-only"  # caller already pinned: nothing to probe
+    code = ("import jax\n"
+            "ds = jax.devices()\n"
+            "print('CHIP_OK', sum(d.platform != 'cpu' for d in ds))\n")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+        for line in out.stdout.splitlines():
+            if line.startswith("CHIP_OK"):
+                return "chip" if int(line.split()[1]) > 0 else "cpu-only"
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    return "unreachable"
+
+
 def main() -> int:
     smoke = "--smoke" in sys.argv
     run_all = "--all" in sys.argv
+
+    chip = _chip_preflight()
+    if chip != "chip":
+        # no accelerator (or tunnel down): every section still runs,
+        # on CPU, and the JSON says which — a hung or empty benchmark
+        # helps nobody. jax.config covers THIS process (the TPU plugin
+        # overrides the env var at import); the env var is re-asserted
+        # AFTER the import for inherited children
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if chip == "unreachable":
+            print("  WARNING: accelerator unreachable (tunnel "
+                  "preflight timed out); running device sections on "
+                  "CPU", file=sys.stderr)
 
     from ray_tpu._private import benchmarks, perf
 
@@ -149,6 +190,10 @@ def main() -> int:
         code = (
             "import json, sys\n"
             f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+            # config pin, not just the env var: the TPU plugin rewrites
+            # JAX_PLATFORMS at import, and this child RUNS jax compute
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
             "from ray_tpu._private import perf\n"
             f"r = perf.rl_rollout_throughput(iters={1 if smoke else 4})\n"
             "print('RL_JSON:' + json.dumps(r))\n")
@@ -240,6 +285,10 @@ def main() -> int:
     # processes on a 1-core host serialize on IPC); report the cores so
     # the number reads honestly
     out["host_cpus"] = os.cpu_count()
+    if chip == "unreachable":
+        out["device_fallback"] = "cpu (accelerator tunnel unreachable)"
+    elif chip == "cpu-only":
+        out["device_fallback"] = "cpu (no accelerator present)"
 
     target_ms = 10.0
     value = round(ns["scheduling_ms"], 4)
