@@ -31,20 +31,38 @@ _WORKER_LEN = 16
 _PG_LEN = 12
 
 
-class BaseID:
-    """Immutable binary identifier; hashable, ordered, hex-printable."""
+class BaseID(bytes):
+    """Immutable binary identifier; hashable, ordered, hex-printable.
 
-    __slots__ = ("_bytes", "_hash")
+    Subclasses ``bytes`` so that every dict/set operation keyed on an ID
+    hashes and compares at C level — the previous Python ``__hash__``
+    ran ~28 times per task across the submit/execute/complete path and
+    was a measurable slice of the e2e task budget. Different ID kinds
+    never collide in practice: lengths differ (ObjectID 20B vs TaskID
+    16B) or the bytes are random. ``self`` IS the binary value, so the
+    ``task_id()``-is-a-slice property the scheduler kernel exploits
+    still holds.
+    """
+
+    __slots__ = ()
     _LENGTH = 16
 
-    def __init__(self, binary: bytes):
-        if len(binary) != self._LENGTH:
+    def __new__(cls, binary: bytes) -> "BaseID":
+        if len(binary) != cls._LENGTH:
             raise ValueError(
-                f"{type(self).__name__} requires {self._LENGTH} bytes, "
+                f"{cls.__name__} requires {cls._LENGTH} bytes, "
                 f"got {len(binary)}"
             )
-        self._bytes = bytes(binary)
-        self._hash = hash((type(self).__name__, self._bytes))
+        return bytes.__new__(cls, binary)
+
+    def __reduce__(self):
+        # route unpickling through __new__ (bytes' default reduce would
+        # bypass the length check)
+        return (type(self), (bytes(self),))
+
+    @property
+    def _bytes(self) -> bytes:
+        return bytes(self)
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -59,22 +77,10 @@ class BaseID:
         return cls(b"\x00" * cls._LENGTH)
 
     def is_nil(self) -> bool:
-        return self._bytes == b"\x00" * self._LENGTH
+        return bytes(self) == b"\x00" * self._LENGTH
 
     def binary(self) -> bytes:
-        return self._bytes
-
-    def hex(self) -> str:
-        return self._bytes.hex()
-
-    def __hash__(self) -> int:
-        return self._hash
-
-    def __eq__(self, other) -> bool:
-        return type(other) is type(self) and other._bytes == self._bytes
-
-    def __lt__(self, other) -> bool:
-        return self._bytes < other._bytes
+        return bytes(self)
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.hex()})"
@@ -89,7 +95,7 @@ class JobID(BaseID):
         return cls(struct.pack(">I", value))
 
     def int_value(self) -> int:
-        return struct.unpack(">I", self._bytes)[0]
+        return struct.unpack(">I", bytes(self))[0]
 
 
 class NodeID(BaseID):
@@ -111,7 +117,7 @@ class ActorID(BaseID):
         return cls(job_id.binary() + os.urandom(_ACTOR_LEN - _JOB_LEN))
 
     def job_id(self) -> JobID:
-        return JobID(self._bytes[:_JOB_LEN])
+        return JobID(self[:_JOB_LEN])
 
 
 class TaskID(BaseID):
@@ -130,10 +136,10 @@ class TaskID(BaseID):
         return cls(actor_id.binary()[:12] + struct.pack(">I", seq & 0xFFFFFFFF))
 
     def job_id(self) -> JobID:
-        return JobID(self._bytes[:_JOB_LEN])
+        return JobID(self[:_JOB_LEN])
 
     def seq(self) -> int:
-        return struct.unpack(">I", self._bytes[12:16])[0]
+        return struct.unpack(">I", self[12:16])[0]
 
 
 class ObjectID(BaseID):
@@ -153,13 +159,13 @@ class ObjectID(BaseID):
         return cls(task_id.binary() + struct.pack(">I", 0x80000000 | put_index))
 
     def task_id(self) -> TaskID:
-        return TaskID(self._bytes[:_TASK_LEN])
+        return TaskID(self[:_TASK_LEN])
 
     def return_index(self) -> int:
-        return struct.unpack(">I", self._bytes[16:20])[0] & 0x7FFFFFFF
+        return struct.unpack(">I", self[16:20])[0] & 0x7FFFFFFF
 
     def is_put(self) -> bool:
-        return bool(struct.unpack(">I", self._bytes[16:20])[0] & 0x80000000)
+        return bool(struct.unpack(">I", self[16:20])[0] & 0x80000000)
 
     def job_id(self) -> JobID:
         return self.task_id().job_id()
@@ -174,7 +180,7 @@ class PlacementGroupID(BaseID):
         return cls(job_id.binary() + os.urandom(_PG_LEN - _JOB_LEN))
 
     def job_id(self) -> JobID:
-        return JobID(self._bytes[:_JOB_LEN])
+        return JobID(self[:_JOB_LEN])
 
 
 class _Counter:

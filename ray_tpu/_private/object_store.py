@@ -60,6 +60,13 @@ class MemoryStore:
         with self._lock:
             return object_id in self._objects
 
+    def missing_of(self, object_ids: List[ObjectID]) -> List[ObjectID]:
+        """Ids NOT present, under one lock hold (a batch get() of 50k
+        refs would otherwise pay 50k lock acquisitions up front)."""
+        with self._lock:
+            objects = self._objects
+            return [o for o in object_ids if o not in objects]
+
     def get_entry(self, object_id: ObjectID) -> Optional[_Entry]:
         with self._lock:
             return self._objects.get(object_id)

@@ -31,6 +31,14 @@ def split_hello(hello) -> Tuple[Optional[int], tuple]:
     first field is never an int)."""
     if not (isinstance(hello, tuple) and hello and hello[0] == "hello"):
         return None, ()
+    if (len(hello) == 3 and hello[2] in ("task", "ctrl")
+            and isinstance(hello[1], int)):
+        # legacy UNVERSIONED intra-node worker hello was
+        # ("hello", <int worker_num>, kind) — the int is a worker
+        # number, not a version; without this case the dialer gets a
+        # baffling "peer sent protocol v<worker_num>" (or a silent
+        # accept when worker_num happens to equal PROTOCOL_VERSION)
+        return None, tuple(hello[1:])
     if len(hello) >= 2 and isinstance(hello[1], int) \
             and not isinstance(hello[1], bool):
         return hello[1], tuple(hello[2:])

@@ -402,10 +402,16 @@ class NodeDaemon:
         slot = _WorkerSlot(num)
         with self._lock:
             self._slots[num] = slot
-        env = dict(os.environ)
-        env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in sys.path if p) + os.pathsep + env.get("PYTHONPATH", "")
+        # by default workers don't own an accelerator (the head holds
+        # the single-chip lease) — strip the plugin vars so a degraded
+        # tunnel can't hang their `import jax`; worker_tpu_access
+        # opts a node's workers back in (same knob process_pool honors)
+        from ray_tpu._private import spawn_env
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        env = spawn_env.child_env(
+            use_accelerator=GLOBAL_CONFIG.worker_tpu_access,
+            inherit_sys_path=True,
+            extra={"RAY_TPU_AUTHKEY": self._authkey.hex()})
         slot.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.runtime.worker_process",
              self._listener.address, self.store.arena.name,

@@ -30,6 +30,7 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private import spawn_env
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
@@ -205,19 +206,15 @@ class ProcessWorkerPool:
         h = _Handle(num)
         with self._lock:
             self._by_num[num] = h
-        env = dict(os.environ)
-        env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in sys.path if p) + os.pathsep + env.get("PYTHONPATH", "")
-        if not GLOBAL_CONFIG.worker_tpu_access:
-            # the HEAD owns the accelerator (single-chip lease; same
-            # stance as the reference's GPU ownership via resources) —
-            # worker processes skip the site-level TPU plugin bootstrap,
-            # which costs seconds of import and a device-lease fight,
-            # and fall back to CPU jax if a task imports jax at all
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            if env.get("JAX_PLATFORMS", "").lower() in ("axon", ""):
-                env["JAX_PLATFORMS"] = "cpu"
+        # the HEAD owns the accelerator (single-chip lease; same stance
+        # as the reference's GPU ownership via resources) — worker
+        # processes skip the site-level TPU plugin bootstrap, which
+        # costs seconds of import, a device-lease fight, and (with a
+        # degraded tunnel) an indefinite hang at `import jax`
+        env = spawn_env.child_env(
+            use_accelerator=GLOBAL_CONFIG.worker_tpu_access,
+            inherit_sys_path=True,
+            extra={"RAY_TPU_AUTHKEY": self._authkey.hex()})
         h.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.runtime.worker_process",
              self._listener.address, self._shm.arena.name,

@@ -1,0 +1,81 @@
+"""The one environment builder for every subprocess this framework spawns.
+
+Why this exists (verified rounds 4-5): the site TPU plugin activates at
+``import jax`` whenever its pool env vars (``PALLAS_AXON_POOL_IPS`` and
+friends) are present in the environment — even with ``JAX_PLATFORMS=cpu``
+set — and a degraded accelerator tunnel then hangs backend init forever
+instead of raising. Any child process that inherits the parent
+environment verbatim after the parent imported jax is exposed: the
+plugin rewrites ``JAX_PLATFORMS`` in ``os.environ`` at import, so the
+poisoned value propagates. The fix is mechanical but must be applied at
+EVERY spawn site: strip the plugin's env-var family and pin
+``JAX_PLATFORMS=cpu`` unless the child is explicitly meant to own the
+accelerator.
+
+Reference analog: upstream ray sanitises ``CUDA_VISIBLE_DEVICES`` for
+worker processes (ray: python/ray/_private/utils.py set_cuda_visible_devices);
+this is the same idea for the TPU plugin's bootstrap variables.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, Mapping, Optional
+
+# Env-var prefixes that boot the site TPU plugin at `import jax`.
+# Observed family: PALLAS_AXON_POOL_IPS (the hang trigger when the
+# tunnel is down), PALLAS_AXON_TPU_GEN, PALLAS_AXON_REMOTE_COMPILE,
+# AXON_LOOPBACK_RELAY, AXON_POOL_SVC_OVERRIDE, AXON_COMPAT_VERSION,
+# _AXON_REGISTERED.
+_ACCEL_PREFIXES = ("AXON_", "PALLAS_AXON_", "_AXON")
+
+
+def strip_accelerator(env: Dict[str, str]) -> Dict[str, str]:
+    """Remove accelerator-plugin bootstrap vars and pin jax to CPU.
+
+    Mutates and returns *env*. After this, a child's ``import jax``
+    cannot boot the tunnel plugin (nothing registers it), so the plain
+    ``JAX_PLATFORMS=cpu`` env pin is authoritative in the child.
+    """
+    env["JAX_PLATFORMS"] = "cpu"
+    for key in list(env):
+        if key.startswith(_ACCEL_PREFIXES):
+            del env[key]
+    return env
+
+
+def child_env(base: Optional[Mapping[str, str]] = None, *,
+              use_accelerator: bool = False,
+              inherit_sys_path: bool = False,
+              repo_path: Optional[str] = None,
+              extra: Optional[Mapping[str, str]] = None) -> Dict[str, str]:
+    """Build the environment for a subprocess.
+
+    - ``use_accelerator=False`` (default): the child is CPU-only jax —
+      strips the plugin vars and pins ``JAX_PLATFORMS=cpu``. This is
+      right for worker processes (the head owns the single-chip lease),
+      node daemons, test heads, and bench children.
+    - ``use_accelerator=True``: inherit the accelerator environment
+      untouched (the child is meant to own the chip).
+    - ``inherit_sys_path``: prepend the parent's ``sys.path`` to
+      PYTHONPATH (worker processes import the driver's modules).
+    - ``repo_path``: prepend one directory to PYTHONPATH (tests).
+    - ``extra``: final overrides, applied last so callers win.
+    """
+    env = dict(os.environ if base is None else base)
+    if not use_accelerator:
+        strip_accelerator(env)
+    paths = []
+    if inherit_sys_path:
+        paths.extend(p for p in sys.path if p)
+    if repo_path:
+        paths.insert(0, repo_path)
+    if paths:
+        prev = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = os.pathsep.join(paths) + (
+            os.pathsep + prev if prev else "")
+    if extra:
+        for key, value in extra.items():
+            env[key] = str(value)
+    return env

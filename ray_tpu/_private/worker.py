@@ -37,6 +37,7 @@ logger = logging.getLogger(__name__)
 
 global_worker: Optional["Worker"] = None
 _init_lock = threading.Lock()
+_gc_tuned = False
 
 
 def _noop_exec(task, node_index) -> None:
@@ -123,21 +124,32 @@ class TaskManager:
 
     def complete(self, task_id: TaskID) -> None:
         with self._lock:
-            entry = self._pending.pop(task_id, None)
-            if entry is not None:
-                spec, _ = entry
-                # retain lineage for reconstruction while returns in
-                # scope — keyed by the id the RETURN ids derive from, so
-                # recovery of a retried/reconstructed task's outputs
-                # still finds the spec
-                rr = getattr(spec, "_retry_return_ids", None)
-                key = rr[0].task_id() if rr else task_id
-                self._pending_origin.pop(key, None)
-                if key not in self._lineage:  # overwrites don't grow
-                    self._lineage_bytes += 256  # coarse estimate per spec
-                self._lineage[key] = spec
-                if self._lineage_bytes > self._lineage_cap.value:
-                    self._evict_lineage_locked()
+            self._complete_locked(task_id)
+
+    def complete_batch(self, task_ids: List[TaskID]) -> None:
+        """One lock hold for a drain-loop's worth of completions (the
+        fast-path executor defers these — lineage bookkeeping never
+        gates scheduling, unlike the finished-notification)."""
+        with self._lock:
+            for task_id in task_ids:
+                self._complete_locked(task_id)
+
+    def _complete_locked(self, task_id: TaskID) -> None:
+        entry = self._pending.pop(task_id, None)
+        if entry is not None:
+            spec, _ = entry
+            # retain lineage for reconstruction while returns in
+            # scope — keyed by the id the RETURN ids derive from, so
+            # recovery of a retried/reconstructed task's outputs
+            # still finds the spec
+            rr = getattr(spec, "_retry_return_ids", None)
+            key = rr[0].task_id() if rr else task_id
+            self._pending_origin.pop(key, None)
+            if key not in self._lineage:  # overwrites don't grow
+                self._lineage_bytes += 256  # coarse estimate per spec
+            self._lineage[key] = spec
+            if self._lineage_bytes > self._lineage_cap.value:
+                self._evict_lineage_locked()
 
     def should_retry(self, spec: TaskSpec, exc: BaseException) -> bool:
         if spec.attempt_number >= spec.max_retries:
@@ -170,6 +182,14 @@ class TaskManager:
         with self._lock:
             if self._lineage.pop(task_id, None) is not None:
                 self._lineage_bytes -= 256
+
+    def evict_lineage_batch(self, object_ids: List[ObjectID]) -> None:
+        """One lock hold for a whole out-of-scope drain."""
+        with self._lock:
+            pop = self._lineage.pop
+            for oid in object_ids:
+                if pop(oid.task_id(), None) is not None:
+                    self._lineage_bytes -= 256
 
     def _evict_lineage_locked(self):
         while self._lineage_bytes > self._lineage_cap.value // 2 \
@@ -304,6 +324,7 @@ class Worker:
         self._task_unique = os.urandom(8)
 
         self.memory_store = MemoryStore()
+        self._oos_q: collections.deque = collections.deque()
         self.reference_counter = ReferenceCounter(self._on_object_out_of_scope)
         self.task_manager = TaskManager(self)
 
@@ -471,6 +492,7 @@ class Worker:
     # Object plane: put / get / wait
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
+        self._drain_out_of_scope()
         if isinstance(value, ObjectRef):
             raise TypeError(
                 "Calling put() on an ObjectRef is not allowed: the ref can be "
@@ -637,10 +659,11 @@ class Worker:
         return None
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        self._drain_out_of_scope()
         ids = [r.object_id() for r in refs]
         # lost objects (freed/evicted while still referenced) reconstruct
         # from lineage before we block on the store
-        missing = [oid for oid in ids if not self.memory_store.contains(oid)]
+        missing = self.memory_store.missing_of(ids)
         if missing:
             self._check_env_lock_deadlock(missing)
             self.object_recovery.recover_all(missing)
@@ -670,8 +693,33 @@ class Worker:
             spec = self.task_manager.pending_spec_for_object(oid)
             env = spec.runtime_env if spec is not None else None
             if env and (env.get("working_dir_pkg") or env.get("pip")):
+                if self._spec_fits_process_pool(spec):
+                    # mixed topology: a process-backed node can satisfy
+                    # this producer's demands, and its workers apply
+                    # runtime envs WITHOUT the thread-mode lock — the
+                    # task is not necessarily stuck behind the caller,
+                    # so flagging it would be a spurious deadlock error
+                    continue
                 blocked.append(spec)
         return blocked
+
+    def _spec_fits_process_pool(self, spec: TaskSpec) -> bool:
+        """True when some process-backed node's declared resources cover
+        the spec's demands (i.e. the scheduler CAN run it off the local
+        thread pool). Heuristic on purpose: the grant may still land on
+        local threads, but erring toward not-raising beats failing a
+        program that can make progress."""
+        if not self._node_pools:
+            return False
+        demands = dict(spec.resources or {})
+        demands.setdefault("CPU", 0.0)
+        for entry in self.gcs.node_table():
+            if entry.pool is None or entry.kind == "local":
+                continue
+            caps = entry.resources
+            if all(caps.get(k, 0.0) >= v for k, v in demands.items()):
+                return True
+        return False
 
     def _check_env_lock_deadlock(self, missing: List[ObjectID]) -> None:
         """Fail loudly where a thread-mode env'd task would deadlock
@@ -688,6 +736,7 @@ class Worker:
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        self._drain_out_of_scope()
         ids = [r.object_id() for r in refs]
         if timeout is None:
             # deadlock only if the wait CANNOT be satisfied without an
@@ -768,6 +817,7 @@ class Worker:
         wakeup (reference: the lease-amortization idea of SURVEY §3.2's
         hot-loops note, applied to the submit side). Per-task return
         value shape matches submit_task."""
+        self._drain_out_of_scope()
         store_contains = self.memory_store.contains
         owned: List[tuple] = []
         all_deps: List[ObjectID] = []
@@ -776,7 +826,9 @@ class Worker:
             if spec.runtime_env and "working_dir" in spec.runtime_env:
                 spec.runtime_env = self.prepare_runtime_env(
                     spec.runtime_env)
-            for oid in spec.return_ids():
+            rids = spec.return_ids()
+            spec._returns_memo = rids  # reused by execution + ref build
+            for oid in rids:
                 owned.append((oid, spec.task_id))
             deps = (_top_level_deps(spec.args, spec.kwargs)
                     if (spec.args or spec.kwargs) else [])
@@ -798,7 +850,7 @@ class Worker:
             pendings.append(PendingTask(spec=spec, deps=unresolved,
                                         execute=_noop_exec))
             refs = []
-            for oid in spec.return_ids():
+            for oid in spec._returns_memo:
                 ref = ObjectRef(oid, self.worker_id, _register=False)
                 ref._weak = False  # counted in register_submit_batch
                 refs.append(ref)
@@ -881,6 +933,7 @@ class Worker:
         everything else takes the per-task path."""
         groups: Dict[Any, List[PendingTask]] = {}
         local: List[tuple] = []
+        fast: List[PendingTask] = []
         record = self.events.record
         for pending in pendings:
             spec = pending.spec
@@ -893,21 +946,121 @@ class Worker:
                        pending.node_index)
                 groups.setdefault(pool, []).append(pending)
             elif pool is None:
-                # host-thread execution: queue the whole tick's grants
-                # in one executor lock acquisition. One queue ITEM per
-                # task — pre-chunking per thread would lose work
-                # stealing and let a blocking task head-of-line its
-                # chunk (worst case: deadlock a producer queued behind
-                # its own consumer)
-                record(spec.task_id, spec.name, "dispatched",
-                       pending.node_index)
-                local.append((self._execute_task, (pending,)))
+                # host-thread execution. Plain tasks (no deps to
+                # resolve, no runtime env, no placement group, single
+                # return) take the drain fast path: a SHARED deque that
+                # every executor thread pulls from one task at a time —
+                # work stealing is preserved (pre-chunking per thread
+                # would let a blocking task head-of-line its chunk;
+                # worst case: deadlock a producer queued behind its own
+                # consumer) while completion bookkeeping amortizes
+                # per-drain instead of per-task
+                if (not spec.runtime_env
+                        and spec.placement_group_id is None
+                        and spec.num_returns == 1
+                        and not spec.kwargs
+                        and not getattr(spec, "_deps_memo", None)):
+                    fast.append(pending)
+                else:
+                    record(spec.task_id, spec.name, "dispatched",
+                           pending.node_index)
+                    local.append((self._execute_task, (pending,)))
             else:
                 self._dispatch(pending)
+        if fast:
+            self.events.record_batch(
+                ((p.spec.task_id, p.spec.name) for p in fast),
+                "dispatched")
+            dq: collections.deque = collections.deque(fast)
+            k = min(self._pool.num_threads, len(fast))
+            self._pool.submit_many(
+                [(self._drain_local_batch, (dq,))] * k)
         if local:
             self._pool.submit_many(local)
         for pool, batch in groups.items():
             self._pool.submit(self._run_pool_batch, pool, batch)
+
+    def _drain_local_batch(self, dq) -> None:
+        """Fast-path executor drain: plain no-dep NORMAL tasks from one
+        tick's grants. Per task it does only the irreducible work —
+        cancel-registry bracket, the user function, the result put, and
+        the scheduler notification (slot release must never wait on a
+        batch, or a blocked sibling could deadlock dependants).
+        Everything deferrable — task-manager lineage completion — is
+        flushed per drain. The deque is SHARED with the other executor
+        threads: each pops one task at a time, so a blocking task
+        stalls only itself (see _dispatch_many)."""
+        running = self._running_tasks
+        rlock = self._running_lock
+        record = self.events.record
+        put = self.memory_store.put
+        notify = self.scheduler.notify_batch
+        ctx = self._context
+        prev_task = ctx.task_id
+        prev_put = ctx.put_counter
+        done_ids: List[TaskID] = []
+        try:
+            while True:
+                try:
+                    pending = dq.popleft()
+                except IndexError:
+                    break
+                spec = pending.spec
+                exec_id = spec.task_id
+                with rlock:
+                    running[exec_id] = False
+                    if self._precancelled \
+                            and exec_id in self._precancelled:
+                        self._precancelled.discard(exec_id)
+                        running[exec_id] = True
+                ctx.task_id = exec_id
+                ctx.put_counter = 0
+                record(exec_id, spec.name, "started", pending.node_index)
+                rids = (getattr(spec, "_retry_return_ids", None)
+                        or getattr(spec, "_returns_memo", None)
+                        or spec.return_ids())
+                retry_task = None
+                ready = ()
+                try:
+                    if running.get(exec_id):
+                        self._store_error(
+                            spec, rids, rex.TaskCancelledError(exec_id))
+                    else:
+                        if self._inject_entry is not None:
+                            self._maybe_inject_failure()
+                        try:
+                            result = spec.func(*spec.args)
+                        except BaseException as e:  # noqa: BLE001
+                            retry_task = self._handle_task_failure(
+                                spec, rids, e)
+                        else:
+                            put(rids[0], result)
+                            ready = (rids[0],)
+                            done_ids.append(exec_id)
+                finally:
+                    with rlock:
+                        running.pop(exec_id, None)
+                    record(exec_id, spec.name, "finished",
+                           pending.node_index)
+                    notify(ready, ((exec_id, pending.node_index,
+                                    spec.resources),))
+                    if retry_task is not None:
+                        # finished-notification already out: the
+                        # scheduler sees the slot release before the
+                        # retry (same ordering as _execute_task)
+                        if done_ids:
+                            self.task_manager.complete_batch(done_ids)
+                            done_ids = []
+                        self.scheduler.submit(retry_task)
+                if len(done_ids) >= 256:
+                    self.task_manager.complete_batch(done_ids)
+                    done_ids = []
+        finally:
+            ctx.task_id = prev_task
+            ctx.put_counter = prev_put
+            if done_ids:
+                self.task_manager.complete_batch(done_ids)
+            self.placement_groups.poke()
 
     def _run_pool_batch(self, pool, batch: List[PendingTask]) -> None:
         try:
@@ -977,10 +1130,13 @@ class Worker:
             self._head_server = HeadServer()
         token = self._head_server.issue_token()
         slot_ev, slot = self._head_server.expect(token)
-        env = dict(os.environ)
-        env["RAY_TPU_HEAD_AUTHKEY"] = self._head_server.authkey.hex()
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in sys.path if p) + os.pathsep + env.get("PYTHONPATH", "")
+        # the daemon (and the workers it spawns) never owns the head's
+        # chip lease; strip accelerator plugin vars so a degraded tunnel
+        # can't hang its `import jax` (see spawn_env docstring)
+        from ray_tpu._private import spawn_env
+        env = spawn_env.child_env(
+            inherit_sys_path=True,
+            extra={"RAY_TPU_HEAD_AUTHKEY": self._head_server.authkey.hex()})
         host, port = self._head_server.address
         import json as _json
         info = _json.dumps({"num_cpus": num_cpus, "num_tpus": num_tpus,
@@ -1527,14 +1683,44 @@ class Worker:
             pool.free_remote([object_id])
 
     def _on_object_out_of_scope(self, object_id: ObjectID) -> None:
-        self.memory_store.delete([object_id])
+        # Deferred batch free: __del__-driven releases arrive one at a
+        # time (e.g. 50k refs dying after a batched get), and freeing
+        # per object pays store/lineage lock acquisitions per oid. A
+        # zero-refcount object can never regain references, so deferral
+        # is safe; the size threshold plus drains at the API entry
+        # points bound how long reclaim can lag.
+        q = self._oos_q
+        q.append(object_id)
+        if len(q) >= 128 or (self.shm_store is not None
+                             and self.shm_store.contains(object_id)):
+            # arena-resident objects are the memory that matters —
+            # reclaim those immediately; only small in-process entries
+            # ride the deferred batch
+            self._drain_out_of_scope()
+
+    def _drain_out_of_scope(self) -> None:
+        q = self._oos_q
+        if not q:
+            return
+        batch: List[ObjectID] = []
+        while True:
+            try:
+                batch.append(q.popleft())
+            except IndexError:
+                break
+        if not batch:
+            return
+        self.memory_store.delete(batch)
         if self.shm_store is not None:
-            self.shm_store.free_object(object_id)
-        self._free_remote_copy(object_id)
-        self.task_manager.evict_lineage(object_id.task_id())
+            for oid in batch:
+                self.shm_store.free_object(oid)
+        for oid in batch:
+            self._free_remote_copy(oid)
+        self.task_manager.evict_lineage_batch(batch)
 
     def shutdown(self) -> None:
         self.alive = False
+        self._drain_out_of_scope()
         self.placement_groups.shutdown()
         with self._actors_lock:
             actors = list(self.actors.values())
@@ -1708,15 +1894,29 @@ def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
         global_worker = Worker(num_cpus=num_cpus, num_workers=num_workers,
                                scheduler_factory=scheduler_factory,
                                resources=resources)
+        if GLOBAL_CONFIG.gc_tuning:
+            # see the config knob's docstring (including the freeze
+            # caveat); shutdown() undoes both
+            import gc
+            gc.collect()
+            gc.freeze()
+            gc.set_threshold(20_000, 20, 20)
+            global _gc_tuned
+            _gc_tuned = True
         return global_worker
 
 
 def shutdown() -> None:
-    global global_worker
+    global global_worker, _gc_tuned
     with _init_lock:
         if global_worker is not None:
             global_worker.shutdown()
             global_worker = None
+        if _gc_tuned:
+            import gc
+            gc.unfreeze()
+            gc.set_threshold(700, 10, 10)  # CPython defaults
+            _gc_tuned = False
         GLOBAL_CONFIG.unfreeze()
         # _system_config is scoped to one init/shutdown cycle; a leaked
         # worker_mode=process would silently re-route the next runtime

@@ -42,6 +42,14 @@ def prp_indices(lo: int, hi: int, n: int, seed: int) -> "np.ndarray":
 def _prp_indices_numpy(lo: int, hi: int, n: int,
                        keys: "np.ndarray") -> "np.ndarray":
     """Vectorized fallback: same network, uint32 in-place rounds."""
+    if n > (1 << 32):
+        # the uint32 rounds below would wrap and stop being a bijection
+        # (silent duplicated/dropped rows); the C++ path runs 64-bit
+        # state and handles this size
+        raise ValueError(
+            f"numpy PRP fallback supports domains up to 2^32 rows, got "
+            f"{n}; the native exchange library (ray_tpu._native) is "
+            "required for larger single-permutation domains")
     k = max((max(n, 2) - 1).bit_length(), 4)
     k += k & 1
     half = np.uint32(k // 2)

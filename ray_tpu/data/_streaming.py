@@ -153,9 +153,14 @@ def _shuffle_map_task(block, seed, i):
 def _shuffle_reduce_task(seed, j, num_out, *permuted):
     """Stage B: stripe j of every stage-A block (zero-copy slices),
     concatenated, then one PRP permute interleaves rows from different
-    sources. Stage A made each row's stripe — hence its output block —
-    uniform random; stage B makes within-block order uniform: the same
-    guarantee as the reference's map/reduce random_shuffle."""
+    sources. Stage A makes each row's stripe — hence its output block —
+    uniform random; stage B makes within-block order uniform. NOTE one
+    deliberate delta from the reference's map/reduce random_shuffle:
+    each output block draws a DETERMINISTIC (linspace) row count from
+    every input block, where the reference also randomizes the reducer
+    assignment — per-row placement and order remain uniform, so the
+    result is statistically indistinguishable for ML shuffling, but
+    output block sizes carry no multinomial jitter."""
     import numpy as np
 
     from ray_tpu.data import block as _blk

@@ -159,8 +159,16 @@ class Dataset:
         Arrow blocks via hash partition + table.group_by."""
         return GroupedDataset(self, key)
 
-    def random_shuffle(self, seed: int = 0,
+    def random_shuffle(self, seed: Optional[int] = None,
                        num_blocks: int = 0) -> "Dataset":
+        """Row shuffle via the two-stage PRP exchange.
+
+        seed=None (the reference's default) draws a fresh seed at plan
+        time, so unseeded shuffles differ across runs and chained
+        shuffles are uncorrelated; pass a seed for reproducibility."""
+        if seed is None:
+            import random as _random
+            seed = _random.randrange(1 << 31)
         return Dataset(_LogicalOp(
             "all_to_all", name="random_shuffle", num_blocks=num_blocks,
             fn=("shuffle", seed), parent=self._op))

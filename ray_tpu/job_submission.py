@@ -61,9 +61,20 @@ class JobSubmissionClient:
         job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
         log_path = os.path.join(self._dir, f"{job_id}.log")
         job = _Job(job_id, entrypoint, log_path, metadata)
-        env = dict(os.environ)
-        env["RAY_TPU_JOB_ID"] = job_id
-        env.update(env_vars or {})
+        # job drivers talk to the cluster over ray:// — the head owns
+        # the chip lease, so jobs default to CPU jax with the
+        # accelerator plugin vars stripped (a degraded tunnel would
+        # otherwise hang the job at `import jax`). A job that really
+        # wants the accelerator sets JAX_PLATFORMS to a non-cpu value
+        # in env_vars: that inherits the full plugin environment
+        # (stripping it would delete the bootstrap vars the plugin
+        # needs, making the opt-in impossible to express).
+        from ray_tpu._private import spawn_env
+        wants_accel = (env_vars or {}).get(
+            "JAX_PLATFORMS", "cpu").strip().lower() not in ("cpu", "")
+        env = spawn_env.child_env(
+            use_accelerator=wants_accel,
+            extra=dict({"RAY_TPU_JOB_ID": job_id}, **(env_vars or {})))
         log_f = open(log_path, "wb")
         job.proc = subprocess.Popen(
             entrypoint, shell=True, cwd=working_dir or os.getcwd(),

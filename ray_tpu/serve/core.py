@@ -109,6 +109,21 @@ def get_multiplexed_model_id() -> Optional[str]:
     return _current_model_id.get()
 
 
+def _with_model_id(gen, model_id):
+    """Re-enter the multiplexed-model-id contextvar around each step of
+    a streaming response, preserving laziness (see _Replica.handle_request)."""
+    while True:
+        token = _current_model_id.set(model_id)
+        try:
+            try:
+                item = next(gen)
+            except StopIteration:
+                return
+        finally:
+            _current_model_id.reset(token)
+        yield item
+
+
 def multiplexed(max_num_models_per_replica: int = 3):
     """Decorator for a per-replica model LOADER method (reference:
     @serve.multiplexed): results cache per model id in an LRU bounded
@@ -208,11 +223,14 @@ class _Replica:
             result = fn(*args, **kwargs)
             import inspect as _inspect
             if _inspect.isgenerator(result):
-                # the actor runtime would materialize it AFTER this
-                # finally reset the model-id contextvar — a generator
-                # body reading get_multiplexed_model_id() must run in
-                # scope
-                result = list(result)
+                # the actor runtime materializes the generator AFTER
+                # this finally resets the model-id contextvar, but a
+                # generator body reading get_multiplexed_model_id()
+                # must see it in scope — re-enter the contextvar around
+                # every next() instead of buffering the whole stream
+                # (deployment methods may legitimately stream long or
+                # unbounded responses)
+                result = _with_model_id(result, model_id)
             return result
         finally:
             _current_model_id.reset(token)

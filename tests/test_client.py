@@ -19,6 +19,7 @@ import time
 import pytest
 
 import ray_tpu
+from ray_tpu._private import spawn_env
 from ray_tpu._private import worker as worker_mod
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -27,10 +28,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.fixture(scope="module")
 def head():
     ray_tpu.shutdown()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # head runs WITHOUT jax platform tweaks from conftest; force cpu to
-    # keep startup light
+    env = spawn_env.child_env(repo_path=REPO)
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu", "start", "--head",
          "--num-cpus", "4", "--num-workers", "4",
@@ -179,8 +177,7 @@ class TestClientActors:
 class TestCliNodeJoin:
     def test_node_joins_via_cli(self, head, client):
         _proc, address = head
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env = spawn_env.child_env(repo_path=REPO)
         node = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu", "start",
              "--address", address, "--num-cpus", "2",

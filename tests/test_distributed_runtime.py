@@ -62,11 +62,10 @@ def test_two_process_global_mesh(tmp_path):
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     coord = f"127.0.0.1:{port}"
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU plugin in children
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    from ray_tpu._private import spawn_env
+    env = spawn_env.child_env(
+        repo_path=REPO,
+        extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
     procs = [
         subprocess.Popen(
             [sys.executable, "-c",

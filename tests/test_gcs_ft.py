@@ -25,6 +25,7 @@ import time
 import pytest
 
 import ray_tpu
+from ray_tpu._private import spawn_env
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -32,8 +33,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _start_head(journal: str, log_path: str, port: int = 0):
     """Output goes to a FILE: worker grandchildren inherit the fd, so a
     pipe would never EOF (and diagnostics would be lost on kill)."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = spawn_env.child_env(repo_path=REPO)
     cmd = [sys.executable, "-m", "ray_tpu", "start", "--head",
            "--num-cpus", "2", "--num-workers", "2",
            "--gcs-journal", journal]
@@ -63,9 +63,8 @@ def _start_head(journal: str, log_path: str, port: int = 0):
 
 
 def _start_node(address: str, log_path: str):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["RAY_TPU_DAEMON_REJOIN_TIMEOUT_S"] = "60"
+    env = spawn_env.child_env(
+        repo_path=REPO, extra={"RAY_TPU_DAEMON_REJOIN_TIMEOUT_S": "60"})
     log = open(log_path, "a")
     return subprocess.Popen(
         [sys.executable, "-m", "ray_tpu", "start",
