@@ -36,9 +36,12 @@ def strip_accelerator(env: Dict[str, str]) -> Dict[str, str]:
 
     Mutates and returns *env*. After this, a child's ``import jax``
     cannot boot the tunnel plugin (nothing registers it), so the plain
-    ``JAX_PLATFORMS=cpu`` env pin is authoritative in the child.
+    ``JAX_PLATFORMS=cpu`` env pin is authoritative in the child. An
+    explicitly chosen NON-axon platform (e.g. ``JAX_PLATFORMS=cuda``)
+    is preserved — only unset/axon values are re-pinned.
     """
-    env["JAX_PLATFORMS"] = "cpu"
+    if env.get("JAX_PLATFORMS", "").strip().lower() in ("", "axon"):
+        env["JAX_PLATFORMS"] = "cpu"
     for key in list(env):
         if key.startswith(_ACCEL_PREFIXES):
             del env[key]

@@ -38,6 +38,7 @@ logger = logging.getLogger(__name__)
 global_worker: Optional["Worker"] = None
 _init_lock = threading.Lock()
 _gc_tuned = False
+_gc_saved_threshold = (700, 10, 10)
 
 
 def _noop_exec(task, node_index) -> None:
@@ -133,6 +134,32 @@ class TaskManager:
         with self._lock:
             for task_id in task_ids:
                 self._complete_locked(task_id)
+
+    def complete_batch_with_refs(self, pairs,
+                                 has_reference) -> None:
+        """Deferred completion for the fast path: ``pairs`` is
+        [(task_id, return_oid)]. Because these completions run AFTER
+        the object-ready notification, the return ref may already be
+        dead — its out-of-scope eviction would then have run before
+        this lineage insert, stranding the spec in ``_lineage``
+        forever. Checking liveness under the table lock closes that
+        window (a concurrent eviction blocks on this same lock)."""
+        with self._lock:
+            for task_id, oid in pairs:
+                entry = self._pending.pop(task_id, None)
+                if entry is None:
+                    continue
+                spec, _ = entry
+                rr = getattr(spec, "_retry_return_ids", None)
+                key = rr[0].task_id() if rr else task_id
+                self._pending_origin.pop(key, None)
+                if not has_reference(oid):
+                    continue  # returns already dead: nothing to recover
+                if key not in self._lineage:
+                    self._lineage_bytes += 256
+                self._lineage[key] = spec
+                if self._lineage_bytes > self._lineage_cap.value:
+                    self._evict_lineage_locked()
 
     def _complete_locked(self, task_id: TaskID) -> None:
         entry = self._pending.pop(task_id, None)
@@ -998,7 +1025,9 @@ class Worker:
         ctx = self._context
         prev_task = ctx.task_id
         prev_put = ctx.put_counter
-        done_ids: List[TaskID] = []
+        complete = self.task_manager.complete_batch_with_refs
+        has_ref = self.reference_counter.has_reference
+        done: List[tuple] = []
         try:
             while True:
                 try:
@@ -1036,7 +1065,7 @@ class Worker:
                         else:
                             put(rids[0], result)
                             ready = (rids[0],)
-                            done_ids.append(exec_id)
+                            done.append((exec_id, rids[0]))
                 finally:
                     with rlock:
                         running.pop(exec_id, None)
@@ -1048,18 +1077,18 @@ class Worker:
                         # finished-notification already out: the
                         # scheduler sees the slot release before the
                         # retry (same ordering as _execute_task)
-                        if done_ids:
-                            self.task_manager.complete_batch(done_ids)
-                            done_ids = []
+                        if done:
+                            complete(done, has_ref)
+                            done = []
                         self.scheduler.submit(retry_task)
-                if len(done_ids) >= 256:
-                    self.task_manager.complete_batch(done_ids)
-                    done_ids = []
+                if len(done) >= 256:
+                    complete(done, has_ref)
+                    done = []
         finally:
             ctx.task_id = prev_task
             ctx.put_counter = prev_put
-            if done_ids:
-                self.task_manager.complete_batch(done_ids)
+            if done:
+                complete(done, has_ref)
             self.placement_groups.poke()
 
     def _run_pool_batch(self, pool, batch: List[PendingTask]) -> None:
@@ -1896,12 +1925,14 @@ def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
                                resources=resources)
         if GLOBAL_CONFIG.gc_tuning:
             # see the config knob's docstring (including the freeze
-            # caveat); shutdown() undoes both
+            # caveat); shutdown() undoes both, restoring the HOST
+            # program's thresholds, not CPython defaults
             import gc
+            global _gc_tuned, _gc_saved_threshold
+            _gc_saved_threshold = gc.get_threshold()
             gc.collect()
             gc.freeze()
             gc.set_threshold(20_000, 20, 20)
-            global _gc_tuned
             _gc_tuned = True
         return global_worker
 
@@ -1915,7 +1946,7 @@ def shutdown() -> None:
         if _gc_tuned:
             import gc
             gc.unfreeze()
-            gc.set_threshold(700, 10, 10)  # CPython defaults
+            gc.set_threshold(*_gc_saved_threshold)
             _gc_tuned = False
         GLOBAL_CONFIG.unfreeze()
         # _system_config is scoped to one init/shutdown cycle; a leaked

@@ -227,9 +227,11 @@ class _Replica:
                 # this finally resets the model-id contextvar, but a
                 # generator body reading get_multiplexed_model_id()
                 # must see it in scope — re-enter the contextvar around
-                # every next() instead of buffering the whole stream
-                # (deployment methods may legitimately stream long or
-                # unbounded responses)
+                # every next(). NOTE the actor runtime still buffers
+                # generator results when crossing the actor boundary,
+                # so this preserves laziness only for same-process
+                # composition; true cross-actor streaming is the
+                # streaming-generator path (SSE ingress), not this.
                 result = _with_model_id(result, model_id)
             return result
         finally:
