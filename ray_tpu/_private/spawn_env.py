@@ -40,7 +40,12 @@ def strip_accelerator(env: Dict[str, str]) -> Dict[str, str]:
     explicitly chosen NON-axon platform (e.g. ``JAX_PLATFORMS=cuda``)
     is preserved — only unset/axon values are re-pinned.
     """
-    if env.get("JAX_PLATFORMS", "").strip().lower() in ("", "axon"):
+    tokens = [t.strip().lower()
+              for t in env.get("JAX_PLATFORMS", "").split(",")]
+    if not any(tokens) or "axon" in tokens:
+        # unset, or any form naming axon (including comma lists like
+        # "axon,cpu") — the axon registration is being stripped below,
+        # so leaving the name would make the child fail at backend init
         env["JAX_PLATFORMS"] = "cpu"
     for key in list(env):
         if key.startswith(_ACCEL_PREFIXES):

@@ -11,11 +11,16 @@ clipped surrogate + value/entropy terms) on device — no DDP learner
 group; scaling the learner is a sharding annotation, not more actors.
 """
 
+from ray_tpu.rllib.core import (Algorithm, AlgorithmConfig,  # noqa: F401
+                                DiscreteMLP, GaussianMLP, RLModule,
+                                module_for_env)
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
-from ray_tpu.rllib.connectors import (Connector,  # noqa: F401
-                                      ConnectorPipeline, Lambda,
-                                      ObsNormalizer)
-from ray_tpu.rllib.env import CartPoleEnv  # noqa: F401
+from ray_tpu.rllib.connectors import (ActionClip,  # noqa: F401
+                                      ActionConnector, ActionLambda,
+                                      ActionPipeline, ActionRescale,
+                                      Connector, ConnectorPipeline,
+                                      Lambda, ObsNormalizer)
+from ray_tpu.rllib.env import CartPoleEnv, PendulumEnv  # noqa: F401
 from ray_tpu.rllib.impala import (APPO, APPOConfig,  # noqa: F401
                                   IMPALA, IMPALAConfig)
 from ray_tpu.rllib.multi_agent import (IndependentCartPoles,  # noqa: F401
@@ -25,9 +30,12 @@ from ray_tpu.rllib.offline import (BC, BCConfig,  # noqa: F401
                                    collect_episodes)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 
-__all__ = ["PPOConfig", "PPO", "DQNConfig", "DQN", "IMPALAConfig",
+__all__ = ["Algorithm", "AlgorithmConfig", "RLModule", "DiscreteMLP",
+           "GaussianMLP", "module_for_env",
+           "PPOConfig", "PPO", "DQNConfig", "DQN", "IMPALAConfig",
            "IMPALA", "APPOConfig", "APPO", "BCConfig", "BC",
-           "collect_episodes", "CartPoleEnv", "MultiAgentEnv",
-           "MultiAgentPPOConfig", "MultiAgentPPO",
+           "collect_episodes", "CartPoleEnv", "PendulumEnv",
+           "MultiAgentEnv", "MultiAgentPPOConfig", "MultiAgentPPO",
            "IndependentCartPoles", "Connector", "ConnectorPipeline",
-           "Lambda", "ObsNormalizer"]
+           "Lambda", "ObsNormalizer", "ActionConnector", "ActionClip",
+           "ActionRescale", "ActionLambda", "ActionPipeline"]

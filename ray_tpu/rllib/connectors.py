@@ -109,6 +109,65 @@ class ObsNormalizer(Connector):
         return out
 
 
+class ActionConnector:
+    """One module-to-env transform (reference: ConnectorV2's
+    module-to-env pieces — action clipping/rescaling between the policy
+    sample and env.step). Stateless: applied batched [N, act] inside
+    the runner; the RAW action (and its logp) goes into the sample
+    batch, the TRANSFORMED action goes to the env."""
+
+    def to_env(self, actions: "np.ndarray") -> "np.ndarray":
+        raise NotImplementedError
+
+
+class ActionClip(ActionConnector):
+    """Clip actions to the env's bounds (the standard companion of an
+    unsquashed gaussian head)."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def to_env(self, actions):
+        return np.clip(actions, self.low, self.high)
+
+
+class ActionRescale(ActionConnector):
+    """Map policy-space [-1, 1] actions to env bounds [low, high]
+    (tanh-squash companions; compose after a Lambda(np.tanh))."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def to_env(self, actions):
+        return self.low + (np.asarray(actions) + 1.0) * 0.5 \
+            * (self.high - self.low)
+
+
+class ActionLambda(ActionConnector):
+    """Stateless functional action transform (e.g. np.tanh squash)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def to_env(self, actions):
+        return self._fn(actions)
+
+
+class ActionPipeline:
+    """Ordered module-to-env action transforms."""
+
+    def __init__(self, connectors: List[ActionConnector]):
+        self.connectors = list(connectors)
+
+    def to_env(self, actions: "np.ndarray") -> "np.ndarray":
+        out = actions
+        for c in self.connectors:
+            out = c.to_env(out)
+        return out
+
+
 class ConnectorPipeline:
     """Ordered connectors; runners apply it per observation batch and
     return their local state deltas for the driver to merge."""

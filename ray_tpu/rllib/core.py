@@ -1,0 +1,387 @@
+"""rllib core abstractions: RLModule, action distributions, Algorithm.
+
+Reference surface: ray: rllib/core/rl_module/ (RLModule — one policy
+abstraction every algorithm shares), rllib/core/learner/,
+rllib/algorithms/algorithm.py + algorithm_config.py (Algorithm/
+AlgorithmConfig — build/train/stop/checkpoint). Round 4 grew six
+bespoke algorithm classes sharing internals by import; this module is
+the single frame they all plug into:
+
+- ``RLModule``: init / jittable apply -> distribution inputs / numpy
+  rollout-side sampling / jnp learner-side logp+entropy. Two concrete
+  modules: ``DiscreteMLP`` (categorical head + value) and
+  ``GaussianMLP`` (diagonal-gaussian head + value, continuous control).
+- ``AlgorithmConfig``: the shared config root (env, runners, optimizer
+  family, connector pipelines, seed) with ``build()``.
+- ``Algorithm``: env probe, module selection from the env's action
+  space (the reference infers the distribution the same way), runner
+  group construction, checkpoint save/restore, ``train()``/``stop()``.
+
+TPU-first stance unchanged: every learner is ONE jitted update; the
+module's ``apply`` is pure and shape-stable so XLA caches a single
+executable per (module, batch-shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+# ----------------------------------------------------------------------
+# modules (reference: rllib/core/rl_module/)
+# ----------------------------------------------------------------------
+
+def _mlp_init(rng, sizes):
+    import jax
+
+    keys = jax.random.split(rng, len(sizes) - 1)
+    layers = []
+    for k, (m, n) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (m, n)) * (1.0 / np.sqrt(m))
+        layers.append((w, np.zeros(n, np.float32)))
+    return {"layers": layers}
+
+
+def _mlp_apply(params, x):
+    import jax.numpy as jnp
+
+    for i, (w, b) in enumerate(params["layers"]):
+        x = x @ w + b
+        if i < len(params["layers"]) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def _np_logsumexp(x):
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))
+
+
+class RLModule:
+    """One policy abstraction shared by every algorithm.
+
+    Split by where the code runs:
+    - ``apply(params, obs)`` — pure/jittable; returns the distribution
+      inputs tuple (the runner jits it once, the learner traces it
+      inside the loss).
+    - ``np_sample(dist, rng)`` — numpy, on the env-runner host: sample
+      actions + behavior logp from the distribution inputs.
+    - ``logp_entropy(dist, actions)`` — jnp, inside the jitted loss:
+      per-sample target logp and per-sample entropy.
+    - ``value_of(dist)`` — the critic value from the same forward.
+    """
+
+    def init(self, rng):
+        raise NotImplementedError
+
+    def apply(self, params, obs):
+        raise NotImplementedError
+
+    def np_sample(self, dist, rng):
+        raise NotImplementedError
+
+    def logp_entropy(self, dist, actions):
+        raise NotImplementedError
+
+    def kl(self, dist_a, dist_b):
+        """Per-sample KL(dist_a || dist_b) from two dist-input tuples
+        (value heads ignored) — APPO's adaptive penalty term."""
+        raise NotImplementedError
+
+    def value_of(self, dist):
+        return dist[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscreteMLP(RLModule):
+    """tanh-MLP -> (logits, value); categorical actions."""
+
+    obs_dim: int
+    num_actions: int
+    hidden: int = 32
+
+    def init(self, rng):
+        return _mlp_init(rng, [self.obs_dim, self.hidden, self.hidden,
+                               self.num_actions + 1])
+
+    def apply(self, params, obs):
+        out = _mlp_apply(params, obs)
+        return out[..., :-1], out[..., -1]  # logits, value
+
+    def np_sample(self, dist, rng):
+        logits = np.asarray(dist[0])
+        u = rng.gumbel(size=logits.shape)
+        actions = np.argmax(logits + u, axis=-1)
+        logp_all = logits - _np_logsumexp(logits)
+        logp = np.take_along_axis(
+            logp_all, actions[..., None], axis=-1)[..., 0]
+        return actions.astype(np.int32), logp.astype(np.float32)
+
+    def logp_entropy(self, dist, actions):
+        import jax
+
+        logits = dist[0]
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jax.numpy.take_along_axis(
+            logp_all, actions[..., None], axis=-1)[..., 0]
+        entropy = -(jax.numpy.exp(logp_all) * logp_all).sum(-1)
+        return logp, entropy
+
+    def kl(self, dist_a, dist_b):
+        import jax
+
+        la = jax.nn.log_softmax(dist_a[0])
+        lb = jax.nn.log_softmax(dist_b[0])
+        return (jax.numpy.exp(la) * (la - lb)).sum(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class GaussianMLP(RLModule):
+    """tanh-MLP -> (mean, log_std, value); diagonal-gaussian actions.
+
+    The log_std is a state-independent learned vector (the reference
+    PPO default for continuous control). Sampling returns the RAW
+    gaussian action; squashing/clipping to the env's bounds is the
+    module-to-env action connector's job, and logp is taken on the raw
+    action (standard for clip-style bounds)."""
+
+    obs_dim: int
+    action_dim: int
+    hidden: int = 32
+
+    def init(self, rng):
+        params = _mlp_init(rng, [self.obs_dim, self.hidden, self.hidden,
+                                 self.action_dim + 1])
+        params["log_std"] = np.full(self.action_dim, -0.5, np.float32)
+        return params
+
+    def apply(self, params, obs):
+        import jax.numpy as jnp
+
+        out = _mlp_apply(params, obs)
+        mean = out[..., :self.action_dim]
+        value = out[..., -1]
+        log_std = jnp.broadcast_to(params["log_std"], mean.shape)
+        return mean, log_std, value
+
+    def np_sample(self, dist, rng):
+        mean, log_std = np.asarray(dist[0]), np.asarray(dist[1])
+        std = np.exp(log_std)
+        noise = rng.standard_normal(mean.shape).astype(np.float32)
+        actions = mean + std * noise
+        logp = (-0.5 * np.square(noise) - log_std
+                - 0.5 * np.log(2 * np.pi)).sum(-1)
+        return actions.astype(np.float32), logp.astype(np.float32)
+
+    def logp_entropy(self, dist, actions):
+        import jax.numpy as jnp
+
+        mean, log_std = dist[0], dist[1]
+        z = (actions - mean) / jnp.exp(log_std)
+        logp = (-0.5 * jnp.square(z) - log_std
+                - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
+        entropy = (log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e)).sum(-1)
+        entropy = jnp.broadcast_to(entropy, logp.shape)
+        return logp, entropy
+
+    def kl(self, dist_a, dist_b):
+        import jax.numpy as jnp
+
+        ma, la = dist_a[0], dist_a[1]
+        mb, lb = dist_b[0], dist_b[1]
+        va, vb = jnp.exp(2 * la), jnp.exp(2 * lb)
+        return (lb - la
+                + (va + jnp.square(ma - mb)) / (2 * vb) - 0.5).sum(-1)
+
+
+def module_for_env(env, hidden: int) -> RLModule:
+    """The reference's behavior: infer the action distribution from the
+    env's action space — ``num_actions`` -> categorical,
+    ``action_dim`` -> diagonal gaussian."""
+    if getattr(env, "action_dim", 0):
+        return GaussianMLP(env.observation_dim, env.action_dim, hidden)
+    return DiscreteMLP(env.observation_dim, env.num_actions, hidden)
+
+
+# ----------------------------------------------------------------------
+# config + algorithm (reference: rllib/algorithms/algorithm.py)
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AlgorithmConfig:
+    """Shared config root. Subclasses add algorithm-specific fields and
+    set ``algo_class``; ``build()`` is the one construction path."""
+
+    env_maker: Any = None            # seed -> env (default CartPole)
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_len: int = 128
+    hidden: int = 32
+    lr: float = 3e-3
+    gamma: float = 0.99
+    max_grad_norm: float = 0.5
+    # env-to-module connector pipeline (reference: ConnectorV2):
+    # observation transforms applied in every runner, with exact
+    # parallel-Welford state merging for stateful connectors
+    obs_connectors: Any = None
+    # module-to-env connector pipeline: action transforms (clip,
+    # rescale, squash) applied between the policy sample and env.step
+    action_connectors: Any = None
+    seed: int = 0
+
+    algo_class: Any = dataclasses.field(default=None, repr=False)
+
+    def build(self) -> "Algorithm":
+        cls = type(self).algo_class
+        if cls is None:
+            raise TypeError(
+                f"{type(self).__name__} has no algo_class; use a "
+                "concrete algorithm config (PPOConfig, DQNConfig, ...)")
+        return cls(self)
+
+
+class Algorithm:
+    """Base: env probe, module selection, runner group, checkpoints.
+
+    Subclasses implement ``setup()`` (build the learner state: update
+    fn, optimizer, buffers) and ``train()`` (one iteration returning
+    the reference's result-dict shape), and may override
+    ``_runner_args()`` when their runner actor signature differs.
+    """
+
+    #: runner actor class; subclasses override (ppo._EnvRunner etc.)
+    runner_cls: Any = None
+    #: runners buffer+ship behavior dist inputs only when the learner
+    #: reads them (APPO's KL term)
+    needs_dist_inputs: bool = False
+
+    def __init__(self, config: AlgorithmConfig):
+        import jax
+
+        self.config = config
+        self._env_maker = (config.env_maker
+                           if config.env_maker is not None
+                           else self._default_env_maker())
+        probe = self._env_maker(0)
+        self._probe = probe  # reused by setup() overrides
+        # multi-agent envs expose per-agent dict variants instead
+        self._obs_dim = getattr(probe, "observation_dim", None)
+        self._num_actions = getattr(probe, "num_actions", 0)
+        self._action_dim = getattr(probe, "action_dim", 0)
+        self.module = self._make_module(probe)
+        if self.module is not None:
+            self.params = self.module.init(
+                jax.random.PRNGKey(config.seed))
+        self.iteration = 0
+        self._pipeline = None
+        self._connector_state = None
+        if getattr(config, "obs_connectors", None):
+            from ray_tpu.rllib.connectors import ConnectorPipeline
+
+            self._pipeline = ConnectorPipeline(
+                list(config.obs_connectors))
+            self._connector_state = self._pipeline.init_state()
+        self._action_pipeline = None
+        if getattr(config, "action_connectors", None):
+            from ray_tpu.rllib.connectors import ActionPipeline
+
+            self._action_pipeline = ActionPipeline(
+                list(config.action_connectors))
+        # setup() builds learner state BEFORE the runner group exists
+        # (multi-policy algorithms derive the runner args there);
+        # after_runners() is the post-group hook (async algorithms arm
+        # their sampling pipeline there)
+        self.setup()
+        self._group = None
+        if self.runner_cls is not None and config.num_env_runners > 0:
+            from ray_tpu.rllib.runner_group import RunnerGroup
+
+            self._group = RunnerGroup(
+                self.runner_cls, self._runner_args,
+                config.num_env_runners, config.seed)
+        self.after_runners()
+
+    # -- hooks ----------------------------------------------------------
+    def _default_env_maker(self) -> Callable[[int], Any]:
+        from ray_tpu.rllib.env import CartPoleEnv
+
+        return lambda seed: CartPoleEnv(seed)
+
+    def _make_module(self, probe_env) -> Optional[RLModule]:
+        return module_for_env(probe_env, self.config.hidden)
+
+    def _runner_args(self, seed: int) -> tuple:
+        """Constructor args for one runner actor (reference:
+        EnvRunnerGroup's per-worker config)."""
+        cfg = self.config
+        return (self._env_maker, cfg.num_envs_per_runner,
+                cfg.rollout_len, seed, self._pipeline, self.module,
+                self._action_pipeline, self.needs_dist_inputs)
+
+    def setup(self) -> None:
+        """Build learner state (update fn, optimizer, buffers)."""
+
+    def after_runners(self) -> None:
+        """Runs once the runner group exists (async pipelines arm
+        their first samples here)."""
+
+    def train(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- shared plumbing ------------------------------------------------
+    @property
+    def _runners(self):
+        return self._group.runners if self._group is not None else []
+
+    def _merge_connector_deltas(self, batches: List[Dict]) -> None:
+        if self._pipeline is None:
+            return
+        deltas = [b["connector_state"] for b in batches
+                  if "connector_state" in b]
+        if deltas:
+            # prior + disjoint per-runner deltas: exact parallel-
+            # Welford combine, identical to one single stream
+            self._connector_state = self._pipeline.merge(
+                [self._connector_state] + deltas)
+
+    def stop(self) -> None:
+        if self._group is not None:
+            self._group.stop()
+
+    # -- checkpointing (reference: Algorithm.save/restore) --------------
+    def checkpoint_state(self) -> Dict[str, Any]:
+        state = {"iteration": self.iteration,
+                 "connector_state": self._connector_state}
+        for attr in ("params", "opt_state", "target_params",
+                     "kl_coef", "env_steps", "grad_steps"):
+            if hasattr(self, attr):
+                state[attr] = getattr(self, attr)
+        return state
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        for key, value in state.items():
+            if key == "connector_state":
+                self._connector_state = value
+            else:
+                setattr(self, key, value)
+
+    def save_checkpoint(self, path: str) -> str:
+        import jax
+
+        # device arrays -> host; plain Python scalars (iteration,
+        # kl_coef, ...) stay scalars so restored metrics dicts remain
+        # JSON-serializable
+        state = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
+            self.checkpoint_state())
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    def restore_checkpoint(self, path: str) -> None:
+        with open(path, "rb") as f:
+            self.restore_state(pickle.load(f))

@@ -16,6 +16,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu import exceptions as rex
+from ray_tpu.rllib.core import Algorithm, AlgorithmConfig, RLModule
 
 # ----------------------------------------------------------------------
 # Q network (flax-free MLP, same parameter pytree style as ppo.py)
@@ -185,15 +186,32 @@ def _make_update(lr: float, gamma: float, max_grad_norm: float):
 # config + algorithm (reference: DQNConfig / Algorithm.train())
 # ----------------------------------------------------------------------
 
+class _QModule(RLModule):
+    """Q-network as an RLModule (argmax policy; exploration is the
+    runner's epsilon-greedy, not a distribution sample)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: int):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = hidden
+
+    def init(self, rng):
+        return _q_init(rng, self.obs_dim, self.num_actions, self.hidden)
+
+    def apply(self, params, obs):
+        return (_q_apply(params, obs),)
+
+    def np_sample(self, dist, rng):
+        q = np.asarray(dist[0])
+        actions = q.argmax(-1).astype(np.int32)
+        return actions, np.zeros(actions.shape, np.float32)
+
+
 @dataclasses.dataclass
-class DQNConfig:
-    env_maker: Any = None
-    num_env_runners: int = 2
-    num_envs_per_runner: int = 4
+class DQNConfig(AlgorithmConfig):
     rollout_len: int = 64
     hidden: int = 64
     lr: float = 1e-3
-    gamma: float = 0.99
     buffer_capacity: int = 50_000
     batch_size: int = 128
     updates_per_iteration: int = 32
@@ -203,50 +221,33 @@ class DQNConfig:
     epsilon_end: float = 0.05
     epsilon_decay_steps: int = 4_000  # env steps to anneal over
     max_grad_norm: float = 10.0
-    seed: int = 0
-
-    def build(self) -> "DQN":
-        return DQN(self)
 
 
-class DQN:
-    def __init__(self, config: DQNConfig):
+class DQN(Algorithm):
+    runner_cls = _DQNRunner
+
+    def _make_module(self, probe_env):
+        return _QModule(probe_env.observation_dim,
+                        probe_env.num_actions, self.config.hidden)
+
+    def _runner_args(self, seed: int) -> tuple:
+        cfg = self.config
+        return (self._env_maker, cfg.num_envs_per_runner,
+                cfg.rollout_len, seed)
+
+    def setup(self) -> None:
         import jax
 
-        self.config = config
-        if config.env_maker is not None:
-            self._env_maker = config.env_maker
-        else:
-            from ray_tpu.rllib.env import CartPoleEnv
-
-            self._env_maker = lambda seed: CartPoleEnv(seed)
-        env = self._env_maker(0)
-        self._obs_dim = env.observation_dim
-        self._num_actions = env.num_actions
-        self.params = _q_init(jax.random.PRNGKey(config.seed),
-                              self._obs_dim, self._num_actions,
-                              config.hidden)
+        config = self.config
         self.target_params = jax.tree_util.tree_map(
             lambda x: x, self.params)
         self._optimizer, self._update = _make_update(
             config.lr, config.gamma, config.max_grad_norm)
         self.opt_state = self._optimizer.init(self.params)
         self.buffer = ReplayBuffer(config.buffer_capacity, self._obs_dim)
-        self.iteration = 0
         self.env_steps = 0
         self.grad_steps = 0
         self._rng = np.random.default_rng(config.seed)
-        from ray_tpu.rllib.runner_group import RunnerGroup
-        cfg2 = self.config
-        self._group = RunnerGroup(
-            _DQNRunner,
-            lambda seed: (self._env_maker, cfg2.num_envs_per_runner,
-                          cfg2.rollout_len, seed),
-            cfg2.num_env_runners, cfg2.seed)
-
-    @property
-    def _runners(self):
-        return self._group.runners
 
     @property
     def epsilon(self) -> float:
@@ -300,5 +301,5 @@ class DQN:
             "loss": float(np.mean(losses)) if losses else float("nan"),
         }
 
-    def stop(self) -> None:
-        self._group.stop()
+
+DQNConfig.algo_class = DQN

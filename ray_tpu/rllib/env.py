@@ -64,3 +64,59 @@ class CartPoleEnv:
                     or abs(theta) > self.THETA_LIMIT
                     or self._t >= self.MAX_STEPS)
         return self._state.astype(np.float32), 1.0, done
+
+
+class PendulumEnv:
+    """Continuous-control pendulum swing-up (the classic Pendulum-v1
+    dynamics: state (theta, theta_dot), observation (cos, sin,
+    theta_dot), torque in [-2, 2], reward
+    -(theta^2 + 0.1*theta_dot^2 + 0.001*torque^2), 200-step episodes).
+
+    Exposes ``action_dim``/``action_low``/``action_high`` instead of
+    ``num_actions`` — the Algorithm frame infers a gaussian policy head
+    from this, the way the reference infers the distribution from the
+    env's action space."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    observation_dim = 3
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._theta = 0.0
+        self._theta_dot = 0.0
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._theta), np.sin(self._theta),
+                         self._theta_dot], np.float32)
+
+    def reset(self) -> np.ndarray:
+        self._theta = self._rng.uniform(-np.pi, np.pi)
+        self._theta_dot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs()
+
+    def step(self, action) -> Tuple[np.ndarray, float, bool]:
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.MAX_TORQUE, self.MAX_TORQUE))
+        th, thdot = self._theta, self._theta_dot
+        # normalize angle to [-pi, pi] for the cost
+        angle = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = angle ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3 * self.G / (2 * self.L) * np.sin(th)
+                         + 3.0 / (self.M * self.L ** 2) * u) * self.DT
+        thdot = float(np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED))
+        th = th + thdot * self.DT
+        self._theta, self._theta_dot = th, thdot
+        self._t += 1
+        return self._obs(), -float(cost), self._t >= self.MAX_STEPS

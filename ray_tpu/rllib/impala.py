@@ -9,11 +9,19 @@ fan-in) and hands the freshest params only to the runner it just
 drained. Staleness is bounded by the pipeline depth (one outstanding
 rollout per runner), and V-trace importance weights correct for it.
 
+APPO (reference: rllib/algorithms/appo/) rides the same chassis with
+three additions that make it an algorithm rather than a flag: PPO's
+clipped surrogate on the V-trace-corrected advantages, an ADAPTIVE KL
+penalty against the behavior distribution (coefficient doubles/halves
+toward kl_target, rllib's update_kl schedule), and a TARGET VALUE
+NETWORK whose estimates compute the V-trace targets (synced every
+target_update_freq updates).
+
 TPU-first differences from the reference: the learner is ONE jitted
 program — V-trace itself runs on device as a `jax.lax.scan` (the
 reference computes corrections in torch on the learner host), so the
-whole update (correction + policy gradient + value + entropy) is a
-single XLA executable; scaling the learner is a sharding annotation,
+whole update (correction + policy gradient + value + entropy + KL) is
+a single XLA executable; scaling the learner is a sharding annotation,
 not a learner-group of processes.
 """
 
@@ -27,17 +35,20 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu import exceptions as rex
-from ray_tpu.rllib.ppo import _EnvRunner, _policy_apply, _policy_init
+from ray_tpu.rllib.core import Algorithm, AlgorithmConfig, DiscreteMLP
+from ray_tpu.rllib.ppo import _EnvRunner
 
 
 def _make_update(lr: float, gamma: float, vf_coeff: float,
                  ent_coeff: float, max_grad_norm: float,
                  rho_bar: float, c_bar: float,
-                 clip: float = 0.0):
+                 clip: float = 0.0, use_kl: bool = False,
+                 module=None):
     import jax
     import jax.numpy as jnp
     import optax
 
+    module = module if module is not None else DiscreteMLP(0, 0, 0)
     optimizer = optax.chain(optax.clip_by_global_norm(max_grad_norm),
                             optax.rmsprop(lr, decay=0.99, eps=1e-5))
 
@@ -65,24 +76,30 @@ def _make_update(lr: float, gamma: float, vf_coeff: float,
         pg_adv = clipped_rho * (rewards + discounts * vs_next - values)
         return vs, pg_adv
 
-    def loss_fn(params, obs, actions, behavior_logp, rewards, dones,
-                last_obs):
-        T, B = actions.shape
-        logits, values = _policy_apply(params, obs)  # [T, B, A], [T, B]
-        logp_all = jax.nn.log_softmax(logits)
-        target_logp = jnp.take_along_axis(
-            logp_all, actions[..., None], axis=-1)[..., 0]
-        _, last_value = _policy_apply(params, last_obs)  # [B]
+    def loss_fn(params, target_params, kl_coef, obs, actions,
+                behavior_logp, behavior_dist, rewards, dones, last_obs):
+        dist = module.apply(params, obs)
+        values = module.value_of(dist)
+        target_logp, entropy = module.logp_entropy(dist, actions)
+        # V-trace baseline values: the TARGET network's estimates when
+        # one is provided (APPO), else the online net's (IMPALA)
+        if target_params is not None:
+            tdist = module.apply(target_params, obs)
+            base_values = module.value_of(tdist)
+            base_last = module.value_of(
+                module.apply(target_params, last_obs))
+        else:
+            base_values = values
+            base_last = module.value_of(module.apply(params, last_obs))
         vs, pg_adv = vtrace(behavior_logp,
                             jax.lax.stop_gradient(target_logp),
-                            jax.lax.stop_gradient(values),
-                            jax.lax.stop_gradient(last_value),
+                            jax.lax.stop_gradient(base_values),
+                            jax.lax.stop_gradient(base_last),
                             rewards, dones)
         adv = jax.lax.stop_gradient(pg_adv)
         if clip:
             # APPO: PPO's clipped surrogate on the V-trace-corrected
-            # advantages (reference: rllib/algorithms/appo/ — the
-            # async PPO variant riding the IMPALA architecture)
+            # advantages
             ratio = jnp.exp(target_logp - behavior_logp)
             surr = jnp.minimum(
                 ratio * adv,
@@ -91,16 +108,23 @@ def _make_update(lr: float, gamma: float, vf_coeff: float,
         else:
             pi_loss = -(adv * target_logp).mean()
         vf_loss = jnp.square(values - jax.lax.stop_gradient(vs)).mean()
-        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-        total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
-        return total, (pi_loss, vf_loss, entropy)
+        ent = entropy.mean()
+        total = pi_loss + vf_coeff * vf_loss - ent_coeff * ent
+        kl = jnp.zeros(())
+        if use_kl:
+            # adaptive KL penalty against the BEHAVIOR distribution
+            # (the params that produced the rollout) — keeps the async
+            # update from straying while V-trace's clipping saturates
+            kl = module.kl(behavior_dist, dist).mean()
+            total = total + kl_coef * kl
+        return total, (pi_loss, vf_loss, ent, kl)
 
     @jax.jit
-    def update(params, opt_state, obs, actions, behavior_logp,
-               rewards, dones, last_obs):
+    def update(params, target_params, opt_state, kl_coef, obs, actions,
+               behavior_logp, behavior_dist, rewards, dones, last_obs):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, obs, actions, behavior_logp, rewards, dones,
-            last_obs)
+            params, target_params, kl_coef, obs, actions,
+            behavior_logp, behavior_dist, rewards, dones, last_obs)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss, aux
@@ -109,78 +133,55 @@ def _make_update(lr: float, gamma: float, vf_coeff: float,
 
 
 @dataclasses.dataclass
-class IMPALAConfig:
-    env_maker: Any = None            # seed -> env (default CartPole)
-    num_env_runners: int = 2
-    num_envs_per_runner: int = 4
+class IMPALAConfig(AlgorithmConfig):
     rollout_len: int = 64
-    hidden: int = 32
     lr: float = 5e-3
-    gamma: float = 0.99
     vf_coeff: float = 0.5
     ent_coeff: float = 0.01
     max_grad_norm: float = 40.0
     rho_bar: float = 1.0             # V-trace rho clip
     c_bar: float = 1.0               # V-trace c clip
-    clip: float = 0.0                # >0: APPO's clipped surrogate
+    clip: float = 0.0                # >0: clipped surrogate (APPO)
     updates_per_iter: int = 8        # rollouts consumed per train()
     sample_timeout_s: float = 120.0
-    seed: int = 0
-
-    def build(self) -> "IMPALA":
-        return IMPALA(self)
 
 
-class IMPALA:
+class IMPALA(Algorithm):
     """Async actor-learner: the learner drains whichever runner
     finishes first, updates, and re-arms ONLY that runner with fresh
     params — the others keep sampling with params at most one pipeline
     slot stale (bounded staleness, corrected by V-trace)."""
 
-    def __init__(self, config: IMPALAConfig):
-        import jax
+    runner_cls = _EnvRunner
+    _use_kl = False
 
-        self.config = config
-        if config.env_maker is not None:
-            self._env_maker = config.env_maker
-        else:
-            from ray_tpu.rllib.env import CartPoleEnv
-
-            self._env_maker = lambda seed: CartPoleEnv(seed)
-        env = self._env_maker(0)
-        self._obs_dim = env.observation_dim
-        self._num_actions = env.num_actions
-        self.params = _policy_init(jax.random.PRNGKey(config.seed),
-                                   self._obs_dim, self._num_actions,
-                                   config.hidden)
+    def setup(self) -> None:
+        cfg = self.config
+        self.target_params = None
+        self.kl_coef = float(getattr(cfg, "kl_coef_init", 0.0))
         self._optimizer, self._update = _make_update(
-            config.lr, config.gamma, config.vf_coeff, config.ent_coeff,
-            config.max_grad_norm, config.rho_bar, config.c_bar,
-            clip=config.clip)
+            cfg.lr, cfg.gamma, cfg.vf_coeff, cfg.ent_coeff,
+            cfg.max_grad_norm, cfg.rho_bar, cfg.c_bar,
+            clip=cfg.clip, use_kl=self._use_kl, module=self.module)
         self.opt_state = self._optimizer.init(self.params)
-        self.iteration = 0
-        from ray_tpu.rllib.runner_group import RunnerGroup
 
-        cfg = config
-        self._group = RunnerGroup(
-            _EnvRunner,
-            lambda seed: (self._env_maker, cfg.num_envs_per_runner,
-                          cfg.rollout_len, seed),
-            cfg.num_env_runners, cfg.seed)
+    def after_runners(self) -> None:
         self._params_ref = ray_tpu.put(self.params)
         # prime the pipeline: one outstanding rollout per runner
         self._inflight: Dict[Any, int] = {}
-        for i in range(cfg.num_env_runners):
+        for i in range(self.config.num_env_runners):
             self._arm(i)
 
     # -- async plumbing -------------------------------------------------
     def _arm(self, i: int) -> None:
         """One outstanding sample on runner i with the CURRENT params."""
         try:
-            ref = self._group.runners[i].sample.remote(self._params_ref)
+            ref = self._group.runners[i].sample.remote(
+                self._params_ref, self._connector_state)
         except rex.ActorError:
             self._group.respawn(i)
-            ref = self._group.runners[i].sample.remote(self._params_ref)
+            ref = self._group.runners[i].sample.remote(
+                self._params_ref, self._connector_state)
         self._inflight[ref] = i
 
     def _next_batch(self):
@@ -204,6 +205,16 @@ class IMPALA:
                 self._group.respawn(i)
                 self._arm(i)
 
+    def _after_update(self, aux) -> None:
+        """Per-update hook (APPO: target network sync)."""
+
+    def _update_kl(self, mean_kl: float) -> None:
+        """Per-ITERATION hook (APPO: adaptive KL coefficient).
+        Adapting per update whiplashed the coefficient — 8 compounding
+        x1.5 steps per iteration drove it to the clamp and collapsed
+        the policy; the reference adapts once per training iteration
+        on the mean sampled KL."""
+
     # -- training -------------------------------------------------------
     def train(self) -> Dict[str, Any]:
         """One iteration: consume updates_per_iter rollouts as they
@@ -212,29 +223,39 @@ class IMPALA:
 
         cfg = self.config
         losses: List[float] = []
+        kls: List[float] = []
         ep_returns: List[float] = []
         env_steps = 0
         t0 = time.perf_counter()
         for _ in range(cfg.updates_per_iter):
             batch, i = self._next_batch()
-            self.params, self.opt_state, loss, _aux = self._update(
-                self.params, self.opt_state,
+            self._merge_connector_deltas([batch])
+            bdist = tuple(jnp.asarray(d) for d in batch["dist_inputs"])
+            self.params, self.opt_state, loss, aux = self._update(
+                self.params, self.target_params, self.opt_state,
+                jnp.asarray(self.kl_coef),
                 jnp.asarray(batch["obs"]),
                 jnp.asarray(batch["actions"]),
                 jnp.asarray(batch["logp"]),
+                bdist,
                 jnp.asarray(batch["rewards"]),
                 jnp.asarray(batch["dones"]),
                 jnp.asarray(batch["last_obs"]))
             losses.append(float(loss))
+            kls.append(float(aux[3]))
+            self._after_update(aux)
             ep_returns.extend(batch["episode_returns"])
-            env_steps += batch["actions"].size
+            env_steps += batch["actions"].shape[0] \
+                * batch["actions"].shape[1]
             # freshest params go to the runner just drained; the rest
             # keep streaming with their (bounded-stale) copy
             self._params_ref = ray_tpu.put(self.params)
             self._arm(i)
         dt = time.perf_counter() - t0
+        if self._use_kl and kls:
+            self._update_kl(float(np.mean(kls)))
         self.iteration += 1
-        return {
+        out = {
             "training_iteration": self.iteration,
             "episode_return_mean": (float(np.mean(ep_returns))
                                     if ep_returns else float("nan")),
@@ -243,23 +264,60 @@ class IMPALA:
             "env_steps_per_sec": env_steps / max(dt, 1e-9),
             "loss": float(np.mean(losses)) if losses else float("nan"),
         }
+        if self._use_kl:
+            out["kl"] = float(np.mean(kls)) if kls else float("nan")
+            out["kl_coef"] = self.kl_coef
+        return out
 
     def stop(self) -> None:
         self._inflight.clear()
-        self._group.stop()
+        super().stop()
 
 
 @dataclasses.dataclass
 class APPOConfig(IMPALAConfig):
     """Async PPO (reference: rllib/algorithms/appo/): the IMPALA
-    architecture — async runners, V-trace correction — with PPO's
-    clipped surrogate objective on the corrected advantages."""
+    architecture — async runners, V-trace correction — plus PPO's
+    clipped surrogate, an adaptive KL penalty toward kl_target, and a
+    target value network for the V-trace baseline."""
 
     clip: float = 0.2
-
-    def build(self) -> "APPO":
-        return APPO(self)
+    kl_target: float = 0.05
+    kl_coef_init: float = 0.2
+    target_update_freq: int = 4      # updates between target-net syncs
 
 
 class APPO(IMPALA):
-    pass
+    _use_kl = True
+    needs_dist_inputs = True
+
+    def setup(self) -> None:
+        self._updates_done = 0
+        super().setup()
+        import jax
+
+        # target value network starts as a copy of the online params
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params)
+
+    def _update_kl(self, mean_kl: float) -> None:
+        # rllib's update_kl schedule, once per iteration on the mean
+        # sampled KL: raise above 2x target, lower below 0.5x target
+        cfg = self.config
+        if mean_kl > 2.0 * cfg.kl_target:
+            self.kl_coef = min(self.kl_coef * 1.5, 10.0)
+        elif mean_kl < 0.5 * cfg.kl_target:
+            self.kl_coef = max(self.kl_coef * 0.5, 1e-4)
+
+    def _after_update(self, aux) -> None:
+        cfg = self.config
+        self._updates_done += 1
+        if self._updates_done % max(1, cfg.target_update_freq) == 0:
+            import jax
+
+            self.target_params = jax.tree_util.tree_map(
+                lambda x: x, self.params)
+
+
+IMPALAConfig.algo_class = IMPALA
+APPOConfig.algo_class = APPO

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.core import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.ppo import (_gae, _logsumexp, _make_update,
                                _policy_apply, _policy_init)
 
@@ -180,44 +181,42 @@ class _MultiAgentRunner:
 
 
 @dataclasses.dataclass
-class MultiAgentPPOConfig:
+class MultiAgentPPOConfig(AlgorithmConfig):
     """reference: AlgorithmConfig.multi_agent(policies=...,
-    policy_mapping_fn=...). policies maps policy id -> (obs_dim,
-    num_actions); policy_mapping_fn maps agent id -> policy id
-    (default: one shared policy for every agent)."""
+    policy_mapping_fn=...), on the shared AlgorithmConfig root.
+    policies maps policy id -> (obs_dim, num_actions);
+    policy_mapping_fn maps agent id -> policy id (default: one shared
+    policy for every agent)."""
 
-    env_maker: Any = None            # seed -> MultiAgentEnv
     policies: Optional[Dict[str, tuple]] = None
     policy_mapping_fn: Optional[Callable[[str], str]] = None
-    num_env_runners: int = 2
-    num_envs_per_runner: int = 4
-    rollout_len: int = 128
-    hidden: int = 32
-    lr: float = 3e-3
-    gamma: float = 0.99
     gae_lambda: float = 0.95
     clip: float = 0.2
     vf_coeff: float = 0.5
     ent_coeff: float = 0.01
-    max_grad_norm: float = 0.5
     num_epochs: int = 4
     minibatches: int = 4
-    seed: int = 0
-
-    def build(self) -> "MultiAgentPPO":
-        return MultiAgentPPO(self)
 
 
-class MultiAgentPPO:
-    def __init__(self, config: MultiAgentPPOConfig):
+class MultiAgentPPO(Algorithm):
+    runner_cls = _MultiAgentRunner
+
+    def _make_module(self, probe_env):
+        return None  # per-POLICY param dicts below, not one module
+
+    def _runner_args(self, seed: int) -> tuple:
+        cfg = self.config
+        return (self._env_maker, cfg.num_envs_per_runner,
+                cfg.rollout_len, self._policy_of, seed)
+
+    def _default_env_maker(self):
+        return lambda seed: IndependentCartPoles(seed)
+
+    def setup(self) -> None:
         import jax
 
-        self.config = config
-        if config.env_maker is not None:
-            self._env_maker = config.env_maker
-        else:
-            self._env_maker = lambda seed: IndependentCartPoles(seed)
-        probe = self._env_maker(0)
+        config = self.config
+        probe = self._probe  # the base's probe env, not a second one
         mapping = config.policy_mapping_fn or (lambda aid: "shared")
         self._policy_of = {a: mapping(a) for a in probe.agent_ids}
         if config.policies is not None:
@@ -249,15 +248,6 @@ class MultiAgentPPO:
                                     config.max_grad_norm)
             self.opt_state[k] = opt.init(self.params[k])
             self._update[k] = upd
-        self.iteration = 0
-        from ray_tpu.rllib.runner_group import RunnerGroup
-
-        cfg = config
-        self._group = RunnerGroup(
-            _MultiAgentRunner,
-            lambda seed: (self._env_maker, cfg.num_envs_per_runner,
-                          cfg.rollout_len, self._policy_of, seed),
-            cfg.num_env_runners, cfg.seed)
 
     def train(self) -> Dict[str, Any]:
         """One iteration: collect, then per-policy PPO epochs over the
@@ -318,5 +308,5 @@ class MultiAgentPPO:
         })
         return metrics
 
-    def stop(self) -> None:
-        self._group.stop()
+
+MultiAgentPPOConfig.algo_class = MultiAgentPPO

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.rllib.core import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.ppo import _policy_apply, _policy_init
 
 
@@ -44,47 +45,31 @@ def collect_episodes(env_maker, policy_fn, num_episodes: int,
 
 
 @dataclasses.dataclass
-class BCConfig:
+class BCConfig(AlgorithmConfig):
     """Behavior cloning from a transition dataset (reference:
-    rllib/algorithms/bc/)."""
+    rllib/algorithms/bc/). On the shared AlgorithmConfig root — no env
+    runners (the data IS the experience), so num_env_runners=0."""
 
     dataset: Any = None              # ray_tpu.data Dataset of rows
-    env_maker: Any = None            # for evaluate(); default CartPole
-    hidden: int = 32
     lr: float = 1e-2
     batch_size: int = 256
-    seed: int = 0
-
-    def build(self) -> "BC":
-        return BC(self)
+    num_env_runners: int = 0
 
 
-class BC:
+class BC(Algorithm):
     """Supervised imitation: maximize log pi(action | obs) over the
-    dataset. One jitted update; the policy network is the SAME MLP the
-    online algorithms train, so a cloned policy drops into their
-    evaluation path."""
+    dataset. One jitted update; the policy module is the SAME
+    DiscreteMLP the online algorithms train, so a cloned policy drops
+    into their evaluation path."""
 
-    def __init__(self, config: BCConfig):
+    def setup(self) -> None:
         import jax
         import jax.numpy as jnp
         import optax
 
+        config = self.config
         if config.dataset is None:
             raise ValueError("BCConfig.dataset is required")
-        self.config = config
-        if config.env_maker is not None:
-            self._env_maker = config.env_maker
-        else:
-            from ray_tpu.rllib.env import CartPoleEnv
-
-            self._env_maker = lambda seed: CartPoleEnv(seed)
-        env = self._env_maker(0)
-        self._obs_dim = env.observation_dim
-        self._num_actions = env.num_actions
-        self.params = _policy_init(jax.random.PRNGKey(config.seed),
-                                   self._obs_dim, self._num_actions,
-                                   config.hidden)
         optimizer = optax.adam(config.lr)
         self.opt_state = optimizer.init(self.params)
 
@@ -107,7 +92,6 @@ class BC:
         self._update = update
         # jit ONCE: evaluate() in a loop must hit the compile cache
         self._apply = jax.jit(_policy_apply)
-        self.iteration = 0
         # materialize ONCE into arrays; epochs reshuffle indices
         rows = config.dataset.take_all()
         self._obs = np.asarray([r["obs"] for r in rows], np.float32)
@@ -154,3 +138,5 @@ class BC:
             returns.append(total)
         return {"episode_return_mean": float(np.mean(returns)),
                 "num_episodes": num_episodes}
+
+BCConfig.algo_class = BC

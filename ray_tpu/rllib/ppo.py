@@ -17,35 +17,23 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu import exceptions as rex
+from ray_tpu.rllib.core import (Algorithm, AlgorithmConfig, DiscreteMLP,
+                                _mlp_apply, _mlp_init)
 
 # ----------------------------------------------------------------------
-# policy network (flax MLP: logits + value head)
+# policy network (MLP: logits + value head) — kept as module-level
+# functions for the discrete-only consumers (multi_agent, offline);
+# the Algorithm frame goes through RLModule instead (core.py)
 # ----------------------------------------------------------------------
 
 
 def _policy_apply(params, obs):
-    import jax.numpy as jnp
-
-    x = obs
-    for i, (w, b) in enumerate(params["layers"]):
-        x = x @ w + b
-        if i < len(params["layers"]) - 1:
-            x = jnp.tanh(x)
-    logits = x[..., :-1]
-    value = x[..., -1]
-    return logits, value
+    x = _mlp_apply(params, obs)
+    return x[..., :-1], x[..., -1]
 
 
 def _policy_init(rng, obs_dim: int, num_actions: int, hidden: int):
-    import jax
-
-    sizes = [obs_dim, hidden, hidden, num_actions + 1]
-    keys = jax.random.split(rng, len(sizes) - 1)
-    layers = []
-    for k, (m, n) in zip(keys, zip(sizes[:-1], sizes[1:])):
-        w = jax.random.normal(k, (m, n)) * (1.0 / np.sqrt(m))
-        layers.append((w, np.zeros(n, np.float32)))
-    return {"layers": layers}
+    return _mlp_init(rng, [obs_dim, hidden, hidden, num_actions + 1])
 
 
 # ----------------------------------------------------------------------
@@ -55,7 +43,8 @@ def _policy_init(rng, obs_dim: int, num_actions: int, hidden: int):
 @ray_tpu.remote
 class _EnvRunner:
     def __init__(self, env_maker, num_envs: int, rollout_len: int,
-                 seed: int, connectors=None):
+                 seed: int, connectors=None, module=None,
+                 action_connectors=None, need_dist_inputs=False):
         import jax
 
         self.envs = [env_maker(seed * 1000 + i) for i in range(num_envs)]
@@ -64,13 +53,23 @@ class _EnvRunner:
         # observations transform before the module forward AND before
         # buffering, so the learner sees exactly what the policy saw
         self.connectors = connectors
+        # module-to-env pipeline: RAW actions (+ logp) are buffered for
+        # the learner; TRANSFORMED actions go to env.step
+        self.action_connectors = action_connectors
+        # the RLModule (core.py): apply -> dist inputs, np_sample.
+        # None = legacy discrete-MLP path (module-level _policy_apply)
+        self.module = module if module is not None \
+            else DiscreteMLP(0, 0, 0)
+        # behavior dist inputs are a full obs-buffer-sized extra array
+        # per rollout; only KL-penalized learners (APPO) read them
+        self.need_dist_inputs = need_dist_inputs
         self.rollout_len = rollout_len
         self.episode_returns: List[float] = []
         self.running = np.zeros(len(self.envs))
         self.rng = np.random.default_rng(seed)
         # jit ONCE per runner: a per-sample jax.jit would discard the
         # trace/compile cache every rollout
-        self._apply = jax.jit(_policy_apply)
+        self._apply = jax.jit(self.module.apply)
 
     def sample(self, params, connector_state=None) -> Dict[str, Any]:
         """One rollout with the given policy params: batch arrays +
@@ -87,10 +86,14 @@ class _EnvRunner:
                 prior = pipeline.init_state()
             delta = pipeline.init_state()
         T, N = self.rollout_len, len(self.envs)
-        # obs_buf allocates from the FIRST transformed batch: a
-        # connector may change the observation shape
+        module = self.module
+        act_pipe = self.action_connectors
+        # obs/action buffers allocate from the FIRST batch: a connector
+        # may change the observation shape, and the module decides the
+        # action dtype/shape (int32 [N] categorical, f32 [N, D] gaussian)
         obs_buf = None
-        act_buf = np.zeros((T, N), np.int32)
+        act_buf = None
+        dist_buf = None
         logp_buf = np.zeros((T, N), np.float32)
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
@@ -104,19 +107,30 @@ class _EnvRunner:
                     self.obs, prior, delta)
             if obs_buf is None:
                 obs_buf = np.zeros((T,) + np.shape(step_obs), np.float32)
-            logits, value = apply(params, jnp.asarray(step_obs))
-            logits = np.asarray(logits)
-            value = np.asarray(value)
-            # sample from the categorical
-            u = self.rng.gumbel(size=logits.shape)
-            actions = np.argmax(logits + u, axis=-1)
-            logp_all = logits - _logsumexp(logits)
+            dist = apply(params, jnp.asarray(step_obs))
+            value = np.asarray(module.value_of(dist))
+            actions, logp = module.np_sample(dist, self.rng)
+            if act_buf is None:
+                act_buf = np.zeros((T,) + actions.shape, actions.dtype)
+                # behavior distribution inputs (minus the value head):
+                # off-policy learners (APPO's KL term) need the full
+                # behavior dist, not just the taken action's logp
+                dist_buf = ([np.zeros((T,) + np.shape(d), np.float32)
+                             for d in dist[:-1]]
+                            if self.need_dist_inputs else [])
+            env_actions = actions if act_pipe is None \
+                else act_pipe.to_env(actions)
+            discrete = act_buf.dtype.kind in "iu"
             obs_buf[t] = step_obs
             act_buf[t] = actions
-            logp_buf[t] = logp_all[np.arange(N), actions]
+            if dist_buf:
+                for j, d in enumerate(dist[:-1]):
+                    dist_buf[j][t] = np.asarray(d)
+            logp_buf[t] = logp
             val_buf[t] = value
             for i, env in enumerate(self.envs):
-                nobs, r, done = env.step(int(actions[i]))
+                a = env_actions[i]
+                nobs, r, done = env.step(int(a) if discrete else a)
                 rew_buf[t, i] = r
                 self.running[i] += r
                 if done:
@@ -130,10 +144,11 @@ class _EnvRunner:
         if pipeline is not None:
             last_obs = pipeline.transform(
                 self.obs, pipeline.effective(prior, delta))
-        _, last_val = apply(params, jnp.asarray(last_obs))
+        last_val = module.value_of(apply(params, jnp.asarray(last_obs)))
         out = {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "dist_inputs": dist_buf,
             "last_values": np.asarray(last_val),
             # the observation AFTER the rollout: off-policy learners
             # (IMPALA) bootstrap it under the TARGET params
@@ -171,28 +186,28 @@ def _gae(batch, gamma: float, lam: float):
 
 
 def _make_update(lr: float, clip: float, vf_coeff: float,
-                 ent_coeff: float, max_grad_norm: float):
+                 ent_coeff: float, max_grad_norm: float,
+                 module=None):
     import jax
     import jax.numpy as jnp
     import optax
 
+    module = module if module is not None else DiscreteMLP(0, 0, 0)
     optimizer = optax.chain(optax.clip_by_global_norm(max_grad_norm),
                             optax.adam(lr))
 
     def loss_fn(params, obs, actions, old_logp, adv, returns):
-        logits, value = _policy_apply(params, obs)
-        logp_all = jax.nn.log_softmax(logits)
-        logp = jnp.take_along_axis(logp_all, actions[:, None],
-                                   axis=-1)[:, 0]
+        dist = module.apply(params, obs)
+        value = module.value_of(dist)
+        logp, entropy = module.logp_entropy(dist, actions)
         ratio = jnp.exp(logp - old_logp)
         surr = jnp.minimum(
             ratio * adv,
             jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
         pi_loss = -surr.mean()
         vf_loss = jnp.square(value - returns).mean()
-        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-        total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
-        return total, (pi_loss, vf_loss, entropy)
+        total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy.mean()
+        return total, (pi_loss, vf_loss, entropy.mean())
 
     @jax.jit
     def update(params, opt_state, obs, actions, old_logp, adv, returns):
@@ -210,73 +225,29 @@ def _make_update(lr: float, clip: float, vf_coeff: float,
 # ----------------------------------------------------------------------
 
 @dataclasses.dataclass
-class PPOConfig:
-    env_maker: Any = None            # seed -> env (default CartPole)
-    num_env_runners: int = 2
-    num_envs_per_runner: int = 4
-    rollout_len: int = 128
-    hidden: int = 32
-    lr: float = 3e-3
-    gamma: float = 0.99
+class PPOConfig(AlgorithmConfig):
+    """reference: rllib/algorithms/ppo/PPOConfig, on the shared
+    AlgorithmConfig root (core.py). A continuous-action env (exposing
+    ``action_dim`` instead of ``num_actions``) gets a gaussian policy
+    head automatically."""
+
     gae_lambda: float = 0.95
     clip: float = 0.2
     vf_coeff: float = 0.5
     ent_coeff: float = 0.01
-    max_grad_norm: float = 0.5
     num_epochs: int = 4
     minibatches: int = 4
-    # env-to-module connector pipeline (reference: ConnectorV2):
-    # list of rllib.connectors.Connector applied to observations in
-    # every runner; stateful connectors merge exactly after each
-    # collect round
-    obs_connectors: Any = None
-    seed: int = 0
-
-    def build(self) -> "PPO":
-        return PPO(self)
 
 
-class PPO:
-    def __init__(self, config: PPOConfig):
-        import jax
+class PPO(Algorithm):
+    runner_cls = None  # set below (class defined above this point)
 
-        self.config = config
-        if config.env_maker is not None:
-            self._env_maker = config.env_maker
-        else:
-            from ray_tpu.rllib.env import CartPoleEnv
-
-            self._env_maker = lambda seed: CartPoleEnv(seed)
-        env = self._env_maker(0)
-        self._obs_dim = env.observation_dim
-        self._num_actions = env.num_actions
-        self.params = _policy_init(jax.random.PRNGKey(config.seed),
-                                   self._obs_dim, self._num_actions,
-                                   config.hidden)
+    def setup(self) -> None:
+        cfg = self.config
         self._optimizer, self._update = _make_update(
-            config.lr, config.clip, config.vf_coeff, config.ent_coeff,
-            config.max_grad_norm)
+            cfg.lr, cfg.clip, cfg.vf_coeff, cfg.ent_coeff,
+            cfg.max_grad_norm, module=self.module)
         self.opt_state = self._optimizer.init(self.params)
-        self.iteration = 0
-        from ray_tpu.rllib.runner_group import RunnerGroup
-        cfg2 = self.config
-        self._pipeline = None
-        self._connector_state = None
-        if cfg2.obs_connectors:
-            from ray_tpu.rllib.connectors import ConnectorPipeline
-
-            self._pipeline = ConnectorPipeline(list(cfg2.obs_connectors))
-            self._connector_state = self._pipeline.init_state()
-        pipeline = self._pipeline
-        self._group = RunnerGroup(
-            _EnvRunner,
-            lambda seed: (self._env_maker, cfg2.num_envs_per_runner,
-                          cfg2.rollout_len, seed, pipeline),
-            cfg2.num_env_runners, cfg2.seed)
-
-    @property
-    def _runners(self):
-        return self._group.runners
 
     def _collect(self) -> List[Dict[str, Any]]:
         """Fan the current params out, gather rollouts; dead runners
@@ -287,14 +258,7 @@ class PPO:
         cstate = self._connector_state
         batches = self._group.collect(
             lambda r: r.sample.remote(params_ref, cstate))
-        if self._pipeline is not None:
-            deltas = [b["connector_state"] for b in batches
-                      if "connector_state" in b]
-            if deltas:
-                # prior + disjoint per-runner deltas: exact parallel-
-                # Welford combine, identical to one single stream
-                self._connector_state = self._pipeline.merge(
-                    [self._connector_state] + deltas)
+        self._merge_connector_deltas(batches)
         return batches
 
     def train(self) -> Dict[str, Any]:
@@ -307,8 +271,9 @@ class PPO:
             [], []
         for b in batches:
             a, r = _gae(b, cfg.gamma, cfg.gae_lambda)
-            obs.append(b["obs"].reshape(-1, self._obs_dim))
-            actions.append(b["actions"].reshape(-1))
+            obs.append(b["obs"].reshape(-1, b["obs"].shape[-1]))
+            actions.append(b["actions"].reshape(
+                (-1,) + b["actions"].shape[2:]))
             logp.append(b["logp"].reshape(-1))
             adv.append(a.reshape(-1))
             returns.append(r.reshape(-1))
@@ -343,5 +308,6 @@ class PPO:
             "loss": float(np.mean(losses)),
         }
 
-    def stop(self) -> None:
-        self._group.stop()
+
+PPO.runner_cls = _EnvRunner
+PPOConfig.algo_class = PPO

@@ -14,10 +14,13 @@ from ray_tpu.tune.tuner import (ASHAScheduler,  # noqa: F401
                                 TrialResult, TuneConfig, Tuner, choice,
                                 get_checkpoint, grid_search, loguniform,
                                 report, uniform)
+from ray_tpu.tune.search import (BasicVariantSearcher,  # noqa: F401
+                                 Searcher, TPESearcher)
 
 __all__ = [
     "Tuner", "TuneConfig", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
     "ResultGrid", "TrialResult", "grid_search", "choice", "uniform",
     "loguniform", "report", "get_checkpoint",
+    "Searcher", "BasicVariantSearcher", "TPESearcher",
 ]

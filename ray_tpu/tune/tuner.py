@@ -373,6 +373,12 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Optional[ASHAScheduler] = None
+    # model-based searcher (reference: tune.search_alg — hyperopt/
+    # optuna integrations; here the native TPESearcher in search.py).
+    # With a searcher, configs are suggested SEQUENTIALLY — each new
+    # trial conditions on every completed result — so num_samples is
+    # the trial budget and grid_search axes are rejected.
+    search_alg: Optional[Any] = None
     seed: int = 0
 
 
@@ -501,13 +507,30 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         cfg = self._cfg
-        configs = self._make_configs()
         sched = cfg.scheduler
         metric = cfg.metric or (sched.metric if sched else None)
         mode = cfg.mode
         is_pbt = isinstance(sched, PopulationBasedTraining)
+        searcher = cfg.search_alg
+        if searcher is not None:
+            if metric is None:
+                raise ValueError("search_alg needs TuneConfig.metric")
+            searcher.set_search_properties(self._space, metric, mode,
+                                           cfg.seed)
+            # configs materialize lazily at launch time: each suggest()
+            # conditions on every completed trial so far
+            configs: List[Optional[Dict[str, Any]]] = \
+                [None] * cfg.num_samples
+        else:
+            configs = self._make_configs()
 
         completed = self._storage_setup(configs)
+        if searcher is not None:
+            # resumed experiments replay finished trials into the model
+            for tid, res in sorted(completed.items()):
+                searcher.register(tid, res.config) \
+                    if hasattr(searcher, "register") else None
+                searcher.on_trial_complete(tid, res.metrics)
         queue = [(tid, conf) for tid, conf in enumerate(configs)
                  if tid not in completed]
         running: Dict[int, Dict[str, Any]] = {}  # trial_id -> state
@@ -551,6 +574,9 @@ class Tuner:
         while queue or running:
             while queue and len(running) < cfg.max_concurrent_trials:
                 tid, conf = queue.pop(0)
+                if conf is None:  # searcher path: suggest at launch
+                    conf = searcher.suggest(tid)
+                    configs[tid] = conf
                 launch(tid, conf)
 
             refs = [st["ref"] for st in running.values()]
@@ -583,6 +609,9 @@ class Tuner:
                                 list(st["history"]), True)
                             results[tid] = result
                             self._storage_save(result)
+                            if searcher is not None:
+                                searcher.on_trial_complete(
+                                    tid, result.metrics)
                             if is_pbt:
                                 sched.forget(tid)
                             running.pop(tid)
@@ -609,6 +638,8 @@ class Tuner:
                         False)
                     results[tid] = result
                     self._storage_save(result)
+                    if searcher is not None:
+                        searcher.on_trial_complete(tid, result.metrics)
                     if is_pbt:
                         sched.forget(tid)
                     try:
