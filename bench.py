@@ -398,6 +398,19 @@ def main() -> int:
             OUT["data_shuffle_mb_per_sec"] = None
         _emit()
 
+    # --- Data library: columnar hash-join MB/s -------------------------
+    if section("data_join", 10):
+        try:
+            r = perf.data_join_throughput(total_mb=8 if smoke else 64)
+            OUT["data_join_mb_per_sec"] = r["mb_per_sec"]
+            print(f"  data join: {r['mb_per_sec']:.0f} MB/s "
+                  f"({r['total_mb']:.0f} MB in {r['seconds']:.1f}s)",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+            OUT["data_join_mb_per_sec"] = None
+        _emit()
+
     # --- RLlib: IMPALA async rollout throughput ------------------------
     if section("rl_rollout", 45):
         try:

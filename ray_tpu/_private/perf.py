@@ -274,6 +274,55 @@ def data_shuffle_throughput(total_mb: int = 128, num_blocks: int = 16,
                              worker_mode="thread", best_of=3)
 
 
+def data_join_throughput(total_mb: int = 64, num_blocks: int = 8,
+                         num_workers: int = 0) -> Dict[str, Any]:
+    """Columnar hash-join MB/s: key-partitioned exchange + Arrow hash
+    join per reducer (data/_streaming.py join_exchange). Payload is
+    the JOINED output's nbytes; thread mode + best-of-3 like the
+    shuffle bench."""
+    import os
+    import time as _time
+
+    import numpy as np
+    import pyarrow as pa
+
+    import ray_tpu
+    from ray_tpu import data
+    from ray_tpu.data import block as blk
+
+    ray_tpu.shutdown()
+    nw = num_workers or max(2, min(8, os.cpu_count() or 2))
+    ray_tpu.init(num_workers=nw, scheduler="tensor",
+                 _system_config={"worker_mode": "thread"})
+    try:
+        n_rows = total_mb * 1024 * 1024 // 16  # two int64 cols
+        keys = np.arange(n_rows, dtype=np.int64)
+        left_t = pa.table({"k": keys, "v": keys * 2})
+        right_t = pa.table({"k": keys, "w": keys * 3})
+        dt = None
+        out_bytes = rows = 0
+        for _ in range(3):
+            left = data.from_arrow(left_t, parallelism=num_blocks)
+            right = data.from_arrow(right_t, parallelism=num_blocks)
+            t0 = _time.perf_counter()
+            out_bytes = 0
+            rows = 0
+            for b in left.join(right, on="k")._execute():
+                out_bytes += blk.block_nbytes(b)
+                rows += blk.block_rows(b)
+            trial = _time.perf_counter() - t0
+            assert rows == n_rows, (rows, n_rows)
+            dt = trial if dt is None else min(dt, trial)
+    finally:
+        ray_tpu.shutdown()
+    return {
+        "total_mb": round(out_bytes / 1e6, 1),
+        "seconds": dt,
+        "mb_per_sec": round(out_bytes / 1e6 / dt, 1),
+        "num_blocks": num_blocks,
+    }
+
+
 def _flops_per_step(compiled, params, batch: int, seq: int) -> float:
     """XLA's own FLOP count for the compiled step; analytic fallback."""
     try:

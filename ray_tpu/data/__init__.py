@@ -17,7 +17,8 @@ never look inside a block except in driver-side aggregations).
     ds.take(5)   # [0, 2, 4, 6, 8]
 """
 
-from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,  # noqa: F401
+from ray_tpu.data.dataset import (ActorPoolStrategy,  # noqa: F401
+                                  AggregateFn, Dataset,
                                   from_items, range)  # noqa: A004
 from ray_tpu.data.datasource import (from_arrow, from_numpy,  # noqa: F401
                                      from_pandas, read_binary_files,
@@ -25,6 +26,7 @@ from ray_tpu.data.datasource import (from_arrow, from_numpy,  # noqa: F401
                                      read_parquet, read_text)
 
 __all__ = ["Dataset", "range", "from_items", "ActorPoolStrategy",
+           "AggregateFn",
            "read_text", "read_csv", "read_json", "read_binary_files",
            "read_numpy", "read_parquet", "from_pandas", "from_numpy",
            "from_arrow"]

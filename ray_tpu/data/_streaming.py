@@ -260,10 +260,22 @@ def _agg_key_hash(value) -> int:
 
 
 _AGG_COL = {"count": "count()", "sum": "sum({})", "mean": "mean({})",
-            "min": "min({})", "max": "max({})"}
+            "min": "min({})", "max": "max({})", "std": "std({})",
+            "quantile": "quantile({})"}
+# ops pyarrow's group_by computes natively; std/quantile/custom take
+# the sorted-group numpy walk instead
+_ARROW_NATIVE_AGGS = ("count", "sum", "mean", "min", "max")
 
 
-def _agg_out_name(col, op) -> str:
+def _norm_spec(spec):
+    """(col, op) | (col, op, param) -> (col, op, param)."""
+    return spec if len(spec) == 3 else (spec[0], spec[1], None)
+
+
+def _agg_out_name(spec) -> str:
+    col, op, param = _norm_spec(spec)
+    if op == "custom":
+        return param.name
     return _AGG_COL[op].format(col)
 
 
@@ -484,9 +496,13 @@ def _reduce_task(kind, arg, j, *pieces):
         out_rows = []
         for k, grp in groups.items():
             rec = {key: k}
-            for col, op in specs:
+            for spec in specs:
+                col, op, param = _norm_spec(spec)
                 if op == "count":
                     rec["count()"] = len(grp)
+                    continue
+                if op == "custom":
+                    rec[param.name] = param.of_rows(k, grp)
                     continue
                 # None values are skipped, matching Arrow's null
                 # semantics (all-null -> null result)
@@ -499,9 +515,23 @@ def _reduce_task(kind, arg, j, *pieces):
                     v = sum(vals) / len(vals)
                 elif op == "min":
                     v = min(vals)
-                else:
+                elif op == "max":
                     v = max(vals)
-                rec[_agg_out_name(col, op)] = v
+                elif op == "std":
+                    import numpy as _np
+
+                    ddof = param if param is not None else 1
+                    a = _np.asarray(vals, dtype=_np.float64)
+                    v = (float(_np.std(a, ddof=ddof))
+                         if len(a) > ddof else None)
+                elif op == "quantile":
+                    import numpy as _np
+
+                    v = float(_np.quantile(
+                        _np.asarray(vals, dtype=_np.float64), param))
+                else:
+                    raise ValueError(op)
+                rec[_agg_out_name(spec)] = v
             out_rows.append(rec)
         rows = out_rows
     return rows
@@ -510,15 +540,69 @@ def _reduce_task(kind, arg, j, *pieces):
 def _agg_arrow(table, arg):
     """Columnar named-aggregation reduce over a concatenated table."""
     key, specs = arg
+    norm = [_norm_spec(s) for s in specs]
+    if any(op not in _ARROW_NATIVE_AGGS for _c, op, _p in norm):
+        return _agg_arrow_groups(table, key, norm)
     pa_specs = [(([], "count_all") if op == "count"
-                 else (col, op)) for col, op in specs]
+                 else (col, op)) for col, op, _p in norm]
     out = table.group_by(key).aggregate(pa_specs)
     # pyarrow names results "<col>_<op>" / "count_all"; emit the
     # reference's "<op>(<col>)" / "count()" form
     rename = {(f"{col}_{op}" if op != "count" else "count_all"):
-              _agg_out_name(col, op) for col, op in specs}
+              _agg_out_name(s) for s, (col, op, _p) in zip(specs, norm)}
     return out.rename_columns(
         [rename.get(c, c) for c in out.column_names])
+
+
+def _agg_arrow_groups(table, key, norm):
+    """Sorted-group walk for aggregations pyarrow's group_by lacks
+    (std with chosen ddof, exact quantile, custom AggregateFn):
+    sort by key, find boundaries, reduce each group's column slice
+    with numpy — rows materialize only for custom AggregateFns."""
+    import numpy as np
+    import pyarrow as pa
+
+    if table.num_rows == 0:
+        return []
+    tbl = table.sort_by([(key, "ascending")])
+    kv = tbl.column(key).to_pylist()
+    n = len(kv)
+    bounds = [0] + [i for i in range(1, n) if kv[i] != kv[i - 1]] + [n]
+    out_rows = []
+    for s, e in zip(bounds[:-1], bounds[1:]):
+        grp = tbl.slice(s, e - s)
+        rec = {key: kv[s]}
+        for spec in norm:
+            col, op, param = spec
+            if op == "count":
+                rec["count()"] = e - s
+                continue
+            if op == "custom":
+                rec[param.name] = param.of_rows(kv[s], grp.to_pylist())
+                continue
+            vals = grp.column(col).drop_null().to_numpy(
+                zero_copy_only=False).astype(np.float64)
+            if len(vals) == 0:
+                v = None
+            elif op == "sum":
+                v = float(vals.sum())
+            elif op == "mean":
+                v = float(vals.mean())
+            elif op == "min":
+                v = float(vals.min())
+            elif op == "max":
+                v = float(vals.max())
+            elif op == "std":
+                ddof = param if param is not None else 1
+                v = (float(np.std(vals, ddof=ddof))
+                     if len(vals) > ddof else None)
+            elif op == "quantile":
+                v = float(np.quantile(vals, param))
+            else:
+                raise ValueError(op)
+            rec[_agg_out_name(spec)] = v
+        out_rows.append(rec)
+    return out_rows
 
 
 def _group_apply_arrow(table, arg) -> List[Any]:
@@ -544,6 +628,210 @@ def _group_apply_arrow(table, arg) -> List[Any]:
     out = []
     for s, e in zip(bounds[:-1], bounds[1:]):
         out.append(fn(kv[s], rest.slice(s, e - s).to_pylist()))
+    return out
+
+
+@ray_tpu.remote
+def _count_rows_task(block) -> int:
+    from ray_tpu.data import block as _blk
+
+    return _blk.block_rows(block)
+
+
+@ray_tpu.remote
+def _zip_task(left_block, lo: int, hi: int, rstarts, *rblocks):
+    """Merge columns of the right-side row range [lo, hi) into the
+    left block. rstarts[i] is rblocks[i]'s global start offset."""
+    from ray_tpu.data import block as _blk
+
+    pieces = []
+    for start, rb in zip(rstarts, rblocks):
+        n = _blk.block_rows(rb)
+        s = max(lo, start) - start
+        e = min(hi, start + n) - start
+        if s >= e:
+            continue
+        pieces.append(rb.slice(s, e - s) if _blk._is_arrow(rb)
+                      else rb[s:e])
+    if _blk._is_arrow(left_block) and pieces \
+            and all(_blk._is_arrow(p) for p in pieces):
+        import pyarrow as pa
+
+        right = _blk.compact_table(pa.concat_tables(pieces))
+        out = left_block
+        for name, col in zip(right.column_names, right.columns):
+            # duplicate names get the reference's "_1" suffix
+            final = name if name not in out.column_names \
+                else f"{name}_1"
+            out = out.append_column(final, col)
+        return out
+    lrows = _blk.block_to_rows(left_block)
+    rrows: List[Any] = []
+    for p in pieces:
+        rrows.extend(_blk.block_to_rows(p) if _blk._is_arrow(p) else p)
+    out_rows = []
+    for lr, rr in zip(lrows, rrows):
+        if isinstance(lr, dict) and isinstance(rr, dict):
+            merged = dict(lr)
+            for k, v in rr.items():
+                merged[k if k not in lr else f"{k}_1"] = v
+            out_rows.append(merged)
+        else:
+            out_rows.append((lr, rr))
+    return out_rows
+
+
+def zip_exchange(left_refs: List[Any], right_refs: List[Any]) -> List[Any]:
+    """Positional zip: realign right blocks to the left's row
+    boundaries in tasks (columnar end-to-end for Arrow blocks)."""
+    if not left_refs or not right_refs:
+        if not left_refs and not right_refs:
+            return []
+        raise ValueError("zip: one side is empty, the other is not")
+    count_refs = [_count_rows_task.remote(r)
+                  for r in list(left_refs) + list(right_refs)]
+    counts = ray_tpu.get(count_refs)
+    lcounts = counts[:len(left_refs)]
+    rcounts = counts[len(left_refs):]
+    if sum(lcounts) != sum(rcounts):
+        raise ValueError(
+            f"zip needs equal row counts, got {sum(lcounts)} vs "
+            f"{sum(rcounts)} (reference: Dataset.zip)")
+    rstarts = []
+    acc = 0
+    for c in rcounts:
+        rstarts.append(acc)
+        acc += c
+    out = []
+    lo = 0
+    for lref, lc in zip(left_refs, lcounts):
+        hi = lo + lc
+        need_idx = [i for i, (s, c) in enumerate(zip(rstarts, rcounts))
+                    if s < hi and s + c > lo]
+        out.append(_zip_task.remote(
+            lref, lo, hi, [rstarts[i] for i in need_idx],
+            *[right_refs[i] for i in need_idx]))
+        lo = hi
+    ray_tpu.wait(out, num_returns=len(out), timeout=None)
+    return out
+
+
+_JOIN_HOW = {"inner": "inner", "left": "left outer",
+             "right": "right outer", "full": "full outer"}
+# observability for tests: reduces that took Arrow's hash join
+_JOIN_COLUMNAR_REDUCES = 0
+
+
+@ray_tpu.remote
+def _columns_task(block) -> List[str]:
+    """Column names of one block (schema hint for outer joins whose
+    reducers may see zero rows of one side)."""
+    from ray_tpu.data import block as _blk
+
+    if _blk._is_arrow(block):
+        return list(block.column_names)
+    rows = _blk.block_to_rows(block)
+    return list(rows[0].keys()) if rows \
+        and isinstance(rows[0], dict) else []
+
+
+@ray_tpu.remote
+def _join_reduce_task(on: str, how: str, n_left: int, lcols, rcols,
+                      *pieces):
+    """One reducer's hash-join: pieces[:n_left] are the left side's
+    key-partition j, the rest the right side's. Arrow's hash join does
+    the columnar work; the row fallback builds a dict index."""
+    from ray_tpu.data import block as _blk
+
+    left_pieces = pieces[:n_left]
+    right_pieces = pieces[n_left:]
+
+    def _concat(parts):
+        import pyarrow as pa
+
+        if parts and all(_blk._is_arrow(p) for p in parts):
+            live = [p for p in parts if p.num_rows] or [parts[0]]
+            return pa.concat_tables(live).combine_chunks()
+        return None
+
+    lt = _concat(left_pieces)
+    rt = _concat(right_pieces)
+    if lt is not None and rt is not None:
+        global _JOIN_COLUMNAR_REDUCES
+        _JOIN_COLUMNAR_REDUCES += 1
+        # duplicate non-key right columns get an "_r" suffix
+        return lt.join(rt, keys=on, join_type=_JOIN_HOW[how],
+                       right_suffix="_r")
+    # row fallback
+    def _rows(parts):
+        rows: List[Any] = []
+        for p in parts:
+            rows.extend(_blk.block_to_rows(p)
+                        if _blk._is_arrow(p) else p)
+        return rows
+
+    lrows, rrows = _rows(left_pieces), _rows(right_pieces)
+    rindex: dict = {}
+    for r in rrows:
+        rindex.setdefault(r[on], []).append(r)
+    out = []
+    matched_right = set()
+
+    def _merge(lr, rr):
+        merged = dict(lr)
+        for k, v in rr.items():
+            if k == on:
+                continue
+            merged[k if k not in lr else f"{k}_r"] = v
+        return merged
+
+    rcols = [c for c in rcols if c != on]
+    for lr in lrows:
+        hits = rindex.get(lr[on])
+        if hits:
+            for idx, rr in enumerate(hits):
+                matched_right.add((lr[on], idx))
+                out.append(_merge(lr, rr))
+        elif how in ("left", "full"):
+            out.append(_merge(lr, {c: None for c in rcols}))
+    if how in ("right", "full"):
+        for key, hits in rindex.items():
+            for idx, rr in enumerate(hits):
+                if (key, idx) not in matched_right:
+                    row = {c: None for c in lcols}
+                    row[on] = key
+                    out.append(_merge(row, rr))
+    return out
+
+
+def join_exchange(left_refs, right_refs, on: str, how: str,
+                  num_out: int) -> List[Any]:
+    """Hash join over the streamed keyed exchange: BOTH sides
+    partition by the key column with the exact groupby_agg routing
+    (arrow-vectorized dest computation, identical hash both sides),
+    then each reducer joins its partitions."""
+    def _parts(refs):
+        parts = []
+        for i, r in enumerate(refs):
+            p = _partition_task.options(num_returns=num_out).remote(
+                "groupby_agg", (on, []), num_out, r, i)
+            parts.append([p] if num_out == 1 else p)
+        return parts
+
+    lparts = _parts(left_refs)
+    rparts = _parts(right_refs)
+    # schema hints: an outer-join reducer may receive zero rows of one
+    # side yet must emit its columns as nulls
+    lcols, rcols = ray_tpu.get(
+        [_columns_task.remote(left_refs[0]) if left_refs
+         else ray_tpu.put([]),
+         _columns_task.remote(right_refs[0]) if right_refs
+         else ray_tpu.put([])])
+    out = [_join_reduce_task.remote(
+        on, how, len(lparts), lcols, rcols,
+        *[p[j] for p in lparts], *[p[j] for p in rparts])
+        for j in range(num_out)]
+    ray_tpu.wait(out, num_returns=len(out), timeout=None)
     return out
 
 

@@ -323,6 +323,38 @@ class Dataset:
             out.append(Dataset(_refs_source(refs[lo:hi], f"split_{i}")))
         return out
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Positional column-merge of two same-length datasets
+        (reference: Dataset.zip — right-side duplicate column names
+        get a "_1" suffix). A materializing barrier like union: both
+        sides execute to refs; right blocks re-chunk to the left's row
+        boundaries in tasks, so the merge itself stays columnar and
+        off-driver."""
+        from ray_tpu.data._streaming import zip_exchange
+
+        left = self.materialize().block_refs
+        right = other.materialize().block_refs
+        return Dataset(_refs_source(zip_exchange(left, right), "zip"))
+
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             num_blocks: int = 0) -> "Dataset":
+        """Key-based hash join (reference: the all-to-all join over
+        Ray Data's hash shuffle). Both sides hash-partition by the key
+        COLUMN through the same streamed exchange the shuffle tier
+        uses; each reducer joins its partitions columnar via Arrow's
+        hash join (duplicate right columns get an "_r" suffix).
+        ``how``: inner | left | right | full."""
+        from ray_tpu.data._streaming import join_exchange
+
+        if how not in ("inner", "left", "right", "full"):
+            raise ValueError(
+                f"how must be inner|left|right|full, got {how!r}")
+        left = self.materialize().block_refs
+        right = other.materialize().block_refs
+        out = join_exchange(left, right, on, how,
+                            num_blocks or len(left) or 1)
+        return Dataset(_refs_source(out, f"join({on},{how})"))
+
     def union(self, *others: "Dataset") -> "Dataset":
         """Concatenation of this dataset and `others` (reference:
         Dataset.union). A materializing barrier here: every input
@@ -436,8 +468,68 @@ class GroupedDataset:
     def max(self, col: str) -> Dataset:
         return self._named_agg([(col, "max")])
 
-    def aggregate(self, agg: Callable[[List[Any]], Any]) -> Dataset:
-        return self.map_groups(lambda k, rows, _a=agg: (k, _a(rows)))
+    def std(self, col: str, ddof: int = 1) -> Dataset:
+        """Sample standard deviation per group (reference: Std
+        aggregation, default ddof=1)."""
+        return self._named_agg([(col, "std", ddof)])
+
+    def quantile(self, col: str, q: float = 0.5) -> Dataset:
+        """Exact per-group quantile (reference: Quantile aggregation;
+        exact because each group's rows land on ONE reducer)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        return self._named_agg([(col, "quantile", q)])
+
+    def aggregate(self, *aggs) -> Dataset:
+        """Custom aggregations (reference: GroupedData.aggregate):
+        each arg is an AggregateFn (init/accumulate_row/merge/finalize)
+        OR — legacy form — one plain callable rows->value."""
+        if len(aggs) == 1 and callable(aggs[0]) \
+                and not isinstance(aggs[0], AggregateFn):
+            agg = aggs[0]
+            return self.map_groups(lambda k, rows, _a=agg: (k, _a(rows)))
+        for a in aggs:
+            if not isinstance(a, AggregateFn):
+                raise TypeError(
+                    f"aggregate() takes AggregateFn args, got {a!r}")
+        if not isinstance(self._key, str):
+            fns = list(aggs)
+
+            def apply(k, rows, _fns=fns):
+                rec = {"key": k}
+                for f in _fns:
+                    rec[f.name] = f.of_rows(k, rows)
+                return rec
+
+            return self.map_groups(apply)
+        return self._named_agg([(None, "custom", a) for a in aggs])
+
+
+class AggregateFn:
+    """Custom streaming aggregation (reference: ray.data.AggregateFn):
+    ``init(key) -> acc``, ``accumulate_row(acc, row) -> acc``,
+    ``finalize(acc) -> value``. The hash exchange lands ALL rows of a
+    group on one reducer, which folds them in a single accumulate
+    pass — ``merge`` (accepted for reference-API compatibility) is
+    therefore never invoked by the current execution tier; it becomes
+    load-bearing only if reducers ever fold partial accumulators."""
+
+    def __init__(self, init: Callable[[Any], Any],
+                 accumulate_row: Callable[[Any, Any], Any],
+                 merge: Optional[Callable[[Any, Any], Any]] = None,
+                 finalize: Optional[Callable[[Any], Any]] = None,
+                 name: str = "custom_agg"):
+        self.init = init
+        self.accumulate_row = accumulate_row
+        self.merge = merge
+        self.finalize = finalize or (lambda acc: acc)
+        self.name = name
+
+    def of_rows(self, key: Any, rows: List[Any]) -> Any:
+        acc = self.init(key)
+        for row in rows:
+            acc = self.accumulate_row(acc, row)
+        return self.finalize(acc)
 
 
 class MaterializedDataset:

@@ -239,6 +239,14 @@ class DQN(Algorithm):
         import jax
 
         config = self.config
+        if config.obs_connectors or config.action_connectors:
+            # the DQN runner's epsilon-greedy path doesn't thread the
+            # connector pipelines; silently ignoring the config would
+            # train on raw observations while claiming otherwise
+            raise NotImplementedError(
+                "DQN does not support obs/action connectors yet; "
+                "normalize observations in env_maker, or use PPO/"
+                "IMPALA/APPO")
         self.target_params = jax.tree_util.tree_map(
             lambda x: x, self.params)
         self._optimizer, self._update = _make_update(

@@ -216,6 +216,12 @@ class MultiAgentPPO(Algorithm):
         import jax
 
         config = self.config
+        if config.obs_connectors or config.action_connectors:
+            # the multi-agent runner doesn't thread the connector
+            # pipelines; reject loudly rather than silently no-op
+            raise NotImplementedError(
+                "MultiAgentPPO does not support obs/action connectors "
+                "yet; transform observations in the env")
         probe = self._probe  # the base's probe env, not a second one
         mapping = config.policy_mapping_fn or (lambda aid: "shared")
         self._policy_of = {a: mapping(a) for a in probe.agent_ids}
