@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import random
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
@@ -39,19 +40,25 @@ class AutoscalingConfig:
 class Deployment:
     def __init__(self, cls, name: str, num_replicas: int,
                  max_ongoing_requests: int,
-                 autoscaling_config: Optional[AutoscalingConfig] = None):
+                 autoscaling_config: Optional[AutoscalingConfig] = None,
+                 version: Optional[str] = None):
         self._cls = cls
         self.name = name
         self.num_replicas = num_replicas
         self.max_ongoing_requests = max_ongoing_requests
         self.autoscaling_config = autoscaling_config
+        # user-declared code version (reference: DeploymentVersion):
+        # a redeploy with the SAME version only rescales; a different
+        # (or absent) version triggers a rolling replica replacement
+        self.version = version
 
     _UNSET = object()
 
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
-                autoscaling_config: Any = _UNSET) -> "Deployment":
+                autoscaling_config: Any = _UNSET,
+                version: Optional[str] = None) -> "Deployment":
         """autoscaling_config=None explicitly DISABLES autoscaling;
         leaving it unset inherits."""
         return Deployment(
@@ -61,7 +68,8 @@ class Deployment:
             max_ongoing_requests if max_ongoing_requests is not None
             else self.max_ongoing_requests,
             self.autoscaling_config if autoscaling_config is
-            Deployment._UNSET else autoscaling_config)
+            Deployment._UNSET else autoscaling_config,
+            version if version is not None else self.version)
 
     def bind(self, *args, **kwargs) -> "Application":
         """Build the composition graph node (reference: deployment DAG);
@@ -82,11 +90,13 @@ class Application:
 
 def deployment(cls=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 100,
-               autoscaling_config: Optional[AutoscalingConfig] = None):
+               autoscaling_config: Optional[AutoscalingConfig] = None,
+               version: Optional[str] = None):
     """@serve.deployment decorator."""
     def wrap(c):
         return Deployment(c, name or c.__name__, num_replicas,
-                          max_ongoing_requests, autoscaling_config)
+                          max_ongoing_requests, autoscaling_config,
+                          version)
 
     return wrap(cls) if cls is not None else wrap
 
@@ -210,6 +220,15 @@ class _Replica:
         cls = cloudpickle.loads(cls_blob)
         self.instance = cls(*init_args, **init_kwargs)
 
+    def ping(self) -> str:
+        """Health gate for rolling updates (reference: replica
+        check_health): runs the deployment's own check_health() when
+        it defines one — an exception marks the replica unhealthy."""
+        check = getattr(self.instance, "check_health", None)
+        if callable(check):
+            check()
+        return "ok"
+
     def handle_request(self, method: str, args, kwargs,
                        model_id: Optional[str] = None):
         target = (self.instance if method == "__call__"
@@ -239,11 +258,15 @@ class _Replica:
 
 
 class _ReplicaState:
-    __slots__ = ("actor", "ongoing")
+    __slots__ = ("actor", "ongoing", "version", "gen")
 
-    def __init__(self, actor):
+    def __init__(self, actor, version=None, gen=0):
         self.actor = actor
         self.ongoing = 0
+        self.version = version   # user-declared deployment version
+        self.gen = gen           # internal code generation (bumps on
+        #                          every rolling code replacement, so
+        #                          UNVERSIONED redeploys roll too)
 
 
 class _DeploymentState:
@@ -256,6 +279,7 @@ class _DeploymentState:
 
         self._controller = controller
         self.dep = dep
+        self._gen = 0
         self._cls_blob = cloudpickle.dumps(dep._cls)
         self._init_args = init_args
         self._init_kwargs = init_kwargs
@@ -269,20 +293,41 @@ class _DeploymentState:
         self._model_replicas: "_collections.OrderedDict" = \
             _collections.OrderedDict()
         self._stop = threading.Event()
+        self._roll_lock = threading.Lock()
+        self._autoscale_thread: Optional[threading.Thread] = None
         auto = dep.autoscaling_config
         self._scale_to(auto.min_replicas if auto else dep.num_replicas)
-        if auto is not None:
-            threading.Thread(target=self._autoscale_loop, daemon=True,
-                             name=f"ray_tpu_serve_scale_{dep.name}"
-                             ).start()
+        self._ensure_autoscaler()
+
+    def _ensure_autoscaler(self) -> None:
+        """Start the autoscale thread when the CURRENT config wants
+        one and none is running — redeploys can add autoscaling, and
+        the loop exits on its own when a redeploy removes it."""
+        if self.dep.autoscaling_config is None:
+            return
+        t = self._autoscale_thread
+        if t is not None and t.is_alive():
+            return
+        self._autoscale_thread = threading.Thread(
+            target=self._autoscale_loop, daemon=True,
+            name=f"ray_tpu_serve_scale_{self.dep.name}")
+        self._autoscale_thread.start()
 
     def _autoscale_loop(self) -> None:
         """Queue-driven scaling (reference: serve autoscaling reads
-        ongoing-request metrics per replica)."""
+        ongoing-request metrics per replica). The config re-reads every
+        tick: a rolling redeploy may change or remove it."""
         import math
 
-        cfg = self.dep.autoscaling_config
-        while not self._stop.wait(cfg.interval_s):
+        while True:
+            cfg = self.dep.autoscaling_config
+            if cfg is None:
+                return  # autoscaling removed by a redeploy
+            if self._stop.wait(cfg.interval_s):
+                return
+            cfg = self.dep.autoscaling_config
+            if cfg is None:
+                return
             with self._lock:
                 ongoing = sum(r.ongoing for r in self._replicas)
                 n = len(self._replicas)
@@ -291,23 +336,170 @@ class _DeploymentState:
                 min(cfg.max_replicas,
                     math.ceil(ongoing / cfg.target_ongoing_requests)))
             if desired != n:
-                self._scale_to(desired)
+                try:
+                    self._scale_to(desired)
+                except rex.RayTpuError:
+                    pass  # growth failed its health gate: hold at n
 
     def _spawn(self) -> _ReplicaState:
         actor = _Replica.options(max_concurrency=8).remote(
             self._cls_blob, self._init_args, self._init_kwargs)
-        return _ReplicaState(actor)
+        return _ReplicaState(actor, self.dep.version, self._gen)
 
-    def _scale_to(self, n: int, force: bool = False) -> None:
+    def rolling_update(self, dep: Deployment, init_args, init_kwargs,
+                       health_timeout_s: float = 30.0,
+                       drain_timeout_s: float = 30.0) -> None:
+        """Versioned rolling redeploy (reference: DeploymentState's
+        version-diffed rollout): one at a time, a NEW-version replica
+        spawns, passes its health gate, joins the router, and only
+        then one old replica leaves — retired replicas first DRAIN
+        their in-flight requests AND their open sticky streams. Old
+        replicas keep serving throughout; a failing health gate aborts
+        the roll and RESTORES the previous code/version, so crash
+        respawns and retries never see the broken blob. Same declared
+        version -> scale-only."""
+        import cloudpickle
+
+        with self._roll_lock:  # serialize concurrent rolls by name
+            prev = (self.dep, self._cls_blob, self._init_args,
+                    self._init_kwargs, self._gen)
+            same_version = (dep.version is not None
+                            and self.dep.version == dep.version)
+            with self._lock:
+                self.dep = dep
+                self._init_args = init_args
+                self._init_kwargs = init_kwargs
+                if not same_version:
+                    self._cls_blob = cloudpickle.dumps(dep._cls)
+                    self._gen += 1
+            target = (dep.autoscaling_config.min_replicas
+                      if dep.autoscaling_config else dep.num_replicas)
+            try:
+                if same_version:
+                    self._scale_to(target, force=False,
+                                   health_timeout_s=health_timeout_s)
+                else:
+                    self._roll(target, health_timeout_s,
+                               drain_timeout_s)
+            except Exception:
+                # abort: the OLD code must stay authoritative — a
+                # crash respawn from the broken blob (or a same-version
+                # retry short-circuit) would silently serve it
+                with self._lock:
+                    (self.dep, self._cls_blob, self._init_args,
+                     self._init_kwargs, self._gen) = prev
+                raise
+            finally:
+                self._ensure_autoscaler()
+
+    def _roll(self, target: int, health_timeout_s: float,
+              drain_timeout_s: float) -> None:
+        while True:
+            with self._lock:
+                old_n = sum(1 for r in self._replicas
+                            if r.gen != self._gen)
+                n_total = len(self._replicas)
+            if not old_n and n_total == target:
+                return
+            if not old_n and n_total > target:
+                self._scale_to(target, force=False)  # trim extras
+                return
+            fresh = self._spawn()
+            # HEALTH GATE before the router can see it
+            self._health_gate([fresh], health_timeout_s)
+            with self._lock:
+                self._replicas.append(fresh)
+                # re-derive the victim under THIS lock hold: the
+                # snapshot above is stale across the health gate (a
+                # crash respawn or the autoscaler may have removed it)
+                victim = next((r for r in self._replicas
+                               if r.gen != self._gen), None)
+                if victim is not None:
+                    self._replicas.remove(victim)
+                    self._prune_affinity_locked()
+                    # the victim deliberately STAYS in self._sticky:
+                    # open streaming sessions keep routing to it while
+                    # it drains; only new sessions see the new set
+            self._drain_and_kill(victim, drain_timeout_s)
+
+    def _health_gate(self, fresh: List[_ReplicaState],
+                     timeout_s: float) -> None:
+        """check_health gate shared by EVERY spawn path (initial
+        deploy, autoscaler growth, crash respawn, rolling update)."""
+        try:
+            ray_tpu.get([f.actor.ping.remote() for f in fresh],
+                        timeout=timeout_s)
+        except Exception as e:
+            for f in fresh:
+                try:
+                    ray_tpu.kill(f.actor)
+                except Exception:
+                    pass
+            raise rex.RayTpuError(
+                f"replica health check failed for "
+                f"{self.dep.name!r}: {e}") from e
+
+    def _drain_and_kill(self, state: Optional[_ReplicaState],
+                        timeout_s: float) -> None:
+        """Retired replica: wait for its in-flight requests AND open
+        sticky streams to finish (it no longer receives new sessions —
+        it left the router under the lock), then kill."""
+        if state is None:
+            return
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                pinned = any(r is state
+                             for r in self._sticky.values())
+            if state.ongoing == 0 and not pinned:
+                break
+            time.sleep(0.02)
+        with self._lock:
+            # a stream that outlived the drain timeout loses its
+            # replica (documented limit of the timeout)
+            self._sticky = {sid: r for sid, r in self._sticky.items()
+                            if r is not state}
+        try:
+            ray_tpu.kill(state.actor)
+        except Exception:
+            pass
+
+    def _scale_to(self, n: int, force: bool = False,
+                  health_timeout_s: float = 30.0) -> None:
         """force=False (autoscaler): never grow after shutdown, and only
         retire IDLE replicas — killing one mid-request would fail its
         callers' pending refs. force=True (shutdown/redeploy) tears down
-        unconditionally."""
+        unconditionally. Growth happens OUTSIDE the router lock and
+        behind the health gate: actor boot must not stall request
+        routing, and an unhealthy replica must never join the set."""
+        while not force:
+            with self._lock:
+                if self._stop.is_set():
+                    return  # shutdown won the race; do not respawn
+                need = n - len(self._replicas)
+            if need <= 0:
+                break
+            fresh = [self._spawn() for _ in range(need)]
+            self._health_gate(fresh, health_timeout_s)
+            extras: List[_ReplicaState] = []
+            with self._lock:
+                if self._stop.is_set():
+                    extras = fresh
+                else:
+                    room = max(0, n - len(self._replicas))
+                    self._replicas.extend(fresh[:room])
+                    extras = fresh[room:]
+            for f in extras:
+                try:
+                    ray_tpu.kill(f.actor)
+                except Exception:
+                    pass
+            if extras:
+                break
         with self._lock:
-            if self._stop.is_set() and not force:
-                return  # shutdown won the race; do not respawn
-            while len(self._replicas) < n:
-                self._replicas.append(self._spawn())
+            if force:
+                while len(self._replicas) < n:
+                    self._replicas.append(self._spawn())
             victims = []
             if force:
                 while len(self._replicas) > n:
@@ -472,8 +664,22 @@ class _DeploymentState:
                 self._replicas.remove(dead)
             except ValueError:
                 return  # already replaced
-            self._replicas.append(self._spawn())
             self._prune_affinity_locked()
+        fresh = self._spawn()
+        try:
+            self._health_gate([fresh], 30.0)
+        except rex.RayTpuError:
+            return  # current blob won't boot healthy: don't publish
+        with self._lock:
+            if self._stop.is_set():
+                pass  # shutdown raced the respawn
+            else:
+                self._replicas.append(fresh)
+                return
+        try:
+            ray_tpu.kill(fresh.actor)
+        except Exception:
+            pass
 
     def _prune_affinity_locked(self) -> None:
         """Drop dead replicas from the model-affinity lists (they are
@@ -557,6 +763,7 @@ class _MethodCaller:
 class _Controller:
     def __init__(self):
         self.deployments: Dict[str, _DeploymentState] = {}
+        self._deploy_lock = threading.RLock()
         self.ingress_name: Optional[str] = None
         self.http_server = None
         self.grpc_server = None
@@ -573,13 +780,17 @@ class _Controller:
         kwargs = {k: (self._deploy_node(v) if isinstance(v, Application)
                       else v) for k, v in app.kwargs.items()}
         name = app.deployment.name
-        existing = self.deployments.get(name)
-        if existing is not None:
-            # redeploy: replace replicas (rolling update semantics at
-            # minimum scale — new set up, old torn down)
-            existing.shutdown()
-        self.deployments[name] = _DeploymentState(self, app.deployment,
-                                                  args, kwargs)
+        with self._deploy_lock:
+            existing = self.deployments.get(name)
+            if existing is None:
+                self.deployments[name] = _DeploymentState(
+                    self, app.deployment, args, kwargs)
+                return DeploymentHandle(name)
+        # versioned rolling redeploy runs OUTSIDE the controller lock
+        # (health gates + drains can take minutes and must not block
+        # unrelated deployments); the per-deployment _roll_lock
+        # serializes concurrent rolls of the same name
+        existing.rolling_update(app.deployment, args, kwargs)
         return DeploymentHandle(name)
 
     def shutdown(self) -> None:
@@ -605,7 +816,10 @@ def run(app: Application) -> DeploymentHandle:
     with _lock:
         if _controller is None:
             _controller = _Controller()
-        return _controller.deploy_app(app)
+        controller = _controller
+    # deploy outside the module lock: a long rolling update must not
+    # block status()/shutdown()/other apps
+    return controller.deploy_app(app)
 
 
 def get_app_handle(name: Optional[str] = None) -> DeploymentHandle:
@@ -627,7 +841,10 @@ def status() -> Dict[str, Dict[str, Any]]:
     for name, st in _controller.deployments.items():
         with st._lock:
             out[name] = {"replicas": len(st._replicas),
-                         "ongoing": sum(r.ongoing for r in st._replicas)}
+                         "ongoing": sum(r.ongoing for r in st._replicas),
+                         "version": st.dep.version,
+                         "replica_versions": [r.version
+                                              for r in st._replicas]}
     return out
 
 
