@@ -25,7 +25,8 @@ from ray_tpu.rllib.impala import (APPO, APPOConfig,  # noqa: F401
                                   IMPALA, IMPALAConfig)
 from ray_tpu.rllib.multi_agent import (IndependentCartPoles,  # noqa: F401
                                        MultiAgentEnv, MultiAgentPPO,
-                                       MultiAgentPPOConfig)
+                                       MultiAgentPPOConfig,
+                                       TwoStepGame)
 from ray_tpu.rllib.offline import (BC, BCConfig,  # noqa: F401
                                    collect_episodes)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
@@ -36,6 +37,7 @@ __all__ = ["Algorithm", "AlgorithmConfig", "RLModule", "DiscreteMLP",
            "IMPALA", "APPOConfig", "APPO", "BCConfig", "BC",
            "collect_episodes", "CartPoleEnv", "PendulumEnv",
            "MultiAgentEnv", "MultiAgentPPOConfig", "MultiAgentPPO",
-           "IndependentCartPoles", "Connector", "ConnectorPipeline",
+           "IndependentCartPoles", "TwoStepGame",
+           "Connector", "ConnectorPipeline",
            "Lambda", "ObsNormalizer", "ActionConnector", "ActionClip",
            "ActionRescale", "ActionLambda", "ActionPipeline"]

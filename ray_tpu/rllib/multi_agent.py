@@ -82,6 +82,57 @@ class IndependentCartPoles(MultiAgentEnv):
         return obs, rew, done
 
 
+class TwoStepGame(MultiAgentEnv):
+    """The COUPLED cooperative matrix game of the QMIX paper
+    (reference: rllib's TwoStepGame example env, examples/envs/classes/
+    two_step_game.py): two agents, shared reward, and a payoff that
+    depends on the JOINT action — unlike IndependentCartPoles, no
+    agent can learn its part in isolation.
+
+    Step 1: agent a0's action picks the branch (0 -> state 2A,
+    1 -> state 2B); a1's action is ignored. Step 2: in 2A every joint
+    action pays 7; in 2B the payoff matrix is [[0, 1], [1, 8]] — the
+    optimum 8 requires BOTH agents to coordinate on action 1, and the
+    safe branch caps at 7. Observations: one-hot state + agent id.
+    """
+
+    agent_ids = ["a0", "a1"]
+    PAYOFF_2B = ((0.0, 1.0), (1.0, 8.0))
+
+    def __init__(self, seed: int = 0):
+        self.observation_dims = {a: 4 for a in self.agent_ids}
+        self.num_actions = {a: 2 for a in self.agent_ids}
+        self._state = 0
+
+    def _obs(self):
+        out = {}
+        for i, a in enumerate(self.agent_ids):
+            v = np.zeros(4, np.float32)
+            v[self._state] = 1.0
+            v[3] = float(i)
+            out[a] = v
+        return out
+
+    def reset(self):
+        self._state = 0
+        return self._obs()
+
+    def step(self, actions):
+        if self._state == 0:
+            self._state = 1 if int(actions["a0"]) == 0 else 2
+            obs = self._obs()
+            return obs, {a: 0.0 for a in self.agent_ids}, \
+                {"a0": False, "a1": False, "__all__": False}
+        if self._state == 1:
+            r = 7.0
+        else:
+            r = self.PAYOFF_2B[int(actions["a0"])][int(actions["a1"])]
+        self._state = 0
+        obs = self._obs()
+        return obs, {a: r for a in self.agent_ids}, \
+            {"a0": True, "a1": True, "__all__": True}
+
+
 @ray_tpu.remote
 class _MultiAgentRunner:
     """Vector of multi-agent envs; one rollout batches each POLICY's
