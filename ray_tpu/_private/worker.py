@@ -1721,10 +1721,17 @@ class Worker:
         q = self._oos_q
         q.append(object_id)
         if len(q) >= 128 or (self.shm_store is not None
-                             and self.shm_store.contains(object_id)):
-            # arena-resident objects are the memory that matters —
-            # reclaim those immediately; only small in-process entries
-            # ride the deferred batch
+                             and self.shm_store.contains(object_id)) \
+                or (self._node_pools
+                    and self.gcs.object_location_get(object_id)
+                    is not None):
+            # arena-resident and REMOTE-resident objects are the
+            # memory that matters — reclaim those immediately (a
+            # remote copy pins another node's arena); only small
+            # in-process entries ride the deferred batch. The GCS
+            # location lookup is gated on node pools existing: single-
+            # node runs (the common case and the bench) must not pay a
+            # GCS lock round-trip per dying ref
             self._drain_out_of_scope()
 
     def _drain_out_of_scope(self) -> None:

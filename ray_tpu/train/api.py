@@ -28,6 +28,12 @@ class ScalingConfig:
     num_workers: int = 1
     use_tpu: bool = False
     resources_per_worker: Optional[Dict[str, float]] = None
+    # ELASTIC training (reference: train/v2 elastic worker groups):
+    # when set, a failure-restart resizes the group to what the
+    # cluster can currently hold — num_workers is the ceiling,
+    # min_workers the floor (shrunk capacity after a node death no
+    # longer wedges the restart at a size that can't schedule)
+    min_workers: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -262,7 +268,8 @@ class Trainer:
                         for name, ds in self._datasets.items()}
         while True:
             try:
-                return self._run_attempt(restore, dataset_refs)
+                return self._run_attempt(restore, dataset_refs,
+                                         self._elastic_target())
             except _GroupFailure as gf:
                 failures += 1
                 if max_failures != -1 and failures > max_failures:
@@ -271,11 +278,41 @@ class Trainer:
                         f"restarts: {gf.cause}") from gf.cause
                 restore = gf.latest_checkpoint
                 # surviving actors are torn down; a fresh group restarts
-                # from the last checkpoint (reference FailurePolicy)
+                # from the last checkpoint (reference FailurePolicy),
+                # elastically resized to current capacity
+
+    def _elastic_target(self) -> int:
+        """Worker count for the NEXT attempt. Fixed groups return
+        num_workers; elastic groups (min_workers set) clamp to what
+        the cluster's current CPU capacity can schedule."""
+        sc = self._scaling
+        if sc.min_workers is None:
+            return sc.num_workers
+        per = float((sc.resources_per_worker or {}).get("CPU", 1.0))
+        if per <= 0:
+            return sc.num_workers
+        try:
+            # FREE capacity sizes the attempt (other actors may hold
+            # CPUs); TOTAL capacity decides whether the floor is ever
+            # reachable. Transient holders below the floor get the
+            # benefit of the doubt — the readiness gate catches an
+            # attempt that still can't place.
+            avail = float(ray_tpu.available_resources().get("CPU", 0.0))
+            total = float(ray_tpu.cluster_resources().get("CPU", 0.0))
+        except Exception:
+            return sc.num_workers
+        if int(total // per) < sc.min_workers:
+            raise rex.RayTpuError(
+                f"elastic training needs {sc.min_workers} workers "
+                f"({per} CPU each) but the cluster's total capacity "
+                f"holds {int(total // per)}")
+        return max(sc.min_workers,
+                   min(sc.num_workers, int(avail // per)))
 
     def _run_attempt(self, restore: Optional[str],
-                     dataset_refs: Dict[str, list]) -> Result:
-        n = self._scaling.num_workers
+                     dataset_refs: Dict[str, list],
+                     n: Optional[int] = None) -> Result:
+        n = n if n is not None else self._scaling.num_workers
         # round-robin each dataset's block refs across ranks (reference:
         # Train+Data ingest via get_dataset_shard)
         shards_by_rank: List[Dict[str, list]] = [dict() for _ in
@@ -292,6 +329,16 @@ class Trainer:
             for rank in range(n)
         ]
         try:
+            if self._scaling.min_workers is not None:
+                # elastic readiness gate: a worker that cannot schedule
+                # (capacity view lagging a node death) must surface as
+                # a group failure — the NEXT attempt re-reads capacity
+                # — not hang the whole fit
+                try:
+                    ray_tpu.get([w.poll.remote() for w in workers],
+                                timeout=60.0)
+                except Exception as e:
+                    raise _GroupFailure(restore, e) from e
             run_refs = [w.run.remote(self._fn, self._config, restore,
                                      shards_by_rank[rank])
                         for rank, w in enumerate(workers)]

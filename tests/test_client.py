@@ -175,6 +175,7 @@ class TestClientActors:
 
 
 class TestCliNodeJoin:
+    @pytest.mark.slow
     def test_node_joins_via_cli(self, head, client):
         _proc, address = head
         env = spawn_env.child_env(repo_path=REPO)

@@ -103,6 +103,7 @@ def sq(x):
 
 
 class TestMultiNodeExecution:
+    @pytest.mark.slow
     def test_tasks_run_across_nodes(self, cluster):
         cluster.add_node(num_cpus=4, num_workers=2)
         cluster.add_node(num_cpus=4, num_workers=2)
@@ -110,6 +111,7 @@ class TestMultiNodeExecution:
         out = ray_tpu.get([sq.remote(i) for i in range(40)], timeout=60)
         assert out == [i * i for i in range(40)]
 
+    @pytest.mark.slow
     def test_remove_node_mid_run_reschedules(self, cluster):
         """The VERDICT 'done when': killing a node mid-run re-schedules its
         queued tasks onto survivors and the job completes."""
@@ -147,6 +149,7 @@ class TestMultiNodeExecution:
         assert out == list(range(20))
         assert wait_for(lambda: n1.state == "DEAD", timeout=15)
 
+    @pytest.mark.slow
     def test_actor_restarts_on_surviving_node(self, cluster):
         n1 = cluster.add_node(num_cpus=4, num_workers=1)
         n2 = cluster.add_node(num_cpus=4, num_workers=1)

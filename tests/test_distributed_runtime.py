@@ -11,6 +11,8 @@ results.
 
 import os
 import socket
+
+import pytest
 import subprocess
 import sys
 
@@ -57,6 +59,7 @@ print("RANK_OK", {rank})
 """
 
 
+@pytest.mark.slow
 def test_two_process_global_mesh(tmp_path):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))

@@ -27,6 +27,8 @@ import pytest
 import ray_tpu
 from ray_tpu._private import spawn_env
 
+
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -93,6 +95,7 @@ def _load_counter():
     return ns["Counter"]
 
 
+@pytest.mark.slow
 def test_head_restart_actor_survives(tmp_path):
     journal = str(tmp_path / "gcs.journal")
     head_log = str(tmp_path / "head.log")

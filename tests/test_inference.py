@@ -91,6 +91,7 @@ class TestFunctionalForwardParity:
         assert k_seq.shape == (cfg.n_layers, 8, cfg.n_kv_heads,
                                cfg.head_dim)
 
+    @pytest.mark.slow
     def test_paged_decode_matches_full_forward(self, tiny_model):
         cfg, model, params = tiny_model
         icfg = InferenceConfig(batch_size=2, page_size=4,
@@ -183,6 +184,7 @@ class TestContinuousBatching:
         finally:
             engine.shutdown()
 
+    @pytest.mark.slow
     def test_serve_llm_stream_polls(self, tiny_model):
         """The Serve replica's poll protocol (start_stream/next_tokens)
         delivers the full generation incrementally across >= 2 polls."""
@@ -291,6 +293,7 @@ class TestHTTPStreaming:
             serve.shutdown()
             ray_tpu.shutdown()
 
+    @pytest.mark.slow
     def test_sse_stream_sticky_across_replicas(self, tiny_model):
         """With num_replicas=2 every poll must hit the replica holding
         the stream (sticky sessions) — load-balanced polls would land

@@ -59,6 +59,7 @@ class TestMonitor:
         finally:
             ray_tpu.shutdown()
 
+    @pytest.mark.slow
     def test_victim_is_most_recent(self):
         ray_tpu.shutdown()
         ray_tpu.init(num_workers=2, scheduler="tensor",

@@ -137,6 +137,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape[0] == args[1].shape[0]
 
+    @pytest.mark.slow
     def test_dryrun(self):
         ge = self._import_entry()
         ge.dryrun_multichip(8)

@@ -51,6 +51,7 @@ class TestJobSubmission:
 
 
 class TestAutoscaler:
+    @pytest.mark.slow
     def test_scales_up_under_pressure_and_down_when_idle(self):
         ray_tpu.shutdown()
         ray_tpu.init(num_cpus=1, num_workers=2, scheduler="tensor")

