@@ -352,6 +352,9 @@ class Worker:
 
         self.memory_store = MemoryStore()
         self._oos_q: collections.deque = collections.deque()
+        # flips when a REMOTE node pool registers: only then can a
+        # dying ref have a remote copy worth a per-ref GCS lookup
+        self._has_remote_nodes = False
         self.reference_counter = ReferenceCounter(self._on_object_out_of_scope)
         self.task_manager = TaskManager(self)
 
@@ -1200,6 +1203,7 @@ class Worker:
                               arena_name=arena_name,
                               peer_address=peer_address)
         self._node_pools[row] = pool
+        self._has_remote_nodes = True
         self.scheduler.poke()
         entry = self.gcs.register_node(
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus,
@@ -1291,6 +1295,7 @@ class Worker:
                               daemon_proc=None, arena_name=arena_name,
                               peer_address=peer_address)
         self._node_pools[row] = pool
+        self._has_remote_nodes = True
         self.scheduler.poke()
         entry = self.gcs.register_node(
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus, **resources},
@@ -1324,6 +1329,7 @@ class Worker:
                               daemon_proc=None, arena_name=arena_name,
                               peer_address=peer_address)
         self._node_pools[row] = pool
+        self._has_remote_nodes = True
         adopted_actors = 0
         for num, winfo in sorted(workers.items()):
             actor_hex = winfo.get("actor")
@@ -1722,16 +1728,17 @@ class Worker:
         q.append(object_id)
         if len(q) >= 128 or (self.shm_store is not None
                              and self.shm_store.contains(object_id)) \
-                or (self._node_pools
+                or (self._has_remote_nodes
                     and self.gcs.object_location_get(object_id)
                     is not None):
             # arena-resident and REMOTE-resident objects are the
             # memory that matters — reclaim those immediately (a
             # remote copy pins another node's arena); only small
             # in-process entries ride the deferred batch. The GCS
-            # location lookup is gated on node pools existing: single-
-            # node runs (the common case and the bench) must not pay a
-            # GCS lock round-trip per dying ref
+            # location lookup is gated on a REMOTE pool existing:
+            # single-node runs — thread OR process mode, the common
+            # case and the bench — must not pay a GCS lock round trip
+            # per dying ref
             self._drain_out_of_scope()
 
     def _drain_out_of_scope(self) -> None:

@@ -639,6 +639,24 @@ def _count_rows_task(block) -> int:
 
 
 @ray_tpu.remote
+def _split_block_task(block, at: int):
+    """(block[:at], block[at:]) — the train_test_split boundary cut.
+    block_slice preserves the block FORMAT (arrow/pandas/list), so the
+    boundary block doesn't degrade to rows while its siblings stay
+    columnar."""
+    from ray_tpu.data import block as _blk
+
+    n = _blk.block_rows(block)
+    at = max(0, min(at, n))
+    left = _blk.block_slice(block, 0, at)
+    right = _blk.block_slice(block, at, n)
+    if _blk._is_arrow(left):
+        left = _blk.compact_table(left)
+        right = _blk.compact_table(right)
+    return left, right
+
+
+@ray_tpu.remote
 def _zip_task(left_block, lo: int, hi: int, rstarts, *rblocks):
     """Merge columns of the right-side row range [lo, hi) into the
     left block. rstarts[i] is rblocks[i]'s global start offset."""

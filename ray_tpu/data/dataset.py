@@ -9,8 +9,8 @@ executor (ray_tpu/data/_streaming.py).
 from __future__ import annotations
 
 import builtins
-from typing import (Any, Callable, Iterator, List, Optional, Sequence,
-                    Union)
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Tuple, Union)
 
 # ----------------------------------------------------------------------
 # logical operators
@@ -47,6 +47,11 @@ class _LogicalOp:
             ops.append(node)
             node = node.parent
         return list(reversed(ops))
+
+
+import itertools as _itertools
+
+_SAMPLE_COUNTER = _itertools.count()
 
 
 class ActorPoolStrategy:
@@ -132,6 +137,152 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return Dataset(_LogicalOp("limit", limit=n, parent=self._op))
 
+    # -- column ops (reference: Dataset.select_columns / drop_columns /
+    # rename_columns / add_column — columnar on Arrow blocks, dict-row
+    # fallback otherwise) --------------------------------------------
+
+    def _map_columns(self, name: str, arrow_fn, row_fn) -> "Dataset":
+        from ray_tpu.data import block as blk
+
+        def apply(block, _a=arrow_fn, _r=row_fn):
+            if blk._is_arrow(block):
+                return _a(block)
+            return [_r(dict(row)) for row in blk.block_to_rows(block)]
+
+        return Dataset(_LogicalOp("map_block", fn=apply, name=name,
+                                  parent=self._op))
+
+    def select_columns(self, cols: Sequence[str]) -> "Dataset":
+        cols = list(cols)
+        return self._map_columns(
+            f"select_columns({cols})",
+            lambda t: t.select(cols),
+            lambda r: {k: r[k] for k in cols})
+
+    def drop_columns(self, cols: Sequence[str]) -> "Dataset":
+        cols = list(cols)
+        return self._map_columns(
+            f"drop_columns({cols})",
+            lambda t: t.drop_columns(cols),
+            lambda r: {k: v for k, v in r.items() if k not in cols})
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        mapping = dict(mapping)
+        return self._map_columns(
+            f"rename_columns({mapping})",
+            lambda t: t.rename_columns(
+                [mapping.get(c, c) for c in t.column_names]),
+            lambda r: {mapping.get(k, k): v for k, v in r.items()})
+
+    def add_column(self, name: str,
+                   fn: Callable[[Any], Any]) -> "Dataset":
+        """fn receives the BLOCK in pyarrow form (reference: fn gets
+        the batch) and returns the new column's values."""
+        import pyarrow as pa
+
+        def arrow_fn(t, _f=fn, _n=name):
+            return t.append_column(_n, pa.array(_f(t)))
+
+        def row_fn(r):
+            raise TypeError(
+                "add_column needs columnar (Arrow) blocks; use "
+                "map() for row datasets")
+
+        return self._map_columns(f"add_column({name})", arrow_fn,
+                                 row_fn)
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column (reference: Dataset.unique)."""
+        from ray_tpu.data import block as blk
+
+        seen: set = set()
+        out: List[Any] = []
+        for b in self._execute():
+            if blk._is_arrow(b):
+                vals = b.column(column).unique().to_pylist()
+            else:
+                vals = [r[column] for r in blk.block_to_rows(b)]
+            for v in vals:
+                if v not in seen:
+                    seen.add(v)
+                    out.append(v)
+        return out
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1]: {fraction}")
+        if seed is None:
+            import random as _random
+            seed = _random.randrange(1 << 31)
+        from ray_tpu.data import block as blk
+
+        def sample(block, _frac=fraction, _seed=seed):
+            import os as _os
+
+            import numpy as np
+
+            # (pid, per-process counter) decorrelates equal-sized
+            # blocks WHEREVER they execute — the counter alone restarts
+            # at 0 in every process-pool worker, which would hand
+            # identical keep-masks to same-sized blocks on different
+            # workers. Like the reference, row selection is
+            # statistically stable but not bit-reproducible across
+            # runs (block -> stream assignment follows execution)
+            k = next(_SAMPLE_COUNTER)
+            n = blk.block_rows(block)
+            rng = np.random.default_rng((_seed, n, _os.getpid(), k))
+            keep = np.flatnonzero(rng.random(n) < _frac)
+            if blk._is_arrow(block):
+                return block.take(keep)
+            rows = blk.block_to_rows(block)
+            return [rows[i] for i in keep]
+
+        return Dataset(_LogicalOp("map_block", fn=sample,
+                                  name=f"random_sample({fraction})",
+                                  parent=self._op))
+
+    def train_test_split(self, test_size: float,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        """(train, test) split by proportion (reference:
+        Dataset.train_test_split)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError(
+                f"test_size must be in (0, 1): {test_size}")
+        ds: "Dataset" = self
+        if shuffle:
+            ds = ds.random_shuffle(seed=seed)
+        refs = ds.materialize().block_refs
+        import ray_tpu as _rt
+        from ray_tpu.data._streaming import _count_rows_task
+
+        counts = _rt.get([_count_rows_task.remote(r) for r in refs])
+        total = sum(counts)
+        n_test = int(round(total * test_size))
+        # walk blocks from the END until the test quota fills; the
+        # boundary block splits via a slicing task
+        test_refs: List[Any] = []
+        acc = 0
+        i = len(refs)
+        while acc < n_test and i > 0:
+            i -= 1
+            acc += counts[i]
+        from ray_tpu.data._streaming import _split_block_task
+
+        train_refs = list(refs[:i])
+        test_refs = list(refs[i + 1:]) if acc > n_test else list(refs[i:])
+        if acc > n_test:
+            keep_train = acc - n_test
+            a, b = _split_block_task.options(num_returns=2).remote(
+                refs[i], keep_train)
+            train_refs.append(a)
+            test_refs.insert(0, b)
+        return (Dataset(_refs_source(train_refs, "train_split")),
+                Dataset(_refs_source(test_refs, "test_split")))
+
     # -- all-to-all ops (reference: AllToAllOperator — shuffle/sort/
     # groupby run map tasks that partition + reduce tasks that gather)
     def repartition(self, num_blocks: int) -> "Dataset":
@@ -197,13 +348,85 @@ class Dataset:
 
         return sum(blk.block_rows(b) for b in self._execute())
 
-    def sum(self) -> Any:
+    def sum(self, on: Optional[str] = None) -> Any:
         from ray_tpu.data import block as blk
 
+        if on is not None:
+            return self._numeric_stats(on)["sum"]
         total = 0
         for b in self._execute():
             total = total + builtins.sum(blk.iter_block_rows(b))
         return total
+
+    def min(self, on: str) -> Any:
+        return self._column_stats(on)["min"]
+
+    def max(self, on: str) -> Any:
+        return self._column_stats(on)["max"]
+
+    def mean(self, on: str) -> Any:
+        st = self._numeric_stats(on)
+        return st["sum"] / st["count"] if st["count"] else None
+
+    def std(self, on: str, ddof: int = 1) -> Any:
+        """Whole-dataset column std (reference: Dataset.std), combined
+        from per-block partials — no row gather on the driver."""
+        import math
+
+        st = self._numeric_stats(on)
+        n = st["count"]
+        if n <= ddof:
+            return None
+        var = (st["sumsq"] - st["sum"] * st["sum"] / n) / (n - ddof)
+        return math.sqrt(max(var, 0.0))
+
+    def _numeric_stats(self, col: str) -> Dict[str, Any]:
+        st = self._column_stats(col)
+        if st["count"] and not st["numeric"]:
+            raise TypeError(
+                f"sum/mean/std need a numeric column; {col!r} is not "
+                "(min/max support any ordered type)")
+        return st
+
+    def _column_stats(self, col: str) -> Dict[str, Any]:
+        """One pass, per-block numpy partials (nulls skipped, matching
+        Arrow aggregation semantics). min/max keep the column's NATIVE
+        type and work on any ordered values (strings included);
+        sum/mean/std require a numeric column."""
+        import numpy as np
+
+        from ray_tpu.data import block as blk
+
+        count = 0
+        total = 0.0
+        sumsq = 0.0
+        mn = None
+        mx = None
+        numeric = True
+        for b in self._execute():
+            if blk._is_arrow(b):
+                vals = b.column(col).drop_null().to_numpy(
+                    zero_copy_only=False)
+            else:
+                vals = np.asarray(
+                    [r[col] for r in blk.block_to_rows(b)
+                     if r[col] is not None])
+            if len(vals) == 0:
+                continue
+            count += len(vals)
+            bmn, bmx = vals.min(), vals.max()
+            bmn = bmn.item() if hasattr(bmn, "item") else bmn
+            bmx = bmx.item() if hasattr(bmx, "item") else bmx
+            mn = bmn if mn is None else builtins.min(mn, bmn)
+            mx = bmx if mx is None else builtins.max(mx, bmx)
+            if numeric and vals.dtype.kind in "iufb":
+                f = vals.astype(np.float64)
+                total += float(f.sum())
+                sumsq += float(np.square(f).sum())
+            else:
+                numeric = False
+        return {"count": count, "sum": total, "sumsq": sumsq,
+                "min": mn, "max": mx, "numeric": numeric}
 
     def iter_batches(self, *, batch_size: Optional[int] = None,
                      batch_format: str = "default") -> Iterator[Any]:

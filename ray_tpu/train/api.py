@@ -288,6 +288,11 @@ class Trainer:
         sc = self._scaling
         if sc.min_workers is None:
             return sc.num_workers
+        if not 1 <= sc.min_workers <= sc.num_workers:
+            raise ValueError(
+                f"min_workers must satisfy 1 <= min_workers <= "
+                f"num_workers, got {sc.min_workers} vs "
+                f"{sc.num_workers}")
         per = float((sc.resources_per_worker or {}).get("CPU", 1.0))
         if per <= 0:
             return sc.num_workers

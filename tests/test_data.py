@@ -418,3 +418,62 @@ class TestJoinZipAggregations:
 
         assert by_k[0]["std(v)"] == pytest.approx(
             np.std([0, 2, 4, 6, 8], ddof=1))
+
+
+class TestColumnOpsAndStats:
+    """Reference Dataset surface breadth: select/drop/rename/
+    add_column, unique, random_sample, train_test_split, and
+    whole-dataset column stats."""
+
+    def _ds(self):
+        import numpy as np
+        import pyarrow as pa
+
+        return data.from_arrow(pa.table(
+            {"a": np.arange(50, dtype=np.int64),
+             "b": np.arange(50, dtype=np.float64) * 2.0,
+             "c": np.arange(50, dtype=np.int64) % 5}), parallelism=4)
+
+    def test_select_drop_rename_add(self, rt):
+        ds = self._ds()
+        assert ds.select_columns(["a"]).schema().names == ["a"]
+        assert ds.drop_columns(["b"]).schema().names == ["a", "c"]
+        rows = ds.rename_columns({"a": "x"}).take(1)
+        assert set(rows[0]) == {"x", "b", "c"}
+        rows = ds.add_column(
+            "d", lambda t: (t.column("a").to_numpy() + 1)).take(2)
+        assert [r["d"] for r in rows] == [1, 2]
+
+    def test_column_ops_on_row_blocks(self, rt):
+        ds = data.from_items([{"a": i, "b": -i} for i in range(10)])
+        assert ds.select_columns(["b"]).take(2) == [{"b": 0}, {"b": -1}]
+        assert ds.rename_columns({"b": "z"}).take(1) == [{"a": 0, "z": 0}]
+
+    def test_unique(self, rt):
+        assert sorted(self._ds().unique("c")) == [0, 1, 2, 3, 4]
+
+    def test_random_sample(self, rt):
+        n = len(self._ds().random_sample(0.5, seed=7).take_all())
+        assert 10 <= n <= 40  # Bernoulli around 25
+        assert self._ds().random_sample(0.0).take_all() == []
+        assert len(self._ds().random_sample(1.0).take_all()) == 50
+
+    def test_train_test_split(self, rt):
+        train, test = self._ds().train_test_split(test_size=0.2)
+        tr, te = train.take_all(), test.take_all()
+        assert len(tr) == 40 and len(te) == 10
+        # order-preserving split: test is the TAIL
+        assert [r["a"] for r in tr] == list(range(40))
+        assert [r["a"] for r in te] == list(range(40, 50))
+
+    def test_dataset_level_stats(self, rt):
+        import numpy as np
+
+        ds = self._ds()
+        b = np.arange(50, dtype=np.float64) * 2.0
+        assert ds.sum(on="b") == pytest.approx(b.sum())
+        assert ds.min(on="b") == 0.0 and ds.max(on="b") == 98.0
+        assert ds.mean(on="b") == pytest.approx(b.mean())
+        assert ds.std(on="b") == pytest.approx(np.std(b, ddof=1))
+        # legacy row-sum form still works
+        assert data.range(5).sum() == 10
