@@ -30,11 +30,13 @@ from ray_tpu.rllib.multi_agent import (IndependentCartPoles,  # noqa: F401
 from ray_tpu.rllib.offline import (BC, BCConfig,  # noqa: F401
                                    collect_episodes)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.sac import SAC, SACConfig  # noqa: F401
 
 __all__ = ["Algorithm", "AlgorithmConfig", "RLModule", "DiscreteMLP",
            "GaussianMLP", "module_for_env",
            "PPOConfig", "PPO", "DQNConfig", "DQN", "IMPALAConfig",
-           "IMPALA", "APPOConfig", "APPO", "BCConfig", "BC",
+           "IMPALA", "APPOConfig", "APPO", "SACConfig", "SAC",
+           "BCConfig", "BC",
            "collect_episodes", "CartPoleEnv", "PendulumEnv",
            "MultiAgentEnv", "MultiAgentPPOConfig", "MultiAgentPPO",
            "IndependentCartPoles", "TwoStepGame",

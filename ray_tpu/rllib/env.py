@@ -89,6 +89,10 @@ class PendulumEnv:
     action_dim = 1
     action_low = -2.0
     action_high = 2.0
+    # every done is a TIME LIMIT, never a true terminal: off-policy
+    # learners (SAC) should bootstrap through episode boundaries
+    # instead of masking the value there
+    dones_are_truncations = True
 
     def __init__(self, seed: int = 0):
         self._rng = np.random.default_rng(seed)

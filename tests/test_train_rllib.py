@@ -857,3 +857,80 @@ class TestElasticTraining:
             lambda config: None,
             scaling_config=train.ScalingConfig(num_workers=64))
         assert fixed._elastic_target() == 64  # non-elastic: unclamped
+
+
+class TestSAC:
+    @pytest.mark.slow
+    def test_sac_solves_pendulum(self, rt):
+        """Continuous off-policy control (reference: rllib/algorithms/
+        sac): tanh-gaussian actor, twin target critics, learned
+        temperature. Measured seed 0: -1622 -> best -218 (near-optimal)
+        inside 40 iterations at a 1:2 update-to-data ratio."""
+        from ray_tpu.rllib import PendulumEnv, SACConfig
+
+        algo = SACConfig(env_maker=lambda s: PendulumEnv(s),
+                         num_env_runners=2, num_envs_per_runner=4,
+                         rollout_len=64, learning_starts=1000,
+                         updates_per_iteration=256, seed=0).build()
+        try:
+            first, best = None, -1e18
+            for _ in range(40):
+                m = algo.train()
+                if m["num_episodes"]:
+                    r = m["episode_return_mean"]
+                    if first is None:
+                        first = r
+                    best = max(best, r)
+                if best > -450.0:
+                    break
+            assert best > -450.0, (first, best)
+            # the temperature actually tuned itself down
+            assert m["alpha"] < 0.8
+        finally:
+            algo.stop()
+
+    def test_sac_through_the_shared_frame(self, rt):
+        from ray_tpu import rllib as R
+
+        cfg = R.SACConfig(env_maker=lambda s: R.PendulumEnv(s),
+                          num_env_runners=1, num_envs_per_runner=2,
+                          rollout_len=16, learning_starts=8,
+                          batch_size=8, updates_per_iteration=2,
+                          seed=3)
+        assert isinstance(cfg, R.AlgorithmConfig)
+        algo = cfg.build()
+        try:
+            assert isinstance(algo, R.Algorithm)
+            out = algo.train()
+            assert out["training_iteration"] == 1
+            assert "alpha" in out
+        finally:
+            algo.stop()
+
+    def test_sac_rejects_discrete_envs(self, rt):
+        from ray_tpu.rllib import SACConfig
+
+        with pytest.raises(ValueError, match="continuous"):
+            SACConfig(num_env_runners=1).build()
+
+    def test_replay_bootstraps_through_truncations(self, rt):
+        import numpy as np
+
+        from ray_tpu.rllib.sac import _SACReplay
+
+        buf = _SACReplay(100, 1, 1)
+        batch = {
+            "obs": np.arange(4, dtype=np.float32).reshape(4, 1, 1),
+            "actions": np.zeros((4, 1, 1), np.float32),
+            "rewards": np.ones((4, 1), np.float32),
+            "dones": np.array([[0], [1], [0], [0]], np.float32),
+            "last_obs": np.array([[9.0]], np.float32),
+        }
+        buf.add_batch(batch, dones_are_truncations=True)
+        # the truncation row (s_1 -> reset obs) is DROPPED; everything
+        # stored bootstraps (done == 0)
+        assert buf.size == 3
+        assert buf.done[:3].sum() == 0.0
+        buf2 = _SACReplay(100, 1, 1)
+        buf2.add_batch(batch, dones_are_truncations=False)
+        assert buf2.size == 4 and buf2.done[:4].sum() == 1.0
