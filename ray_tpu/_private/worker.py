@@ -856,9 +856,7 @@ class Worker:
             if spec.runtime_env and "working_dir" in spec.runtime_env:
                 spec.runtime_env = self.prepare_runtime_env(
                     spec.runtime_env)
-            rids = spec.return_ids()
-            spec._returns_memo = rids  # reused by execution + ref build
-            for oid in rids:
+            for oid in spec.return_ids():  # id-keyed memo inside
                 owned.append((oid, spec.task_id))
             deps = (_top_level_deps(spec.args, spec.kwargs)
                     if (spec.args or spec.kwargs) else [])
@@ -880,7 +878,7 @@ class Worker:
             pendings.append(PendingTask(spec=spec, deps=unresolved,
                                         execute=_noop_exec))
             refs = []
-            for oid in spec._returns_memo:
+            for oid in spec.return_ids():
                 ref = ObjectRef(oid, self.worker_id, _register=False)
                 ref._weak = False  # counted in register_submit_batch
                 refs.append(ref)
@@ -1049,8 +1047,7 @@ class Worker:
                 ctx.put_counter = 0
                 record(exec_id, spec.name, "started", pending.node_index)
                 rids = (getattr(spec, "_retry_return_ids", None)
-                        or getattr(spec, "_returns_memo", None)
-                        or spec.return_ids())
+                        or spec.return_ids())  # id-keyed memo inside
                 retry_task = None
                 ready = ()
                 try:

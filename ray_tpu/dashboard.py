@@ -15,20 +15,209 @@ import threading
 import time
 from typing import Optional
 
+# Single-file vanilla-JS overview UI (reference role: the dashboard
+# React app; here dependency-free so it works offline). Live stat
+# tiles + nodes/actors/task-summary tables + a throughput line chart
+# sampled client-side from /api/summary deltas, auto-refreshing.
 _INDEX = """<!doctype html>
+<html><head><meta charset="utf-8">
 <title>ray_tpu dashboard</title>
+<style>
+.viz-root {
+  color-scheme: light;
+  --surface-1: #fcfcfb; --surface-2: #f1f0ee;
+  --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --series-1: #2a78d6; --grid: #e4e2de;
+  --good: #0ca30c; --critical: #d03b3b;
+}
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .viz-root {
+    color-scheme: dark;
+    --surface-1: #1a1a19; --surface-2: #242423;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --series-1: #3987e5; --grid: #343432;
+  }
+}
+body { margin: 0; }
+.viz-root {
+  font: 13px/1.45 system-ui, sans-serif; background: var(--surface-1);
+  color: var(--text-primary); min-height: 100vh; padding: 20px 24px;
+  box-sizing: border-box;
+}
+h1 { font-size: 16px; margin: 0 0 2px; }
+.sub { color: var(--text-secondary); margin-bottom: 16px; }
+.tiles { display: flex; flex-wrap: wrap; gap: 12px; margin-bottom: 18px; }
+.tile {
+  background: var(--surface-2); border-radius: 8px; padding: 10px 16px;
+  min-width: 108px;
+}
+.tile .v { font-size: 22px; font-weight: 600; font-variant-numeric: tabular-nums; }
+.tile .k { color: var(--text-secondary); font-size: 12px; }
+.panel { margin-bottom: 20px; }
+.panel h2 { font-size: 13px; margin: 0 0 6px; color: var(--text-secondary);
+  font-weight: 600; text-transform: uppercase; letter-spacing: .04em; }
+table { border-collapse: collapse; width: 100%; max-width: 880px; }
+th, td { text-align: left; padding: 4px 12px 4px 0; border-bottom: 1px solid var(--grid);
+  font-variant-numeric: tabular-nums; }
+th { color: var(--text-secondary); font-weight: 500; }
+.dot { display: inline-block; width: 8px; height: 8px; border-radius: 50%;
+  margin-right: 6px; vertical-align: baseline; }
+.links a { color: var(--text-secondary); margin-right: 10px; }
+#chartwrap { position: relative; max-width: 880px; }
+#tp-tip { position: absolute; pointer-events: none; display: none;
+  background: var(--surface-2); border: 1px solid var(--grid);
+  border-radius: 6px; padding: 4px 8px; font-size: 12px; }
+</style></head>
+<body><div class="viz-root">
 <h1>ray_tpu</h1>
-<p>endpoints:</p>
-<ul>
-<li><a href="/api/summary">/api/summary</a></li>
-<li><a href="/api/tasks">/api/tasks</a></li>
-<li><a href="/api/actors">/api/actors</a></li>
-<li><a href="/api/objects">/api/objects</a></li>
-<li><a href="/api/nodes">/api/nodes</a></li>
-<li><a href="/api/placement_groups">/api/placement_groups</a></li>
-<li><a href="/api/jobs">/api/jobs</a></li>
-<li><a href="/metrics">/metrics</a></li>
-</ul>
+<div class="sub" id="addr">cluster overview &middot; refreshes every 2s</div>
+<div class="tiles" id="tiles"></div>
+<div class="panel"><h2>Task throughput (finished/s)</h2>
+  <div id="chartwrap"><svg id="tp" width="880" height="120"
+    role="img" aria-label="tasks finished per second over the last two minutes"></svg>
+  <div id="tp-tip"></div></div></div>
+<div class="panel"><h2>Nodes</h2><div id="nodes"></div></div>
+<div class="panel"><h2>Task summary</h2><div id="tasks"></div></div>
+<div class="panel"><h2>Actors</h2><div id="actors"></div></div>
+<div class="panel links"><h2>Raw endpoints</h2>
+<a href="/api/summary">summary</a><a href="/api/tasks">tasks</a>
+<a href="/api/actors">actors</a><a href="/api/objects">objects</a>
+<a href="/api/nodes">nodes</a><a href="/api/placement_groups">pgs</a>
+<a href="/api/jobs">jobs</a><a href="/metrics">metrics</a></div>
+<script>
+"use strict";
+let lastFinished = null, lastT = null;
+const rates = [];         // [{t, rate}] samples for the line chart
+
+function esc(v) {
+  return String(v).replace(/&/g, "&amp;").replace(/</g, "&lt;")
+    .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+}
+
+function tile(k, v, color) {
+  return `<div class="tile"><div class="v"${color ?
+    ` style="color:var(--${color})"` : ""}>${v}</div>
+    <div class="k">${k}</div></div>`;
+}
+
+function rows(list, cols) {
+  if (!list || !list.length) {
+    return '<div class="sub">none</div>';
+  }
+  const head = cols.map(c => `<th>${c}</th>`).join("");
+  const body = list.map(r =>
+    `<tr>${cols.map(c => {
+      const v = r[c] ?? "";
+      // cluster data (actor names, resource keys) must never become
+      // markup in the operator's browser; cells marked _html carry
+      // only our own generated markup
+      return `<td>${r._html && r._html.includes(c) ? v : esc(v)}</td>`;
+    }).join("")}</tr>`
+  ).join("");
+  return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
+}
+
+function drawChart() {
+  const svg = document.getElementById("tp");
+  const W = svg.clientWidth || 880, H = 120, PAD = 28;
+  const pts = rates.slice(-60);
+  if (pts.length < 2) { svg.innerHTML = ""; return; }
+  const vmax = Math.max(1, ...pts.map(p => p.rate));
+  const x = i => PAD + (W - PAD - 8) * i / (pts.length - 1);
+  const y = v => (H - 18) - (H - 26) * v / vmax;
+  let d = "";
+  pts.forEach((p, i) => { d += (i ? "L" : "M") + x(i).toFixed(1) + " " + y(p.rate).toFixed(1); });
+  // recessive grid: three horizontal rules + axis labels in text tokens
+  const gy = [0, vmax / 2, vmax];
+  svg.innerHTML =
+    gy.map(v => `<line x1="${PAD}" x2="${W - 8}" y1="${y(v)}" y2="${y(v)}"
+      stroke="var(--grid)" stroke-width="1"/>`).join("") +
+    gy.map(v => `<text x="${PAD - 6}" y="${y(v) + 4}" text-anchor="end"
+      fill="var(--text-secondary)" font-size="10">${v.toFixed(0)}</text>`).join("") +
+    `<path d="${d}" fill="none" stroke="var(--series-1)" stroke-width="2"
+      stroke-linejoin="round" stroke-linecap="round"/>` +
+    `<line id="xh" y1="8" y2="${H - 18}" stroke="var(--grid)" stroke-width="1"
+      visibility="hidden"/>` +
+    `<circle id="hp" r="4" fill="var(--series-1)" stroke="var(--surface-1)"
+      stroke-width="2" visibility="hidden"/>`;
+  svg.onmousemove = (ev) => {
+    const r = svg.getBoundingClientRect();
+    const i = Math.max(0, Math.min(pts.length - 1,
+      Math.round((ev.clientX - r.left - PAD) / ((W - PAD - 8) / (pts.length - 1)))));
+    const p = pts[i];
+    document.getElementById("xh").setAttribute("x1", x(i));
+    document.getElementById("xh").setAttribute("x2", x(i));
+    document.getElementById("xh").setAttribute("visibility", "visible");
+    const hp = document.getElementById("hp");
+    hp.setAttribute("cx", x(i)); hp.setAttribute("cy", y(p.rate));
+    hp.setAttribute("visibility", "visible");
+    const tip = document.getElementById("tp-tip");
+    tip.style.display = "block";
+    tip.style.left = Math.min(x(i) + 10, W - 150) + "px";
+    tip.style.top = "8px";
+    tip.textContent = new Date(p.t * 1000).toLocaleTimeString() +
+      "  " + p.rate.toFixed(1) + " tasks/s";
+  };
+  svg.onmouseleave = () => {
+    document.getElementById("tp-tip").style.display = "none";
+    for (const id of ["xh", "hp"])
+      document.getElementById(id).setAttribute("visibility", "hidden");
+  };
+}
+
+async function refresh() {
+  try {
+    const [s, actors] = await Promise.all([
+      fetch("/api/summary").then(r => r.json()),
+      fetch("/api/actors").then(r => r.json()),
+    ]);
+    const nodes = s.nodes || [];
+    document.getElementById("addr").textContent =
+      "cluster overview \u00b7 refreshes every 2s";
+    const t = s.tasks || {};
+    const sched = s.scheduler || {};
+    const finished = sched.finished ?? t.FINISHED_TOTAL ?? 0;
+    if (lastFinished !== null && s.time > lastT) {
+      rates.push({t: s.time,
+                  rate: Math.max(0, (finished - lastFinished) / (s.time - lastT))});
+      if (rates.length > 120) rates.shift();
+    }
+    lastFinished = finished; lastT = s.time;
+    const aliveNodes = nodes.filter(n => (n.state || "ALIVE") === "ALIVE").length;
+    const aliveActors = s.actors_alive ?? 0;
+    document.getElementById("tiles").innerHTML =
+      tile("nodes alive", aliveNodes + "/" + nodes.length,
+           aliveNodes === nodes.length ? "good" : "critical") +
+      tile("actors alive", aliveActors) +
+      tile("deps waiting", sched.waiting_deps ?? 0) +
+      tile("ready queue", sched.ready_queue ?? 0) +
+      tile("tasks running", sched.running ??
+           Math.max(0, (sched.dispatched ?? 0) - finished)) +
+      tile("tasks finished", finished) +
+      tile("tasks/s", rates.length ? rates[rates.length - 1].rate.toFixed(1) : "–");
+    document.getElementById("nodes").innerHTML = rows(nodes.map(n => ({
+      _html: ["state"],
+      node: (n.node_id || "").slice(0, 12), state:
+        `<span class="dot" style="background:var(--${(n.state || "ALIVE") === "ALIVE" ?
+          "good" : "critical"})"></span>${esc(n.state || "ALIVE")}`,
+      kind: n.kind || "", resources: JSON.stringify(n.resources || {}),
+    })), ["node", "state", "kind", "resources"]);
+    document.getElementById("tasks").innerHTML = rows(
+      Object.entries(t).map(([state, count]) => ({state, count})),
+      ["state", "count"]);
+    document.getElementById("actors").innerHTML = rows(actors.slice(0, 50).map(a => ({
+      actor: (a.actor_id || "").slice(0, 12), name: a.name || "",
+      state: a.state || "", node: a.node_index ?? "",
+    })), ["actor", "name", "state", "node"]);
+    drawChart();
+  } catch (e) {
+    document.getElementById("addr").textContent = "refresh failed: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</div></body></html>
 """
 
 

@@ -255,3 +255,24 @@ class TestDashboard:
             f"http://127.0.0.1:{port}/", timeout=10).read()
         assert b"ray_tpu" in html
         ray_tpu.kill(a)
+
+
+class TestDashboardUI:
+    def test_index_serves_the_overview_ui(self, rt):
+        import urllib.request
+
+        from ray_tpu.dashboard import start_dashboard
+
+        port = start_dashboard(0)
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        # the single-file UI: stat tiles, nodes/actors tables, the
+        # throughput chart svg, auto-refresh wiring, dark-mode tokens
+        for marker in ('id="tiles"', 'id="nodes"', 'id="actors"',
+                       '<svg id="tp"', "setInterval(refresh",
+                       "prefers-color-scheme: dark",
+                       "/api/summary"):
+            assert marker in html, marker
+        # the JS consumes keys the API actually serves
+        assert "sched.finished" in html
+        assert "waiting_deps" in html

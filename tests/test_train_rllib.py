@@ -843,8 +843,11 @@ class TestElasticTraining:
             ray_tpu.shutdown()
 
     def test_elastic_target_respects_floor(self, rt):
-        from ray_tpu.train.api import Trainer
-
+        # the slow node-death test above tears the shared cluster down
+        # in its finally; re-init so capacity queries see a cluster
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(num_workers=8, scheduler="tensor",
+                         ignore_reinit_error=True)
         trainer = train.Trainer(
             lambda config: None,
             scaling_config=train.ScalingConfig(
