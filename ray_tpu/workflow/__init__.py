@@ -14,6 +14,18 @@ Steps execute as framework tasks; every step result is pickled to
 <storage>/<workflow_id>/<step_key>. Step keys are deterministic
 positions in the DAG (function name + path), so resume matches steps
 structurally.
+
+Beyond the core (reference parity):
+- ``.options(max_retries=, catch_exceptions=)`` per step — retries ride
+  the task layer's retry machinery; catch_exceptions makes the step
+  yield ``(result, None)`` / ``(None, exception)``.
+- CONTINUATIONS: a step may RETURN another step node, which executes
+  in its place (reference: workflow.continuation — dynamic workflows).
+- The DAG itself is journaled at run start, so
+  ``workflow.resume(workflow_id)`` needs no node object and
+  ``workflow.resume_all()`` restarts every non-succeeded workflow
+  after a crash. ``get_status``/``list_all``/``get_output`` read the
+  journal; failures are journaled as FAILED with the error.
 """
 
 from __future__ import annotations
@@ -22,7 +34,7 @@ import os
 import pickle
 import tempfile
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import ray_tpu
 
@@ -49,20 +61,52 @@ def storage_root() -> str:
 class _StepNode:
     """One node of the workflow DAG (unexecuted)."""
 
-    def __init__(self, fn: Callable, args, kwargs):
+    def __init__(self, fn: Callable, args, kwargs,
+                 max_retries: Optional[int] = None,
+                 catch_exceptions: bool = False):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
+        # None = the task layer's default; 0 explicitly DISABLES
+        # retries (a non-idempotent step must be able to opt out)
+        self.max_retries = max_retries
+        self.catch_exceptions = catch_exceptions
+
+    def options(self, *, max_retries: Optional[int] = None,
+                catch_exceptions: Optional[bool] = None) -> "_StepNode":
+        """Per-step execution options (reference: step .options())."""
+        return _StepNode(
+            self.fn, self.args, self.kwargs,
+            self.max_retries if max_retries is None else max_retries,
+            self.catch_exceptions if catch_exceptions is None
+            else catch_exceptions)
 
     # -- execution ----------------------------------------------------
     def run(self, workflow_id: str,
             storage: Optional[str] = None) -> Any:
         """Execute (or resume) the workflow rooted at this step."""
+        import cloudpickle
+
         root = storage or storage_root()
         wf_dir = os.path.join(root, workflow_id)
         os.makedirs(wf_dir, exist_ok=True)
+        # journal the DAG itself so resume()/resume_all() can re-run
+        # this workflow without the caller re-building the node —
+        # rewritten on EVERY run, so a re-run with a corrected node
+        # replaces the stale (possibly broken) one
+        _journal_write(wf_dir, "__dag__",
+                       {"node": cloudpickle.dumps(self)})
+        _journal_write(wf_dir, "__status__", {"status": "RUNNING"})
         executed: Dict[str, int] = {"fresh": 0, "cached": 0}
-        result = self._execute(wf_dir, "root", executed)
+        try:
+            result = self._execute(wf_dir, "root", executed)
+        except BaseException as e:
+            _journal_write(wf_dir, "__status__",
+                           {"status": "FAILED", "error": repr(e),
+                            "fresh_steps": executed["fresh"],
+                            "cached_steps": executed["cached"]})
+            raise
+        _journal_write(wf_dir, "__output__", {"result": result})
         _journal_write(wf_dir, "__status__",
                        {"status": "SUCCEEDED",
                         "fresh_steps": executed["fresh"],
@@ -82,10 +126,52 @@ class _StepNode:
         kwargs = {k: (v._execute(wf_dir, f"{path}.{k}", executed)
                       if isinstance(v, _StepNode) else v)
                   for k, v in self.kwargs.items()}
-        remote_fn = ray_tpu.remote(self.fn)
-        result = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+        # a journaled step BODY (the fn ran but its continuation
+        # didn't finish before a crash) must not re-run — its side
+        # effects already happened
+        body = _journal_read(wf_dir, f"{key}#body")
+        if body is not None:
+            import cloudpickle
+
+            result: Any = cloudpickle.loads(body["node"])
+        else:
+            remote_fn = ray_tpu.remote(self.fn)
+            if self.max_retries is not None:
+                remote_fn = remote_fn.options(
+                    max_retries=self.max_retries,
+                    retry_exceptions=self.max_retries > 0)
+            try:
+                result = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+            except Exception as e:  # noqa: BLE001
+                if not self.catch_exceptions:
+                    raise
+                value: Tuple[Any, Any] = (None, e)
+                _journal_write(wf_dir, key, {"result": value})
+                executed["fresh"] += 1
+                return value
+            executed["fresh"] += 1
+            if isinstance(result, _StepNode):
+                # journal the body's outcome (the continuation node)
+                # BEFORE descending: a crash inside the continuation
+                # must not re-run THIS step's side effects on resume
+                import cloudpickle
+
+                _journal_write(wf_dir, f"{key}#body",
+                               {"node": cloudpickle.dumps(result)})
+        # CONTINUATION: a step that returns a step node hands the
+        # workflow off to it (dynamic workflows). The continuation's
+        # sub-steps journal under this step's path, and the RESOLVED
+        # value is journaled as this step's result — a resume replays
+        # the final value without re-descending. Errors inside the
+        # continuation belong to ITS steps' options, not this one's.
+        hops = 0
+        while isinstance(result, _StepNode):
+            hops += 1
+            result = result._execute(wf_dir, f"{path}.cont{hops}",
+                                     executed)
+        if self.catch_exceptions:
+            result = (result, None)
         _journal_write(wf_dir, key, {"result": result})
-        executed["fresh"] += 1
         return result
 
 
@@ -106,10 +192,65 @@ def step(fn: Callable) -> _Step:
     return _Step(fn)
 
 
-def resume(workflow_id: str, node: _StepNode,
+def resume(workflow_id: str, node: Optional[_StepNode] = None,
            storage: Optional[str] = None) -> Any:
-    """Explicit resume (same as run: the journal makes it idempotent)."""
+    """Resume a workflow. With ``node=None`` the journaled DAG is
+    loaded (reference: workflow.resume(workflow_id)); passing the node
+    explicitly also works (the journal makes it idempotent)."""
+    if node is None:
+        import cloudpickle
+
+        wf_dir = os.path.join(storage or storage_root(), workflow_id)
+        rec = _journal_read(wf_dir, "__dag__")
+        if rec is None:
+            raise ValueError(
+                f"no journaled DAG for workflow {workflow_id!r}")
+        node = cloudpickle.loads(rec["node"])
     return node.run(workflow_id, storage)
+
+
+def resume_all(storage: Optional[str] = None) -> Dict[str, Any]:
+    """Re-run every workflow whose journal is not SUCCEEDED
+    (reference: workflow.resume_all after a crash). Returns
+    {workflow_id: result} for the ones that now succeed; one still-
+    broken workflow must not gate the rest — it stays FAILED in the
+    journal (query get_status) and the loop continues."""
+    out: Dict[str, Any] = {}
+    for wf_id, status in list_all(storage):
+        if status == "SUCCEEDED":
+            continue
+        try:
+            out[wf_id] = resume(wf_id, storage=storage)
+        except Exception:  # noqa: BLE001
+            continue  # journaled as FAILED (or has no DAG to replay)
+    return out
+
+
+def list_all(storage: Optional[str] = None) -> List[Tuple[str, str]]:
+    """[(workflow_id, status)] for every journaled workflow."""
+    root = storage or storage_root()
+    out: List[Tuple[str, str]] = []
+    if not os.path.isdir(root):
+        return out
+    for wf_id in sorted(os.listdir(root)):
+        wf_dir = os.path.join(root, wf_id)
+        if not os.path.isdir(wf_dir):
+            continue
+        rec = _journal_read(wf_dir, "__status__")
+        out.append((wf_id, rec["status"] if rec else "UNKNOWN"))
+    return out
+
+
+def get_output(workflow_id: str,
+               storage: Optional[str] = None) -> Any:
+    """The finished workflow's root result, from the journal."""
+    wf_dir = os.path.join(storage or storage_root(), workflow_id)
+    rec = _journal_read(wf_dir, "__output__")
+    if rec is None:
+        raise ValueError(
+            f"workflow {workflow_id!r} has no journaled output "
+            "(not run here, or not finished)")
+    return rec["result"]
 
 
 def get_status(workflow_id: str,
@@ -123,8 +264,11 @@ def list_steps(workflow_id: str,
     wf_dir = os.path.join(storage or storage_root(), workflow_id)
     if not os.path.isdir(wf_dir):
         return []
-    return sorted(f[:-len(".step")] for f in os.listdir(wf_dir)
-                  if f.endswith(".step"))
+    return sorted(
+        f[:-len(".step")] for f in os.listdir(wf_dir)
+        if f.endswith(".step")
+        and not f.startswith("__")      # internal records
+        and "#body" not in f)           # continuation bodies
 
 
 # -- journal ------------------------------------------------------------

@@ -166,3 +166,151 @@ class TestCLI:
         out = capsys.readouterr().out
         # qualnames truncate at 40 chars; match the row, not the suffix
         assert "test_status_and_summary" in out and " 3 " in out
+
+
+class TestWorkflowDepth:
+    """Round-5 workflow additions (reference: ray workflow options,
+    continuations, resume_all)."""
+
+    def test_step_retries_through_task_layer(self, rt, tmp_path):
+        from ray_tpu import workflow
+
+        attempts = str(tmp_path / "attempts")
+
+        @workflow.step
+        def flaky(path):
+            import os
+            n = int(open(path).read()) if os.path.exists(path) else 0
+            open(path, "w").write(str(n + 1))
+            if n < 2:
+                raise RuntimeError("transient")
+            return n
+
+        node = flaky.step(attempts).options(max_retries=3)
+        out = node.run(workflow_id="wf_retry",
+                       storage=str(tmp_path / "s"))
+        assert out == 2  # third attempt succeeded
+        assert int(open(attempts).read()) == 3
+
+    def test_catch_exceptions(self, rt, tmp_path):
+        from ray_tpu import workflow
+
+        @workflow.step
+        def boom():
+            raise ValueError("nope")
+
+        @workflow.step
+        def ok():
+            return 7
+
+        r, err = boom.step().options(catch_exceptions=True).run(
+            workflow_id="wf_catch", storage=str(tmp_path))
+        assert r is None and "nope" in str(err)
+        r, err = ok.step().options(catch_exceptions=True).run(
+            workflow_id="wf_catch2", storage=str(tmp_path))
+        assert (r, err) == (7, None)
+
+    def test_continuation_dynamic_workflow(self, rt, tmp_path):
+        from ray_tpu import workflow
+
+        @workflow.step
+        def base(x):
+            return x * 10
+
+        @workflow.step
+        def decide(x):
+            # a step RETURNING a step: the continuation executes in
+            # its place (reference: workflow.continuation)
+            if x < 3:
+                return base.step(x)
+            return x
+
+        assert decide.step(2).run("wf_cont1", str(tmp_path)) == 20
+        assert decide.step(5).run("wf_cont2", str(tmp_path)) == 5
+
+    def test_failed_status_and_resume_without_node(self, rt, tmp_path):
+        from ray_tpu import workflow
+
+        marker = str(tmp_path / "fixed")
+
+        @workflow.step
+        def sometimes(path):
+            import os
+            if not os.path.exists(path):
+                raise RuntimeError("not yet")
+            return "done"
+
+        node = sometimes.step(marker)
+        with pytest.raises(Exception):
+            node.run("wf_res", str(tmp_path / "s"))
+        assert workflow.get_status(
+            "wf_res", str(tmp_path / "s"))["status"] == "FAILED"
+        open(marker, "w").close()
+        # resume WITHOUT the node object: the DAG came from the journal
+        out = workflow.resume("wf_res", storage=str(tmp_path / "s"))
+        assert out == "done"
+        assert workflow.get_output(
+            "wf_res", str(tmp_path / "s")) == "done"
+
+    def test_list_all_and_resume_all(self, rt, tmp_path):
+        from ray_tpu import workflow
+
+        storage = str(tmp_path / "s")
+        gate = str(tmp_path / "gate")
+
+        @workflow.step
+        def good():
+            return 1
+
+        @workflow.step
+        def gated(path):
+            import os
+            if not os.path.exists(path):
+                raise RuntimeError("gated")
+            return 2
+
+        good.step().run("wf_a", storage)
+        with pytest.raises(Exception):
+            gated.step(gate).run("wf_b", storage)
+        assert dict(workflow.list_all(storage)) == {
+            "wf_a": "SUCCEEDED", "wf_b": "FAILED"}
+        open(gate, "w").close()
+        resumed = workflow.resume_all(storage)
+        assert resumed == {"wf_b": 2}
+        assert dict(workflow.list_all(storage))["wf_b"] == "SUCCEEDED"
+
+    def test_continuation_crash_does_not_rerun_parent_body(self, rt,
+                                                           tmp_path):
+        """The parent's side effects must not replay when a resume
+        re-enters a workflow that crashed INSIDE a continuation."""
+        from ray_tpu import workflow
+
+        counter = str(tmp_path / "count")
+        gate = str(tmp_path / "gate")
+        storage = str(tmp_path / "s")
+
+        @workflow.step
+        def gated(path):
+            import os
+            if not os.path.exists(path):
+                raise RuntimeError("continuation crash")
+            return "cont-done"
+
+        @workflow.step
+        def parent(cpath, gpath):
+            import os
+            n = int(open(cpath).read()) if os.path.exists(cpath) else 0
+            open(cpath, "w").write(str(n + 1))
+            return gated.step(gpath)
+
+        node = parent.step(counter, gate)
+        with pytest.raises(Exception):
+            node.run("wf_body", storage)
+        assert int(open(counter).read()) == 1
+        open(gate, "w").close()
+        assert workflow.resume("wf_body", storage=storage) == "cont-done"
+        # the parent body ran exactly once across crash + resume
+        assert int(open(counter).read()) == 1
+        # internal records never leak into the step listing
+        assert all(not s.startswith("__") and "#body" not in s
+                   for s in workflow.list_steps("wf_body", storage))

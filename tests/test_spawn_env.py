@@ -1,0 +1,75 @@
+"""spawn_env — the one subprocess environment builder.
+
+Verified failure mode (rounds 4-5): the site TPU plugin activates at
+`import jax` whenever its pool env vars are present, and a degraded
+accelerator tunnel then hangs backend init forever in any child that
+inherited the parent environment. These tests pin the helper's
+contract without touching jax."""
+
+import os
+import sys
+
+from ray_tpu._private import spawn_env
+
+
+class TestStripAccelerator:
+    def test_strips_plugin_family_and_pins_cpu(self):
+        env = {"PALLAS_AXON_POOL_IPS": "1.2.3.4",
+               "AXON_POOL_SVC_OVERRIDE": "x",
+               "_AXON_REGISTERED": "1",
+               "PALLAS_AXON_TPU_GEN": "v5",
+               "KEEP": "me"}
+        out = spawn_env.strip_accelerator(env)
+        assert out["JAX_PLATFORMS"] == "cpu"
+        assert out["KEEP"] == "me"
+        assert not any(k.startswith(("AXON", "PALLAS_AXON", "_AXON"))
+                       for k in out)
+
+    def test_preserves_explicit_non_axon_platform(self):
+        env = {"JAX_PLATFORMS": "cuda", "PALLAS_AXON_POOL_IPS": "x"}
+        out = spawn_env.strip_accelerator(env)
+        assert out["JAX_PLATFORMS"] == "cuda"  # explicit choice kept
+        assert "PALLAS_AXON_POOL_IPS" not in out
+
+    def test_comma_list_naming_axon_repins(self):
+        # "axon,cpu" with the registration stripped would fail backend
+        # init on the unregistered name — must re-pin to cpu
+        env = {"JAX_PLATFORMS": "axon,cpu",
+               "PALLAS_AXON_POOL_IPS": "x"}
+        assert spawn_env.strip_accelerator(env)["JAX_PLATFORMS"] == "cpu"
+
+    def test_empty_and_axon_repins(self):
+        assert spawn_env.strip_accelerator(
+            {"JAX_PLATFORMS": ""})["JAX_PLATFORMS"] == "cpu"
+        assert spawn_env.strip_accelerator(
+            {"JAX_PLATFORMS": "Axon"})["JAX_PLATFORMS"] == "cpu"
+
+
+class TestChildEnv:
+    def test_defaults_strip_and_keep_base(self, monkeypatch):
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "x")
+        monkeypatch.setenv("SOME_VAR", "v")
+        env = spawn_env.child_env()
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert env["SOME_VAR"] == "v"
+        assert "PALLAS_AXON_POOL_IPS" not in env
+
+    def test_use_accelerator_inherits_untouched(self, monkeypatch):
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "x")
+        env = spawn_env.child_env(use_accelerator=True)
+        assert env["PALLAS_AXON_POOL_IPS"] == "x"
+
+    def test_pythonpath_layers(self):
+        env = spawn_env.child_env(base={"PYTHONPATH": "prior"},
+                                  repo_path="/repo",
+                                  inherit_sys_path=True)
+        parts = env["PYTHONPATH"].split(os.pathsep)
+        assert parts[0] == "/repo"
+        assert parts[-1] == "prior"
+        assert any(p in parts for p in sys.path if p)
+
+    def test_extra_wins_last(self):
+        env = spawn_env.child_env(base={}, extra={"JAX_PLATFORMS": "tpu",
+                                                  "N": 3})
+        assert env["JAX_PLATFORMS"] == "tpu"  # caller override wins
+        assert env["N"] == "3"  # stringified
