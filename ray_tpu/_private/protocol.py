@@ -53,3 +53,53 @@ def mismatch_error(listener: str, version: Optional[int]) -> tuple:
             f"protocol version mismatch: {listener} speaks "
             f"v{PROTOCOL_VERSION}, peer sent {got}; run the same "
             "ray_tpu version on every node/client")
+
+
+# ----------------------------------------------------------------------
+# proto3 envelope (reference: src/ray/protobuf/ — the schema'd wire).
+# wire.proto defines Hello/Reject; wire_pb2.py is the checked-in
+# codegen. The handshake layer speaks proto BYTES; legacy tuple hellos
+# still parse (split_any_hello) so mixed versions fail with a clear
+# rejection instead of a shape error.
+# ----------------------------------------------------------------------
+
+def make_proto_hello(role: str, *, worker_num: int = 0,
+                     kind: str = "", client_id: str = "",
+                     payload: bytes = b"") -> bytes:
+    """Schema'd hello bytes: ray_tpu.wire.Hello."""
+    from ray_tpu._private import wire_pb2
+
+    return wire_pb2.Hello(
+        protocol_version=PROTOCOL_VERSION, role=role,
+        worker_num=worker_num, kind=kind, client_id=client_id,
+        payload=payload).SerializeToString()
+
+
+def split_any_hello(msg) -> Tuple[Optional[int], tuple]:
+    """(version, fields) from a proto-bytes hello OR a legacy tuple.
+
+    Proto hellos yield fields (role, worker_num, kind, client_id,
+    payload); tuple hellos keep their tuple fields."""
+    if isinstance(msg, (bytes, bytearray)):
+        from ray_tpu._private import wire_pb2
+
+        hello = wire_pb2.Hello()
+        try:
+            hello.ParseFromString(bytes(msg))
+        except Exception:  # noqa: BLE001 (DecodeError + runtime variants)
+            return None, ()
+        if not hello.role:
+            return None, ()
+        return hello.protocol_version, (hello.role, hello.worker_num,
+                                        hello.kind, hello.client_id,
+                                        hello.payload)
+    return split_hello(msg)
+
+
+def proto_reject(reason: str) -> bytes:
+    """Schema'd rejection bytes: ray_tpu.wire.Reject."""
+    from ray_tpu._private import wire_pb2
+
+    return wire_pb2.Reject(reason=reason,
+                           speaker_version=PROTOCOL_VERSION
+                           ).SerializeToString()

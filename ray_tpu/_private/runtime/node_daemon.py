@@ -153,7 +153,7 @@ def _peer_dial(address, authkey: bytes, oid: ObjectID, timeout: float):
     except (OSError, EOFError, ValueError, AuthenticationError):
         return None
     try:
-        conn.send(protocol.make_hello("peer"))
+        conn.send(protocol.make_proto_hello("peer"))
         if conn.recv() != ("ok",):
             conn.close()
             return None
@@ -578,10 +578,15 @@ class NodeDaemon:
                 hello = conn.recv()
             except (EOFError, OSError):
                 return
-            ver, _fields = protocol.split_hello(hello)
+            # the peer plane speaks the proto3 envelope (wire.proto);
+            # legacy tuple hellos still parse so skew fails cleanly
+            ver, _fields = protocol.split_any_hello(hello)
             if ver != protocol.PROTOCOL_VERSION:
                 try:
-                    conn.send(protocol.mismatch_error("peer plane", ver))
+                    # schema'd rejection: the Reject envelope is what a
+                    # cross-language dialer can actually parse
+                    conn.send(protocol.proto_reject(
+                        protocol.mismatch_error("peer plane", ver)[1]))
                 except (OSError, ValueError):
                     pass
                 return
@@ -692,7 +697,7 @@ class NodeDaemon:
                 try:
                     if entry[0] is None:
                         c = Client(address, authkey=self._peer_authkey)
-                        c.send(protocol.make_hello("peer"))
+                        c.send(protocol.make_proto_hello("peer"))
                         ack = c.recv()
                         if ack != ("ok",):
                             # version rejection: log the peer's reason

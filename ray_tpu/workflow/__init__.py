@@ -92,11 +92,24 @@ class _StepNode:
         os.makedirs(wf_dir, exist_ok=True)
         # journal the DAG itself so resume()/resume_all() can re-run
         # this workflow without the caller re-building the node —
-        # rewritten on EVERY run, so a re-run with a corrected node
-        # replaces the stale (possibly broken) one
-        _journal_write(wf_dir, "__dag__",
-                       {"node": cloudpickle.dumps(self)})
+        # refreshed when it changes, so a re-run with a corrected node
+        # replaces the stale (possibly broken) one. An unpicklable arg
+        # degrades to no-resume-by-id, never to a failed run.
+        try:
+            blob = cloudpickle.dumps(self)
+        except Exception:  # noqa: BLE001
+            blob = None
+        if blob is not None:
+            prior = _journal_read(wf_dir, "__dag__")
+            if prior is None or prior.get("node") != blob:
+                _journal_write(wf_dir, "__dag__", {"node": blob})
         _journal_write(wf_dir, "__status__", {"status": "RUNNING"})
+        # a stale output from a PREVIOUS successful run must not
+        # masquerade as this run's result if this run fails
+        try:
+            os.remove(os.path.join(wf_dir, "__output__.step"))
+        except FileNotFoundError:
+            pass
         executed: Dict[str, int] = {"fresh": 0, "cached": 0}
         try:
             result = self._execute(wf_dir, "root", executed)
@@ -164,11 +177,10 @@ class _StepNode:
         # value is journaled as this step's result — a resume replays
         # the final value without re-descending. Errors inside the
         # continuation belong to ITS steps' options, not this one's.
-        hops = 0
-        while isinstance(result, _StepNode):
-            hops += 1
-            result = result._execute(wf_dir, f"{path}.cont{hops}",
-                                     executed)
+        # (one hop suffices: _execute returns fully resolved values,
+        # so a chain of continuations drains inside the recursion)
+        if isinstance(result, _StepNode):
+            result = result._execute(wf_dir, f"{path}.cont1", executed)
         if self.catch_exceptions:
             result = (result, None)
         _journal_write(wf_dir, key, {"result": result})
@@ -243,13 +255,15 @@ def list_all(storage: Optional[str] = None) -> List[Tuple[str, str]]:
 
 def get_output(workflow_id: str,
                storage: Optional[str] = None) -> Any:
-    """The finished workflow's root result, from the journal."""
+    """The finished workflow's root result, from the journal (only
+    meaningful once the status is SUCCEEDED — run() clears any prior
+    output when a new run starts)."""
     wf_dir = os.path.join(storage or storage_root(), workflow_id)
     rec = _journal_read(wf_dir, "__output__")
     if rec is None:
         raise ValueError(
             f"workflow {workflow_id!r} has no journaled output "
-            "(not run here, or not finished)")
+            "(not run here, not finished, or its latest run failed)")
     return rec["result"]
 
 

@@ -73,3 +73,46 @@ class TestChildEnv:
                                                   "N": 3})
         assert env["JAX_PLATFORMS"] == "tpu"  # caller override wins
         assert env["N"] == "3"  # stringified
+
+
+class TestWireProto:
+    """The proto3 handshake envelope (wire.proto / wire_pb2) the peer
+    plane speaks; legacy tuple hellos must still parse."""
+
+    def test_proto_hello_roundtrip(self):
+        from ray_tpu._private import protocol
+
+        blob = protocol.make_proto_hello(
+            "peer", worker_num=3, kind="task", client_id="c1",
+            payload=b"x")
+        assert isinstance(blob, bytes)
+        ver, fields = protocol.split_any_hello(blob)
+        assert ver == protocol.PROTOCOL_VERSION
+        assert fields[0] == "peer" and fields[1] == 3
+        assert fields[2] == "task" and fields[3] == "c1"
+        assert fields[4] == b"x"
+
+    def test_legacy_tuple_still_parses(self):
+        from ray_tpu._private import protocol
+
+        ver, fields = protocol.split_any_hello(
+            protocol.make_hello("peer"))
+        assert ver == protocol.PROTOCOL_VERSION
+        assert fields == ("peer",)
+
+    def test_garbage_bytes_rejected_not_crashed(self):
+        from ray_tpu._private import protocol
+
+        # Hello{} parses from b"" with role="" -> malformed, and true
+        # garbage must also yield the unversioned verdict
+        assert protocol.split_any_hello(b"")[0] is None
+        ver, _f = protocol.split_any_hello(b"\xff\xfe\x00garbage")
+        assert ver is None or ver != protocol.PROTOCOL_VERSION
+
+    def test_reject_roundtrip(self):
+        from ray_tpu._private import protocol, wire_pb2
+
+        r = wire_pb2.Reject()
+        r.ParseFromString(protocol.proto_reject("skew"))
+        assert r.reason == "skew"
+        assert r.speaker_version == protocol.PROTOCOL_VERSION
