@@ -366,10 +366,10 @@ class ClientWorker:
         self.job_id = JobID.from_random()  # provisional ids only
         self.alive = True
         self.client_id = uuid.uuid4().hex
-        from ray_tpu._private.protocol import make_hello
+        from ray_tpu._private.protocol import make_wire_hello
 
         self._conn = _Connect((host, port), authkey=authkey)
-        self._conn.send(make_hello("client", self.client_id))
+        self._conn.send(make_wire_hello("client", self.client_id))
         self._send_lock = threading.Lock()
         self._replies: Dict[int, Tuple[threading.Event, list]] = {}
         self._req_seq = 0

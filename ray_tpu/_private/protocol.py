@@ -16,7 +16,13 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-PROTOCOL_VERSION = 2  # v1 was the unversioned round-3 wire
+# v1: unversioned round-3 wire; v2: versioned tuple hellos (round 4);
+# v3: proto3 Hello/Reject envelopes (round 5). v3 acceptors parse v2
+# tuple hellos and reject them with a clear error; a v3 dialer against
+# a v2 acceptor is a ONE-WAY break — the old binary drops the bytes
+# hello silently (its parser predates proto), so upgrade heads before
+# nodes/clients.
+PROTOCOL_VERSION = 3
 
 
 def make_hello(*fields) -> tuple:
@@ -63,24 +69,37 @@ def mismatch_error(listener: str, version: Optional[int]) -> tuple:
 # rejection instead of a shape error.
 # ----------------------------------------------------------------------
 
-def make_proto_hello(role: str, *, worker_num: int = 0,
-                     kind: str = "", client_id: str = "",
-                     payload: bytes = b"") -> bytes:
-    """Schema'd hello bytes: ray_tpu.wire.Hello."""
+def make_wire_hello(role: str, *fields) -> bytes:
+    """Schema'd hello bytes (ray_tpu.wire.Hello). The caller STATES the
+    role — "worker" (fields: num, kind), "client" (fields: client_id),
+    or any daemon role/token (fields ride ``payload`` pickled, the
+    documented single-language extras behind a language-neutral
+    envelope). Version + role + the scalar worker/client fields are
+    proto-parseable by any language."""
+    import pickle as _pickle
+
     from ray_tpu._private import wire_pb2
 
-    return wire_pb2.Hello(
-        protocol_version=PROTOCOL_VERSION, role=role,
-        worker_num=worker_num, kind=kind, client_id=client_id,
-        payload=payload).SerializeToString()
+    hello = wire_pb2.Hello(protocol_version=PROTOCOL_VERSION,
+                           role=role)
+    if role == "worker":
+        num, kind = fields
+        hello.worker_num = num
+        hello.kind = kind
+    elif role == "client":
+        (hello.client_id,) = fields
+    elif fields:
+        hello.payload = _pickle.dumps(tuple(fields))
+    return hello.SerializeToString()
 
 
 def split_any_hello(msg) -> Tuple[Optional[int], tuple]:
-    """(version, fields) from a proto-bytes hello OR a legacy tuple.
-
-    Proto hellos yield fields (role, worker_num, kind, client_id,
-    payload); tuple hellos keep their tuple fields."""
+    """(version, legacy-shaped fields) from a proto-bytes hello OR a
+    legacy tuple — every acceptor's downstream destructuring sees the
+    same field tuples either way."""
     if isinstance(msg, (bytes, bytearray)):
+        import pickle as _pickle
+
         from ray_tpu._private import wire_pb2
 
         hello = wire_pb2.Hello()
@@ -90,9 +109,19 @@ def split_any_hello(msg) -> Tuple[Optional[int], tuple]:
             return None, ()
         if not hello.role:
             return None, ()
-        return hello.protocol_version, (hello.role, hello.worker_num,
-                                        hello.kind, hello.client_id,
-                                        hello.payload)
+        try:
+            if hello.role == "worker":
+                fields: tuple = (hello.worker_num, hello.kind)
+            elif hello.role == "client":
+                fields = ("client", hello.client_id)
+            elif hello.payload:
+                fields = (hello.role,) + tuple(
+                    _pickle.loads(hello.payload))
+            else:
+                fields = (hello.role,)
+        except Exception:  # noqa: BLE001 (torn payload)
+            return None, ()
+        return hello.protocol_version, fields
     return split_hello(msg)
 
 

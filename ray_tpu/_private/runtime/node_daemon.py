@@ -153,7 +153,7 @@ def _peer_dial(address, authkey: bytes, oid: ObjectID, timeout: float):
     except (OSError, EOFError, ValueError, AuthenticationError):
         return None
     try:
-        conn.send(protocol.make_proto_hello("peer"))
+        conn.send(protocol.make_wire_hello("peer"))
         if conn.recv() != ("ok",):
             conn.close()
             return None
@@ -375,14 +375,14 @@ class NodeDaemon:
         # token "join" = self-started daemon (ray_tpu start --address):
         # declared resources travel too and the head ADOPTS the node.
         # The peer transfer address rides at the tuple tail.
-        from ray_tpu._private.protocol import make_hello
+        from ray_tpu._private.protocol import make_wire_hello
 
         if node_token == "join":
-            self._head.send(make_hello(
+            self._head.send(make_wire_hello(
                 "join", os.getpid(), self.store.arena.name,
                 dict(join_info or {}), tuple(self.peer_address)))
         else:
-            self._head.send(make_hello(
+            self._head.send(make_wire_hello(
                 node_token, os.getpid(), self.store.arena.name,
                 tuple(self.peer_address)))
 
@@ -446,7 +446,7 @@ class NodeDaemon:
                 continue
             from ray_tpu._private import protocol
 
-            ver, fields = protocol.split_hello(hello)
+            ver, fields = protocol.split_any_hello(hello)
             if len(fields) != 2:
                 conn.close()
                 continue
@@ -697,7 +697,7 @@ class NodeDaemon:
                 try:
                     if entry[0] is None:
                         c = Client(address, authkey=self._peer_authkey)
-                        c.send(protocol.make_proto_hello("peer"))
+                        c.send(protocol.make_wire_hello("peer"))
                         ack = c.recv()
                         if ack != ("ok",):
                             # version rejection: log the peer's reason
@@ -930,10 +930,10 @@ class NodeDaemon:
                                       if s.actor_bin else None)}
                     for s in self._slots.values()
                     if s.proc is not None and s.proc.poll() is None}
-            from ray_tpu._private.protocol import make_hello
+            from ray_tpu._private.protocol import make_wire_hello
 
             try:
-                head.send(make_hello(
+                head.send(make_wire_hello(
                     "rejoin", os.getpid(), self.store.arena.name,
                     dict(self._node_info), tuple(self.peer_address),
                     workers))

@@ -246,7 +246,7 @@ class ProcessWorkerPool:
                 continue
             from ray_tpu._private import protocol
 
-            ver, fields = protocol.split_hello(hello)
+            ver, fields = protocol.split_any_hello(hello)
             if len(fields) != 2:
                 conn.close()
                 continue

@@ -516,7 +516,7 @@ class HeadServer:
                 continue
             from ray_tpu._private import protocol
 
-            ver, fields = protocol.split_hello(hello)
+            ver, fields = protocol.split_any_hello(hello)
             if not fields:
                 conn.close()
                 continue

@@ -589,13 +589,13 @@ def _main(argv: List[str]) -> None:
     address, arena_name, inline_max, worker_num = (
         argv[0], argv[1], int(argv[2]), int(argv[3]))
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
-    from ray_tpu._private.protocol import make_hello
+    from ray_tpu._private.protocol import make_wire_hello
 
     try:
         conn = Client(address, authkey=authkey)
-        conn.send(make_hello(worker_num, "task"))
+        conn.send(make_wire_hello("worker", worker_num, "task"))
         ctrl = Client(address, authkey=authkey)
-        ctrl.send(make_hello(worker_num, "ctrl"))
+        ctrl.send(make_wire_hello("worker", worker_num, "ctrl"))
     except (FileNotFoundError, ConnectionError, OSError):
         return  # pool already shut down while we were starting
     worker_main(conn, ctrl, arena_name, inline_max)

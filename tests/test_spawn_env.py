@@ -79,18 +79,33 @@ class TestWireProto:
     """The proto3 handshake envelope (wire.proto / wire_pb2) the peer
     plane speaks; legacy tuple hellos must still parse."""
 
-    def test_proto_hello_roundtrip(self):
+    def test_proto_hello_roundtrips_every_role(self):
+        """Every hello shape the runtime sends must reconstruct the
+        exact legacy field tuple its acceptor destructures."""
         from ray_tpu._private import protocol
 
-        blob = protocol.make_proto_hello(
-            "peer", worker_num=3, kind="task", client_id="c1",
-            payload=b"x")
-        assert isinstance(blob, bytes)
-        ver, fields = protocol.split_any_hello(blob)
-        assert ver == protocol.PROTOCOL_VERSION
-        assert fields[0] == "peer" and fields[1] == 3
-        assert fields[2] == "task" and fields[3] == "c1"
-        assert fields[4] == b"x"
+        cases = [
+            (("peer",), ("peer",)),
+            (("worker", 3, "task"), (3, "task")),
+            (("worker", 7, "ctrl"), (7, "ctrl")),
+            (("client", "abc123"), ("client", "abc123")),
+            (("join", 42, "arena0", {"num_cpus": 2.0},
+              ("127.0.0.1", 9000)),
+             ("join", 42, "arena0", {"num_cpus": 2.0},
+              ("127.0.0.1", 9000))),
+            (("rejoin", 42, "arena0", {"n": 1},
+              ("127.0.0.1", 9000), {0: {"pid": 5}}),
+             ("rejoin", 42, "arena0", {"n": 1},
+              ("127.0.0.1", 9000), {0: {"pid": 5}})),
+            (("tok123", 42, "arena0", ("h", 1)),
+             ("tok123", 42, "arena0", ("h", 1))),
+        ]
+        for args, want in cases:
+            blob = protocol.make_wire_hello(*args)
+            assert isinstance(blob, bytes)
+            ver, got = protocol.split_any_hello(blob)
+            assert ver == protocol.PROTOCOL_VERSION, args
+            assert got == want, (args, got)
 
     def test_legacy_tuple_still_parses(self):
         from ray_tpu._private import protocol
