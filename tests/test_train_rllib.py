@@ -677,12 +677,21 @@ class TestAPPOAlgorithm:
                           rollout_len=64, updates_per_iter=8,
                           seed=0).build()
         try:
+            target = algo.config.kl_target
             coefs = set()
-            for _ in range(8):
+            kls = []
+            for _ in range(10):
                 m = algo.train()
                 assert "kl" in m and "kl_coef" in m
                 coefs.add(round(m["kl_coef"], 6))
-            assert len(coefs) > 1, coefs  # the coefficient adapted
+                kls.append(m["kl"])
+            # the schedule holds inside [target/2, 2*target] and moves
+            # outside it; async batch-arrival order makes the KL
+            # trajectory timing-dependent, so EITHER the coefficient
+            # moved OR every measured KL stayed in the hold band
+            in_band = all(0.5 * target <= k <= 2.0 * target
+                          for k in kls)
+            assert len(coefs) > 1 or in_band, (coefs, kls)
         finally:
             algo.stop()
 
