@@ -194,6 +194,14 @@ def main() -> int:
     OUT["host_cpus"] = os.cpu_count()
     _emit()
 
+    if device_smoke:
+        # record the PINNED fallback shapes (perf.py freezes them) so
+        # fallback rounds are comparable round-over-round and a reader
+        # can tell which shape produced a number
+        from ray_tpu._private import perf as _perf
+        OUT["cpu_fallback_config"] = {"model": dict(_perf.SMOKE_MODEL),
+                                      "decode": dict(_perf.SMOKE_DECODE)}
+
     from ray_tpu._private import benchmarks, perf
 
     if run_all and section("baseline_configs", 60):
@@ -252,6 +260,38 @@ def main() -> int:
                 print(f"  north star: {value} ms "
                       f"(groups {OUT['north_star']['runs_ms']})",
                       file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+        _emit()
+
+    # --- north star, multi-tick admission ------------------------------
+    # honesty companion: the SAME 1M tasks admitted over 64 dependency
+    # waves — a full ready-set/admission tick per wave, the cost the
+    # single-wave fan-out headline never shows
+    if section("north_star_multi_tick", 20):
+        try:
+            gw = (benchmarks.build_north_star_waves(10_000, 16, 8)
+                  if smoke else benchmarks.build_north_star_waves())
+            groups = []
+            for _ in range(1 if smoke else 3):
+                if _remaining() < 15 and groups:
+                    break
+                try:
+                    groups.append(benchmarks.run_graph(gw, repeats=3))
+                except RuntimeError:
+                    traceback.print_exc()
+            if groups:
+                ns = min(groups, key=lambda r: r["scheduling_ms"])
+                OUT["north_star_multi_tick"] = {
+                    "scheduling_ms": round(ns["scheduling_ms"], 4),
+                    "tasks_per_sec": round(ns["tasks_per_sec"], 1),
+                    "ticks": ns["ticks"],
+                    "waves": 16 if smoke else 64,
+                    "runs_ms": [round(r["scheduling_ms"], 3)
+                                for r in groups]}
+                print(f"  north star multi-tick: "
+                      f"{OUT['north_star_multi_tick']['scheduling_ms']}"
+                      f" ms over {ns['ticks']} ticks", file=sys.stderr)
         except Exception:
             traceback.print_exc()
         _emit()
@@ -409,6 +449,31 @@ def main() -> int:
         except Exception:
             traceback.print_exc()
             OUT["data_join_mb_per_sec"] = None
+        _emit()
+
+    # --- Data library: streaming-split ingest overlap ------------------
+    if section("data_ingest_overlap", 15):
+        try:
+            r = perf.data_ingest_overlap(
+                num_blocks=32 if smoke else 96,
+                sleep_s=0.01 if smoke else 0.025)
+            OUT["data_ingest_overlap"] = {
+                "ttfb_materialize_s": r["ttfb_materialize_s"],
+                "ttfb_streaming_s": r["ttfb_streaming_s"],
+                "ttfb_speedup": r["ttfb_speedup"],
+                "overlap_fraction": r["overlap_fraction"],
+                "streaming_blocks_per_sec":
+                    r["streaming_blocks_per_sec"],
+                "consumers": r["consumers"],
+                "num_blocks": r["num_blocks"],
+            }
+            print(f"  data ingest overlap: ttfb {r['ttfb_streaming_s']}s"
+                  f" streaming vs {r['ttfb_materialize_s']}s materialized"
+                  f" ({r['ttfb_speedup']}x; overlap "
+                  f"{r['overlap_fraction']})", file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+            OUT["data_ingest_overlap"] = None
         _emit()
 
     # --- RLlib: IMPALA async rollout throughput ------------------------

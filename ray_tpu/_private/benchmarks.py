@@ -231,6 +231,38 @@ def build_north_star(num_tasks: int = 1_000_000,
     return g
 
 
+def build_north_star_waves(num_tasks: int = 1_000_000,
+                           num_waves: int = 64,
+                           num_nodes: int = 64) -> BenchGraph:
+    """North-star honesty companion: the same 1M tasks admitted over
+    ``num_waves`` dependency waves instead of one flat fan-out. Wave w
+    gates on wave w-1's first task, so the kernel must run a full
+    ready-set/admission tick PER WAVE — the multi-tick admission cost a
+    single-wave fan-out never shows. Capacity is sized to one wave, not
+    the whole DAG."""
+    per_wave = num_tasks // num_waves
+    num_tasks = per_wave * num_waves
+    c = num_tasks
+    idx = np.arange(c, dtype=np.int64)
+    wave = idx // per_wave
+    indeg = (wave > 0).astype(np.int32)
+    # every task of wave w>0 depends on wave w-1's FIRST task; dst is
+    # naturally ascending in this wave-major layout
+    has_edge = wave > 0
+    src = ((wave[has_edge] - 1) * per_wave).astype(np.int32)
+    dst = idx[has_edge].astype(np.int32)
+    per_node = -(-per_wave // num_nodes)
+    return BenchGraph(
+        name=f"north_star_waves_{num_tasks}x{num_waves}",
+        indeg=indeg,
+        cls=np.zeros(c, dtype=np.int32),
+        demands=np.asarray([[1, 0, 0, 0]], dtype=np.float32),
+        src=src, dst=dst,
+        cap=_nodes(num_nodes, float(per_node)),
+        max_ticks=num_waves + 2,
+    )
+
+
 CONFIGS = {
     "fanout": build_fanout,
     "map_reduce": build_map_reduce,
@@ -238,6 +270,7 @@ CONFIGS = {
     "actor_heavy": build_actor_heavy,
     "ppo": build_ppo,
     "north_star": build_north_star,
+    "north_star_waves": build_north_star_waves,
 }
 
 

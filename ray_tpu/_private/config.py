@@ -202,6 +202,14 @@ _d("data_buffer_bytes", int, 256 * 1024 * 1024,
    "ray_tpu.data: max BYTES of buffered arena-resident blocks across "
    "the pipeline (bytes-based backpressure; sizes known for shm-stored "
    "blocks)")
+_d("data_split_queue_blocks", int, 8,
+   "ray_tpu.data streaming_split: max buffered blocks PER CONSUMER "
+   "queue (per-consumer backpressure — one slow consumer stalls only "
+   "its own lane, not the whole split)")
+_d("data_split_queue_bytes", int, 64 * 1024 * 1024,
+   "ray_tpu.data streaming_split: max buffered BYTES per consumer "
+   "queue (sizes known for arena-resident blocks; inline blocks fall "
+   "back to the block-count budget)")
 _d("health_check_period_s", float, 1.0, "control-plane health check period")
 _d("health_check_timeout_s", float, 5.0, "mark node dead after this")
 

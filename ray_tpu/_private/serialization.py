@@ -135,20 +135,20 @@ def deserialize(obj: SerializedObject) -> Any:
     return pickle.loads(obj.meta, buffers=buffers)
 
 
-class _BufferAnchor:
+class _BufferAnchor(np.ndarray):
     """Weakref-able buffer-protocol re-exporter. Reconstructed views
     (numpy arrays, Arrow buffers — and anything sliced off them) keep
     their buffer EXPORTER alive through the C buffer protocol; plain
     memoryviews cannot take weakrefs, so re-exporting through this
-    anchor is what lets a finalizer observe the true last-view death."""
+    anchor is what lets a finalizer observe the true last-view death.
+    An ndarray view (not a class with ``__buffer__``, which only
+    Python 3.12+ honours) so the anchor exports the buffer protocol
+    on every supported interpreter."""
 
-    __slots__ = ("_mv", "__weakref__")
 
-    def __init__(self, mv: memoryview):
-        self._mv = mv
-
-    def __buffer__(self, flags) -> memoryview:
-        return self._mv
+def _anchor(buf) -> _BufferAnchor:
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    return np.frombuffer(mv, dtype=np.uint8).view(_BufferAnchor)
 
 
 def deserialize_with_release(obj: SerializedObject,
@@ -165,8 +165,7 @@ def deserialize_with_release(obj: SerializedObject,
             return deserialize(obj)  # plain pickle: nothing aliases
         finally:
             release()
-    anchors = [_BufferAnchor(b if isinstance(b, memoryview)
-                             else memoryview(b)) for b in obj.buffers]
+    anchors = [_anchor(b) for b in obj.buffers]
     remaining = [len(anchors)]
 
     def _one_done():

@@ -60,8 +60,12 @@ table { border-collapse: collapse; width: 100%; max-width: 880px; }
 th, td { text-align: left; padding: 4px 12px 4px 0; border-bottom: 1px solid var(--grid);
   font-variant-numeric: tabular-nums; }
 th { color: var(--text-secondary); font-weight: 500; }
-.dot { display: inline-block; width: 8px; height: 8px; border-radius: 50%;
-  margin-right: 6px; vertical-align: baseline; }
+/* state badges: a CSS-class dot per known state, so no cell value is
+   ever rendered as markup */
+td[class^="st-"]::before { content: ""; display: inline-block; width: 8px;
+  height: 8px; border-radius: 50%; margin-right: 6px;
+  vertical-align: baseline; background: var(--critical); }
+td.st-alive::before, td.st-running::before { background: var(--good); }
 .links a { color: var(--text-secondary); margin-right: 10px; }
 #chartwrap { position: relative; max-width: 880px; }
 #tp-tip { position: absolute; pointer-events: none; display: none;
@@ -79,10 +83,12 @@ th { color: var(--text-secondary); font-weight: 500; }
 <div class="panel"><h2>Nodes</h2><div id="nodes"></div></div>
 <div class="panel"><h2>Task summary</h2><div id="tasks"></div></div>
 <div class="panel"><h2>Actors</h2><div id="actors"></div></div>
+<div class="panel"><h2>Data streams</h2><div id="streams"></div></div>
 <div class="panel links"><h2>Raw endpoints</h2>
 <a href="/api/summary">summary</a><a href="/api/tasks">tasks</a>
 <a href="/api/actors">actors</a><a href="/api/objects">objects</a>
 <a href="/api/nodes">nodes</a><a href="/api/placement_groups">pgs</a>
+<a href="/api/data_streams">streams</a>
 <a href="/api/jobs">jobs</a><a href="/metrics">metrics</a></div>
 <script>
 "use strict";
@@ -100,7 +106,7 @@ function tile(k, v, color) {
     <div class="k">${k}</div></div>`;
 }
 
-function rows(list, cols) {
+function rows(list, cols, stateCols) {
   if (!list || !list.length) {
     return '<div class="sub">none</div>';
   }
@@ -108,10 +114,15 @@ function rows(list, cols) {
   const body = list.map(r =>
     `<tr>${cols.map(c => {
       const v = r[c] ?? "";
-      // cluster data (actor names, resource keys) must never become
-      // markup in the operator's browser; cells marked _html carry
-      // only our own generated markup
-      return `<td>${r._html && r._html.includes(c) ? v : esc(v)}</td>`;
+      // cluster data (actor names, node states, resource keys) must
+      // never become markup in the operator's browser: EVERY cell is
+      // escaped; state badges are pure CSS keyed on a validated class
+      if (stateCols && stateCols.includes(c)) {
+        const cls = /^[a-z_]+$/.test(String(v).toLowerCase()) ?
+          String(v).toLowerCase() : "other";
+        return `<td class="st-${cls}">${esc(v)}</td>`;
+      }
+      return `<td>${esc(v)}</td>`;
     }).join("")}</tr>`
   ).join("");
   return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
@@ -194,21 +205,30 @@ async function refresh() {
       tile("tasks running", sched.running ??
            Math.max(0, (sched.dispatched ?? 0) - finished)) +
       tile("tasks finished", finished) +
-      tile("tasks/s", rates.length ? rates[rates.length - 1].rate.toFixed(1) : "–");
+      tile("tasks/s", rates.length ? rates[rates.length - 1].rate.toFixed(1) : "–") +
+      tile("ingest overlap", (s.data_streams || []).length ?
+           (100 * (s.data_streams[s.data_streams.length - 1]
+                     .overlap_fraction || 0)).toFixed(0) + "%" : "–");
     document.getElementById("nodes").innerHTML = rows(nodes.map(n => ({
-      _html: ["state"],
-      node: (n.node_id || "").slice(0, 12), state:
-        `<span class="dot" style="background:var(--${(n.state || "ALIVE") === "ALIVE" ?
-          "good" : "critical"})"></span>${esc(n.state || "ALIVE")}`,
+      node: (n.node_id || "").slice(0, 12), state: n.state || "ALIVE",
       kind: n.kind || "", resources: JSON.stringify(n.resources || {}),
-    })), ["node", "state", "kind", "resources"]);
+    })), ["node", "state", "kind", "resources"], ["state"]);
     document.getElementById("tasks").innerHTML = rows(
       Object.entries(t).map(([state, count]) => ({state, count})),
       ["state", "count"]);
     document.getElementById("actors").innerHTML = rows(actors.slice(0, 50).map(a => ({
       actor: (a.actor_id || "").slice(0, 12), name: a.name || "",
       state: a.state || "", node: a.node_index ?? "",
-    })), ["actor", "name", "state", "node"]);
+    })), ["actor", "name", "state", "node"], ["state"]);
+    const streams = s.data_streams || [];
+    document.getElementById("streams").innerHTML = rows(streams.map(d => ({
+      stream: d.stream_id, dataset: d.dataset, consumers: d.consumers,
+      epoch: d.epoch, produced: d.blocks_produced,
+      consumed: d.blocks_consumed,
+      overlap: (100 * (d.overlap_fraction || 0)).toFixed(0) + "%",
+      state: d.live ? (d.producing ? "producing" : "idle") : "done",
+    })), ["stream", "dataset", "consumers", "epoch", "produced",
+          "consumed", "overlap", "state"]);
     drawChart();
   } catch (e) {
     document.getElementById("addr").textContent = "refresh failed: " + e;
@@ -238,6 +258,7 @@ class Dashboard:
             "/api/nodes": lambda: state.list_nodes(),
             "/api/placement_groups":
                 lambda: state.list_placement_groups(),
+            "/api/data_streams": lambda: state.list_data_streams(),
             "/api/jobs": lambda: {
                 j.hex(): meta
                 for j, meta in worker.gcs.job_table().items()},
@@ -248,6 +269,7 @@ class Dashboard:
                 "actors_alive": sum(
                     1 for a in state.list_actors()
                     if a["state"] == "ALIVE"),
+                "data_streams": state.list_data_streams(),
                 "time": time.time(),
             },
         }

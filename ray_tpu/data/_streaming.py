@@ -20,7 +20,10 @@ budget); placement/dispatch stay with the core scheduler.
 from __future__ import annotations
 
 import collections
+import itertools
+import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import ray_tpu
@@ -1316,3 +1319,360 @@ class StreamingExecutor:
                 for st in self._stages
             ],
         }
+
+
+# ----------------------------------------------------------------------
+# streaming split (reference: Dataset.streaming_split -> OutputSplitter,
+# python/ray/data/_internal/execution/operators/output_splitter.py):
+# N concurrent consumers fed by ONE streaming execution. A driver-side
+# producer thread pulls the executor's ordered ref stream and routes
+# each finished block to a per-consumer bounded queue — block- AND
+# byte-budget backpressure PER CONSUMER (one slow consumer stalls only
+# its own lane; the reference's equal/locality splitter makes the same
+# per-output-bundle decision). The hand-off is barrier-free: consumers
+# pop existing ObjectRefs the moment they land; epoch restart replays
+# the lazy plan through a fresh executor without re-materializing.
+# ----------------------------------------------------------------------
+
+
+class _SplitConsumer:
+    __slots__ = ("idx", "queue", "queued_bytes", "alive", "epoch",
+                 "blocks_consumed", "bytes_consumed", "wait_s",
+                 "consumed_overlapped")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.queue: collections.deque = collections.deque()  # (ref, nbytes)
+        self.queued_bytes = 0
+        self.alive = True
+        self.epoch = 0                # fully-consumed epochs
+        self.blocks_consumed = 0
+        self.bytes_consumed = 0
+        self.wait_s = 0.0
+        self.consumed_overlapped = 0  # popped while the producer ran
+
+    def over_budget(self, q_blocks: int, q_bytes: int) -> bool:
+        return (len(self.queue) >= q_blocks
+                or self.queued_bytes >= q_bytes)
+
+
+_SPLIT_IDS = itertools.count()
+_SPLIT_REGISTRY_LOCK = threading.Lock()
+# live coordinators (weak: a dropped split must not leak its executor)
+_LIVE_SPLITS: "weakref.WeakValueDictionary[int, Any]" = \
+    weakref.WeakValueDictionary()
+# final stats snapshots of shut-down splits — the observability surface
+# outlives the run so a post-fit caller (tests, dashboard, bench) can
+# still read the overlap it achieved
+_RECENT_SPLITS: collections.deque = collections.deque(maxlen=16)
+
+
+def split_coordinator_stats() -> List[Dict[str, Any]]:
+    """Stats of every live streaming_split coordinator plus the last
+    few shut-down ones (backs util.state.list_data_streams)."""
+    with _SPLIT_REGISTRY_LOCK:
+        live = list(_LIVE_SPLITS.values())
+        recent = [dict(s) for s in _RECENT_SPLITS]
+    return [c.stats() for c in live] + recent
+
+
+class StreamingShard:
+    """One consumer's view of a streaming_split: a DataIterator-shaped
+    lazy iterator (iter_batches/iter_rows/count) whose blocks arrive
+    from the shared splitter as upstream tasks finish. Re-iterating
+    after exhaustion starts the next EPOCH (the plan replays through a
+    fresh executor once every live consumer finished the current one)."""
+
+    def __init__(self, coordinator: "StreamingSplitCoordinator",
+                 idx: int):
+        self.coordinator = coordinator
+        self._idx = idx
+        self._count: Optional[int] = None
+
+    def iter_block_refs(self) -> Iterator[Any]:
+        while True:
+            ref = self.coordinator._pop(self._idx)
+            if ref is None:
+                return
+            yield ref
+
+    def iter_batches(self, *, batch_size: Optional[int] = None,
+                     batch_format: str = "default") -> Iterator[Any]:
+        """Same contract as Dataset.iter_batches: native blocks by
+        default, batch_size re-slices within block boundaries,
+        batch_format converts each batch."""
+        n = 0
+        for ref in self.iter_block_refs():
+            block = ray_tpu.get(ref)
+            rows = blk.block_rows(block)
+            n += rows
+            if rows == 0:
+                continue
+            if batch_size is None:
+                yield blk.to_batch_format(block, batch_format)
+                continue
+            for i in range(0, rows, batch_size):
+                piece = blk.block_slice(block, i,
+                                        min(i + batch_size, rows))
+                yield blk.to_batch_format(piece, batch_format)
+        # a COMPLETE epoch pass caches the row count — count() after a
+        # full pass must not consume another epoch
+        self._count = n
+
+    def iter_rows(self) -> Iterator[Any]:
+        n = 0
+        for ref in self.iter_block_refs():
+            block = ray_tpu.get(ref)
+            n += blk.block_rows(block)
+            yield from blk.iter_block_rows(block)
+        self._count = n
+
+    def count(self) -> int:
+        if self._count is None:
+            self._count = sum(blk.block_rows(b)
+                              for b in self.iter_batches())
+        return self._count
+
+    def close(self) -> None:
+        """Mark this consumer dead: it leaves the epoch barrier and its
+        queued blocks drain back to the splitter for the live consumers
+        (a dead trainer must not poison the run)."""
+        self.coordinator.close_consumer(self._idx)
+
+    def stats(self) -> Dict[str, Any]:
+        return self.coordinator.stats()
+
+
+class StreamingSplitCoordinator:
+    """Owns the producer thread and the N per-consumer bounded queues
+    of one Dataset.streaming_split."""
+
+    def __init__(self, dataset, n: int, equal: bool = False,
+                 locality_hints: Optional[List[Any]] = None):
+        if n < 1:
+            raise ValueError("streaming_split needs n >= 1")
+        if locality_hints is not None and len(locality_hints) != n:
+            raise ValueError(
+                f"locality_hints must have one entry per consumer "
+                f"({len(locality_hints)} != {n})")
+        self._dataset = dataset
+        self._n = n
+        self._equal = equal
+        # accepted for API parity; a single-host runtime has no
+        # placement choice to make, so hints are recorded, not acted on
+        self._locality_hints = locality_hints
+        self._id = next(_SPLIT_IDS)
+        self._name = getattr(dataset._op, "name", "dataset")
+        self._cond = threading.Condition()
+        self._consumers = [_SplitConsumer(i) for i in range(n)]
+        # drain-back lane: blocks queued at a consumer that died come
+        # back here and are picked up by whichever live consumer asks
+        # first (bounded by the same per-consumer budget)
+        self._orphans: collections.deque = collections.deque()
+        self._orphan_bytes = 0
+        self._q_blocks = max(1, GLOBAL_CONFIG.data_split_queue_blocks)
+        self._q_bytes = max(1, GLOBAL_CONFIG.data_split_queue_bytes)
+        self._stopped = False
+        self._producing = False
+        self._produced_epochs = 0
+        self._producer_error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._blocks_produced = 0
+        self._backpressure_s = 0.0
+        self._exec_stats: Optional[Dict[str, Any]] = None
+        with _SPLIT_REGISTRY_LOCK:
+            _LIVE_SPLITS[self._id] = self
+
+    def shards(self) -> List[StreamingShard]:
+        return [StreamingShard(self, i) for i in range(self._n)]
+
+    # -- producer side --------------------------------------------------
+    def _ensure_producer(self) -> None:
+        """Start the next epoch's executor — only once EVERY live
+        consumer has fully consumed the current epoch (no consumer may
+        see epoch k+1 blocks while another still drains k). Callers
+        hold self._cond."""
+        if (self._stopped or self._producing
+                or self._producer_error is not None):
+            return
+        if any(c.alive and c.epoch < self._produced_epochs
+               for c in self._consumers):
+            return
+        self._producing = True
+        self._thread = threading.Thread(
+            target=self._produce, daemon=True,
+            name=f"ray_tpu_split_{self._id}")
+        self._thread.start()
+
+    def _produce(self) -> None:
+        """One epoch: replay the lazy plan (exchange segments and all —
+        no cached materialization) and route the final ref stream."""
+        err: Optional[BaseException] = None
+        ex = None
+        gen = None
+        try:
+            _src, ex = self._dataset._final_executor(None)
+            gen = ex.run_refs()
+            for idx, ref in enumerate(gen):
+                if not self._route(idx, ref):
+                    break
+        except BaseException as e:  # noqa: BLE001 — consumers re-raise
+            err = e
+        finally:
+            if gen is not None:
+                gen.close()  # executor teardown (cancel inflight)
+            if ex is not None:
+                try:
+                    self._exec_stats = ex.stats()
+                    self._dataset._last_stats = dict(
+                        self._exec_stats, split=self.stats())
+                except Exception:
+                    pass
+            with self._cond:
+                if err is not None and not self._stopped:
+                    self._producer_error = err
+                else:
+                    self._produced_epochs += 1
+                self._producing = False
+                self._cond.notify_all()
+
+    def _least_backlogged(self) -> Optional[_SplitConsumer]:
+        live = [c for c in self._consumers if c.alive]
+        if not live:
+            return None
+        return min(live, key=lambda c: (
+            c.over_budget(self._q_blocks, self._q_bytes),
+            len(c.queue), c.idx))
+
+    def _route(self, idx: int, ref: Any) -> bool:
+        """Route one finished block; blocks (producer-side backpressure)
+        while the TARGET consumer is over its budget. False = stop the
+        epoch (coordinator shut down or every consumer closed)."""
+        nbytes = _ref_nbytes(ref)
+        with self._cond:
+            t0 = time.perf_counter()
+            while not self._stopped:
+                if self._equal:
+                    target = self._consumers[idx % self._n]
+                    if not target.alive:
+                        # round-robin owner died: redistribute
+                        target = self._least_backlogged()
+                else:
+                    target = self._least_backlogged()
+                if target is None:
+                    return False
+                if not target.over_budget(self._q_blocks, self._q_bytes):
+                    target.queue.append((ref, nbytes))
+                    target.queued_bytes += nbytes
+                    self._blocks_produced += 1
+                    self._backpressure_s += time.perf_counter() - t0
+                    self._cond.notify_all()
+                    return True
+                self._cond.wait(0.5)
+            return False
+
+    # -- consumer side --------------------------------------------------
+    def _pop(self, cid: int) -> Optional[Any]:
+        """Next block ref for consumer cid, or None when its current
+        epoch is exhausted (which advances the consumer's epoch)."""
+        c = self._consumers[cid]
+        with self._cond:
+            t0 = time.perf_counter()
+            while True:
+                if self._producer_error is not None:
+                    raise self._producer_error
+                if not c.alive:
+                    raise RuntimeError(
+                        "streaming_split consumer already closed")
+                if c.queue:
+                    ref, nbytes = c.queue.popleft()
+                    c.queued_bytes -= nbytes
+                elif self._orphans:
+                    ref, nbytes = self._orphans.popleft()
+                    self._orphan_bytes -= nbytes
+                else:
+                    if self._produced_epochs > c.epoch or self._stopped:
+                        # epoch drained (or split torn down): done
+                        c.wait_s += time.perf_counter() - t0
+                        c.epoch += 1
+                        self._cond.notify_all()
+                        return None
+                    self._ensure_producer()
+                    self._cond.wait(0.5)
+                    continue
+                c.wait_s += time.perf_counter() - t0
+                c.blocks_consumed += 1
+                c.bytes_consumed += nbytes
+                if self._producing:
+                    c.consumed_overlapped += 1
+                self._cond.notify_all()
+                return ref
+
+    def close_consumer(self, cid: int) -> None:
+        with self._cond:
+            c = self._consumers[cid]
+            if not c.alive:
+                return
+            c.alive = False
+            while c.queue:
+                item = c.queue.popleft()
+                self._orphans.append(item)
+                self._orphan_bytes += item[1]
+            c.queued_bytes = 0
+            self._cond.notify_all()
+
+    def shutdown(self) -> None:
+        """Stop the producer and snapshot final stats into the recent-
+        splits registry (the run's overlap stays observable)."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=30.0)
+        with _SPLIT_REGISTRY_LOCK:
+            _LIVE_SPLITS.pop(self._id, None)
+            _RECENT_SPLITS.append(self.stats())
+
+    def __del__(self):  # dropped without shutdown: stop the producer
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+    # -- observability --------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            consumed = sum(c.blocks_consumed for c in self._consumers)
+            overlapped = sum(c.consumed_overlapped
+                             for c in self._consumers)
+            return {
+                "stream_id": self._id,
+                "dataset": self._name,
+                "consumers": self._n,
+                "equal": self._equal,
+                "live": not self._stopped,
+                "producing": self._producing,
+                "epoch": self._produced_epochs,
+                "blocks_produced": self._blocks_produced,
+                "blocks_consumed": consumed,
+                "backpressure_wait_s": round(self._backpressure_s, 4),
+                "overlap_fraction": (round(overlapped / consumed, 4)
+                                     if consumed else 0.0),
+                "per_consumer": [
+                    {"consumer": c.idx,
+                     "alive": c.alive,
+                     "epoch": c.epoch,
+                     "queued": len(c.queue),
+                     "queued_bytes": c.queued_bytes,
+                     "blocks_consumed": c.blocks_consumed,
+                     "bytes_consumed": c.bytes_consumed,
+                     "wait_s": round(c.wait_s, 4),
+                     "overlap_fraction": (
+                         round(c.consumed_overlapped
+                               / c.blocks_consumed, 4)
+                         if c.blocks_consumed else 0.0)}
+                    for c in self._consumers],
+            }

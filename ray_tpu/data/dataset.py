@@ -546,6 +546,27 @@ class Dataset:
             out.append(Dataset(_refs_source(refs[lo:hi], f"split_{i}")))
         return out
 
+    def streaming_split(self, n: int, equal: bool = False,
+                        locality_hints: Optional[List[Any]] = None
+                        ) -> List["StreamingShard"]:
+        """n concurrent shard iterators over ONE streaming execution
+        (reference: Dataset.streaming_split). Unlike split(), nothing
+        materializes: a splitter routes each finished block to a
+        per-consumer bounded queue as upstream tasks complete, so
+        consumption overlaps production. ``equal=True`` round-robins
+        blocks deterministically (consumer i gets blocks i, i+n, ...);
+        ``equal=False`` routes each block to the least-backlogged
+        consumer. Re-iterating an exhausted shard starts the next
+        epoch: the lazy plan replays once every live shard finished
+        the current one. Shards are single-use handles — call
+        ``close()`` on a shard you abandon so the others don't wait on
+        it at the epoch barrier."""
+        from ray_tpu.data._streaming import StreamingSplitCoordinator
+
+        coord = StreamingSplitCoordinator(
+            self, n, equal=equal, locality_hints=locality_hints)
+        return coord.shards()
+
     def zip(self, other: "Dataset") -> "Dataset":
         """Positional column-merge of two same-length datasets
         (reference: Dataset.zip — right-side duplicate column names

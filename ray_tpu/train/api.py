@@ -99,22 +99,31 @@ class DataIterator:
         self._refs = list(block_refs)
         self._count: Optional[int] = None
 
-    def iter_batches(self):
+    def iter_batches(self, *, batch_size=None, batch_format="default"):
         from ray_tpu.data import block as blk
 
         n = 0
         for ref in self._refs:
             block = ray_tpu.get(ref)
-            n += blk.block_rows(block)
-            yield block
+            rows = blk.block_rows(block)
+            n += rows
+            if rows == 0:
+                continue
+            if batch_size is None:
+                yield blk.to_batch_format(block, batch_format)
+                continue
+            for i in range(0, rows, batch_size):
+                piece = blk.block_slice(block, i,
+                                        min(i + batch_size, rows))
+                yield blk.to_batch_format(piece, batch_format)
         self._count = n
 
     def iter_rows(self):
         from ray_tpu.data import block as blk
 
-        for block in self.iter_batches():
+        for ref in self._refs:
             # Arrow blocks iterate COLUMNS natively; rows means rows
-            yield from blk.iter_block_rows(block)
+            yield from blk.iter_block_rows(ray_tpu.get(ref))
 
     def count(self) -> int:
         from ray_tpu.data import block as blk
@@ -127,9 +136,13 @@ class DataIterator:
         return self._count
 
 
-def get_dataset_shard(name: str = "train") -> DataIterator:
-    """Inside train_loop_per_worker: this worker's split of the dataset
-    passed to Trainer(datasets={...}) — blocks round-robined by rank."""
+def get_dataset_shard(name: str = "train"):
+    """Inside train_loop_per_worker: this worker's shard of the dataset
+    passed to Trainer(datasets={...}). On a streaming ingest path the
+    shard is a live StreamingShard — blocks arrive as upstream map
+    tasks finish, overlapping ingest with the train loop; on the
+    materialized fallback it wraps this rank's round-robined refs.
+    Both expose iter_batches/iter_rows/count."""
     session = _current_session()
     if session is None:
         raise RuntimeError("get_dataset_shard() called outside a train "
@@ -137,7 +150,10 @@ def get_dataset_shard(name: str = "train") -> DataIterator:
     if name not in session.dataset_shards:
         raise KeyError(f"no dataset named {name!r} was passed to the "
                        f"Trainer (have: {list(session.dataset_shards)})")
-    return DataIterator(session.dataset_shards[name])
+    shard = session.dataset_shards[name]
+    if hasattr(shard, "iter_batches"):
+        return shard
+    return DataIterator(shard)
 
 
 # session registry keyed by executing THREAD: thread-mode actors share
@@ -216,6 +232,16 @@ class _TrainWorker:
             fn(config)
         finally:
             _sessions.pop(threading.get_ident(), None)
+            # release streaming shards: a worker whose fn returned
+            # mid-epoch must leave the splitter's epoch barrier, or
+            # siblings still iterating would wait on it forever
+            for shard in session.dataset_shards.values():
+                close = getattr(shard, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
         with session.lock:
             return list(session.reports)
 
@@ -259,17 +285,17 @@ class Trainer:
         max_failures = self._run.failure_config.max_failures
         failures = 0
         restore: Optional[str] = None
-        # dataset ingest: materialize ONCE, outside the retry loop — a
-        # failure-restart must not re-run the whole Data pipeline (and a
-        # non-deterministic one, e.g. random_shuffle, must not hand the
-        # restarted attempt different data than the checkpointed one).
-        # The refs survive restarts; lineage recovers lost blocks.
-        dataset_refs = {name: ds.materialize().block_refs
-                        for name, ds in self._datasets.items()}
+        # dataset ingest is STREAMING by default: nothing executes here
+        # — each attempt opens a streaming_split whose blocks reach the
+        # workers as upstream tasks finish, overlapping ingest with the
+        # train loop. Runtimes that must pickle actor args (process
+        # workers, client mode, multi-node) fall back to materializing
+        # once, lazily, cached across restarts (_fallback_refs) so a
+        # non-deterministic pipeline hands every attempt the same data.
+        self._fallback_refs: Optional[Dict[str, list]] = None
         while True:
             try:
-                return self._run_attempt(restore, dataset_refs,
-                                         self._elastic_target())
+                return self._run_attempt(restore, self._elastic_target())
             except _GroupFailure as gf:
                 failures += 1
                 if max_failures != -1 and failures > max_failures:
@@ -314,17 +340,51 @@ class Trainer:
         return max(sc.min_workers,
                    min(sc.num_workers, int(avail // per)))
 
+    @staticmethod
+    def _streaming_ingest_ok() -> bool:
+        """Streaming shards are driver-side objects (threading
+        primitives + executor handle): they cross into train workers
+        only where actor args pass by REFERENCE — thread workers on a
+        single-node, non-client runtime. Everything else (process
+        workers, client mode, multi-node) pickles args and takes the
+        materialized fallback."""
+        from ray_tpu._private import worker as wm
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        w = wm.global_worker
+        if w is None or getattr(w, "is_client", False):
+            return False
+        if GLOBAL_CONFIG.worker_mode != "thread":
+            return False
+        try:
+            return len(w.gcs.node_table()) <= 1
+        except Exception:
+            return False
+
     def _run_attempt(self, restore: Optional[str],
-                     dataset_refs: Dict[str, list],
                      n: Optional[int] = None) -> Result:
         n = n if n is not None else self._scaling.num_workers
-        # round-robin each dataset's block refs across ranks (reference:
-        # Train+Data ingest via get_dataset_shard)
-        shards_by_rank: List[Dict[str, list]] = [dict() for _ in
-                                                 range(n)]
-        for name, refs in dataset_refs.items():
-            for rank in range(n):
-                shards_by_rank[rank][name] = refs[rank::n]
+        # ingest: streaming split per dataset when the runtime supports
+        # it (equal=True keeps the rank->block assignment round-robin,
+        # matching the materialized fallback's refs[rank::n]); else
+        # materialize once, cached across attempts
+        shards_by_rank: List[Dict[str, Any]] = [dict() for _ in
+                                                range(n)]
+        coordinators: List[Any] = []
+        if self._datasets and self._streaming_ingest_ok():
+            for name, ds in self._datasets.items():
+                shards = ds.streaming_split(n, equal=True)
+                coordinators.append(shards[0].coordinator)
+                for rank in range(n):
+                    shards_by_rank[rank][name] = shards[rank]
+        else:
+            if self._fallback_refs is None:
+                self._fallback_refs = {
+                    name: ds.materialize().block_refs
+                    for name, ds in self._datasets.items()}
+            for name, refs in self._fallback_refs.items():
+                for rank in range(n):
+                    shards_by_rank[rank][name] = refs[rank::n]
         workers = [
             _TrainWorker.options(
                 max_concurrency=2,
@@ -413,6 +473,13 @@ class Trainer:
             for w in workers:
                 try:
                     ray_tpu.kill(w)
+                except Exception:
+                    pass
+            # a restart gets a FRESH split (the plan replays); the old
+            # one must stop producing and snapshot its stats
+            for coord in coordinators:
+                try:
+                    coord.shutdown()
                 except Exception:
                     pass
 

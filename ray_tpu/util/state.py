@@ -83,6 +83,17 @@ def list_placement_groups() -> List[Dict[str, Any]]:
 
 
 @_client_dispatch
+def list_data_streams() -> List[Dict[str, Any]]:
+    """Streaming-split ingest stats: one row per live
+    Dataset.streaming_split coordinator plus the last few shut-down
+    ones (per-consumer blocks/bytes consumed, wait time, and the
+    producer/consumer overlap fraction)."""
+    from ray_tpu.data._streaming import split_coordinator_stats
+
+    return split_coordinator_stats()
+
+
+@_client_dispatch
 def summarize_tasks() -> Dict[str, int]:
     """Counts by state (reference: ray summary tasks)."""
     out: Dict[str, int] = {}

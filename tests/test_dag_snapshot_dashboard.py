@@ -276,3 +276,24 @@ class TestDashboardUI:
         # the JS consumes keys the API actually serves
         assert "sched.finished" in html
         assert "waiting_deps" in html
+
+    def test_every_cell_escapes_and_badges_are_css(self, rt):
+        """The _html raw-markup column mechanism is gone: every table
+        cell goes through esc(); state dots are CSS classes keyed on a
+        validated token, so cluster data can never become markup."""
+        import urllib.request
+
+        from ray_tpu.dashboard import start_dashboard
+
+        port = start_dashboard(0)
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        assert "_html" not in html
+        assert "st-${cls}" in html          # CSS-class badge path
+        assert 'td[class^="st-"]::before' in html
+        # the streams panel + endpoint are wired
+        assert 'id="streams"' in html
+        streams = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/data_streams",
+            timeout=10).read())
+        assert isinstance(streams, list)

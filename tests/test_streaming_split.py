@@ -1,0 +1,158 @@
+"""Dataset.streaming_split: N concurrent shard iterators over ONE
+streaming execution (reference: ray.data Dataset.streaming_split /
+OutputSplitter). Covers: disjoint-cover with measured producer/consumer
+overlap, deterministic equal routing, epoch replay, dead-consumer
+drain-back, and per-consumer backpressure bounds."""
+
+import threading
+import time
+
+import pytest
+
+
+def _slow_ds(rt, n_rows=200, parallelism=20, sleep_s=0.01):
+    from ray_tpu import data
+
+    def slow(b, _s=sleep_s):
+        time.sleep(_s)
+        return [x * 2 for x in b]
+
+    return data.range(n_rows, parallelism=parallelism).map_batches(slow)
+
+
+def _drain_concurrently(shards, collect=None):
+    rows = [[] for _ in shards]
+    errs = []
+
+    def drain(i):
+        try:
+            for r in shards[i].iter_rows():
+                rows[i].append(r)
+        except BaseException as e:  # surfaced to the test, not swallowed
+            errs.append(e)
+
+    threads = [threading.Thread(target=drain, args=(i,))
+               for i in range(len(shards))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    return rows
+
+
+class TestStreamingSplit:
+    def test_two_consumers_disjoint_cover_with_overlap(
+            self, ray_start_tensor_sched):
+        """THE tentpole claim: two consumers drain disjoint shards while
+        upstream map tasks are still producing — proven by the op-stats
+        overlap fraction, not by timing luck."""
+        rt = ray_start_tensor_sched
+        ds = _slow_ds(rt)
+        shards = ds.streaming_split(2)
+        rows = _drain_concurrently(shards)
+        # disjoint cover: every row exactly once, split across both
+        assert sorted(rows[0] + rows[1]) == [x * 2 for x in range(200)]
+        assert rows[0] and rows[1]
+        st = shards[0].stats()
+        assert st["blocks_produced"] == 20
+        assert st["blocks_consumed"] == 20
+        # blocks were popped WHILE the producer still ran: with 20
+        # blocks x 10ms through 4 workers the consumers provably
+        # overlap production (0 would mean drain-after-the-fact)
+        assert st["overlap_fraction"] > 0
+        per = st["per_consumer"]
+        assert sum(c["blocks_consumed"] for c in per) == 20
+        assert all(c["bytes_consumed"] >= 0 for c in per)
+
+    def test_equal_split_is_deterministic_round_robin(
+            self, ray_start_tensor_sched):
+        """equal=True routes block i to consumer i % n — the contract
+        Train's rank sharding relies on (matches refs[rank::n])."""
+        rt = ray_start_tensor_sched
+        ds = _slow_ds(rt, n_rows=100, parallelism=10, sleep_s=0.002)
+        shards = ds.streaming_split(2, equal=True)
+        rows = _drain_concurrently(shards)
+        # range(100) in 10 blocks of 10: consumer 0 gets even blocks
+        expect0 = [x * 2 for b in range(0, 10, 2)
+                   for x in range(b * 10, b * 10 + 10)]
+        assert sorted(rows[0]) == expect0
+        assert len(rows[1]) == 50
+
+    def test_epoch_restart_replays_plan(self, ray_start_tensor_sched):
+        """Re-iterating exhausted shards replays the lazy plan through
+        a FRESH executor — same rows again, epoch counter advances."""
+        rt = ray_start_tensor_sched
+        ds = _slow_ds(rt, n_rows=60, parallelism=6, sleep_s=0.002)
+        shards = ds.streaming_split(2, equal=True)
+        first = _drain_concurrently(shards)
+        second = _drain_concurrently(shards)
+        want = [x * 2 for x in range(60)]
+        assert sorted(first[0] + first[1]) == want
+        assert sorted(second[0] + second[1]) == want
+        assert shards[0].stats()["epoch"] == 2
+
+    def test_dead_consumer_drains_back(self, ray_start_tensor_sched):
+        """Elastic-train composition: a closed consumer's queue (and
+        its future round-robin share) flows to the survivors instead of
+        poisoning the run."""
+        rt = ray_start_tensor_sched
+        ds = _slow_ds(rt, n_rows=100, parallelism=10, sleep_s=0.002)
+        shards = ds.streaming_split(2, equal=True)
+        shards[1].close()
+        got = sorted(shards[0].iter_rows())
+        assert got == [x * 2 for x in range(100)]
+        st = shards[0].stats()
+        assert st["per_consumer"][1]["alive"] is False
+        with pytest.raises(RuntimeError):
+            next(iter(shards[1].iter_rows()))
+
+    def test_per_consumer_backpressure_bounds_production(
+            self, ray_start_tensor_sched):
+        """A consumer that never pops caps production at its queue
+        budget — the splitter must not buffer the whole dataset."""
+        rt = ray_start_tensor_sched
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        q = GLOBAL_CONFIG.data_split_queue_blocks
+        ds = _slow_ds(rt, n_rows=400, parallelism=40, sleep_s=0.001)
+        shards = ds.streaming_split(2)
+        coord = shards[0].coordinator
+        # kick the producer without consuming: ask for one block only
+        first = coord._pop(0)
+        assert first is not None
+        deadline = time.monotonic() + 5
+        while (coord.stats()["producing"]
+               and coord.stats()["blocks_produced"] < 2 * q + 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        st = coord.stats()
+        # both lanes full + the one we popped; anything near 40 means
+        # backpressure did nothing
+        assert st["blocks_produced"] <= 2 * q + 2, st
+        coord.shutdown()
+        assert coord.stats()["live"] is False
+
+    def test_streaming_split_validates_args(self, ray_start_tensor_sched):
+        rt = ray_start_tensor_sched
+        ds = _slow_ds(rt, n_rows=10, parallelism=2)
+        with pytest.raises(ValueError):
+            ds.streaming_split(0)
+        with pytest.raises(ValueError):
+            ds.streaming_split(2, locality_hints=["a"])
+
+    def test_state_verb_and_recent_registry(self, ray_start_tensor_sched):
+        """util.state.list_data_streams surfaces live splits and keeps
+        shut-down ones readable (the dashboard's data source)."""
+        rt = ray_start_tensor_sched
+        from ray_tpu.util import state
+
+        ds = _slow_ds(rt, n_rows=40, parallelism=4, sleep_s=0.002)
+        shards = ds.streaming_split(2)
+        _drain_concurrently(shards)
+        live = state.list_data_streams()
+        assert any(s["live"] and s["consumers"] == 2 for s in live)
+        shards[0].coordinator.shutdown()
+        done = state.list_data_streams()
+        assert any(not s["live"] and s["blocks_consumed"] == 4
+                   for s in done)
