@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
+from ray_tpu import chaos  # noqa: F401
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._private import worker as _worker
 from ray_tpu._private.config import GLOBAL_CONFIG as _config  # noqa: F401
@@ -36,7 +37,7 @@ __all__ = [
     "cancel", "kill", "get_actor", "ObjectRef", "ActorHandle", "method",
     "available_resources", "cluster_resources", "nodes", "timeline",
     "snapshot_cluster", "restore_cluster",
-    "get_runtime_context", "__version__",
+    "get_runtime_context", "chaos", "__version__",
 ]
 
 

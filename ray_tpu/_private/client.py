@@ -56,6 +56,7 @@ class ClientSession:
 _STATE_VERBS = frozenset({
     "list_tasks", "list_actors", "list_objects", "list_nodes",
     "list_placement_groups", "summarize_tasks", "list_data_streams",
+    "list_faults",
 })
 
 

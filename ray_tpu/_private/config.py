@@ -212,6 +212,21 @@ _d("data_split_queue_bytes", int, 64 * 1024 * 1024,
    "back to the block-count budget)")
 _d("health_check_period_s", float, 1.0, "control-plane health check period")
 _d("health_check_timeout_s", float, 5.0, "mark node dead after this")
+_d("node_heartbeat_timeout_s", float, 5.0,
+   "mark a node dead after this many seconds without a heartbeat, even "
+   "if its daemon connection stays up (a hung-but-connected node must "
+   "not stall the cluster); heartbeats are recorded only when the "
+   "node's liveness probe actually succeeds")
+_d("task_retry_delay_s", float, 0.05,
+   "base delay before the first task retry; doubles per attempt "
+   "(exponential backoff) so a flapping node is not hammered with "
+   "immediate resubmissions. 0 = retry immediately (pre-backoff "
+   "behavior)")
+_d("task_retry_max_delay_s", float, 2.0,
+   "exponential retry backoff is capped at this delay")
+_d("task_retry_jitter", bool, True,
+   "multiply each retry delay by a seeded jitter factor in [0.5, 1.0) "
+   "to decorrelate retry storms")
 
 # -- logging / observability ----------------------------------------------
 _d("log_dir", str, "", "session log dir; empty = /tmp/ray_tpu/session_*/logs")

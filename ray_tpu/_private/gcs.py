@@ -508,6 +508,10 @@ class GcsService:
         self._health_thread.start()
 
     def _health_loop(self, interval: float) -> None:
+        from ray_tpu._private.chaos import get_controller
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        chaos = get_controller()
         # consecutive-miss grace (reference: GcsHealthCheckManager's
         # failure_threshold): one missed probe must not kill a node
         # whose daemon is merely busy (e.g. serving a large fetch)
@@ -518,6 +522,23 @@ class GcsService:
             for e in self.alive_process_nodes():
                 pool = e.pool
                 if pool is None:
+                    continue
+                # staleness guard: probes answered over a live connection
+                # don't prove the node is making progress — a node whose
+                # heartbeat has not been RECORDED within the timeout is
+                # dead even if its TCP link never dropped
+                timeout_s = GLOBAL_CONFIG.node_heartbeat_timeout_s
+                age = time.monotonic() - e.last_heartbeat
+                if timeout_s and age > timeout_s:
+                    logger.warning("health check: node %s heartbeat is "
+                                   "%.1fs stale (timeout %.1fs); marking "
+                                   "DEAD", e.node_id.hex()[:16], age,
+                                   timeout_s)
+                    self._worker.on_node_failure(
+                        e.node_id,
+                        reason=f"no heartbeat for {age:.1f}s "
+                        f"(node_heartbeat_timeout_s={timeout_s})")
+                    misses.pop(e.node_id, None)
                     continue
                 procs = pool.live_process_count()
                 if procs == 0:
@@ -534,7 +555,10 @@ class GcsService:
                     misses.pop(e.node_id, None)
                 else:
                     misses.pop(e.node_id, None)
-                    self.heartbeat(e.node_id)
+                    if chaos.poll("heartbeat", node=e.index) is None:
+                        self.heartbeat(e.node_id)
+                    # a dropped heartbeat is "recovered" when the
+                    # staleness guard above later declares the node dead
 
     def shutdown(self) -> None:
         self._shutdown = True

@@ -198,6 +198,22 @@ def _render_core(worker) -> List[str]:
     emit("ray_tpu_nodes_alive", "gauge", "alive cluster nodes",
          sum(1 for e in worker.gcs.node_table()
              if e.state == "ALIVE"))
+
+    from ray_tpu._private.chaos import get_controller
+    chaos = get_controller().counters()
+    for name, desc, per_site, total in (
+            ("ray_tpu_chaos_injected_total",
+             "faults injected by the chaos controller",
+             chaos["injected"], chaos["injected_total"]),
+            ("ray_tpu_chaos_recovered_total",
+             "injected faults the runtime detected and recovered from",
+             chaos["recovered"], chaos["recovered_total"])):
+        lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} counter")
+        for site in sorted(per_site):
+            lines.append(f'{name}{{site="{_escape_label(site)}"}} '
+                         f'{per_site[site]}')
+        lines.append(f"{name} {total}")
     return lines
 
 

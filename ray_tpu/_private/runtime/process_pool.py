@@ -112,7 +112,8 @@ class _InFlight:
 class _Handle:
     __slots__ = ("worker_num", "proc", "conn", "ctrl", "worker_id", "pid",
                  "inflight", "borrows",
-                 "sent_fns", "dead", "force_cancel_id", "send_lock",
+                 "sent_fns", "dead", "force_cancel_id", "timeout_cancel_id",
+                 "chaos_kill", "send_lock",
                  "ready", "actor_rt", "oom_kill")
 
     def __init__(self, worker_num: int):
@@ -131,6 +132,10 @@ class _Handle:
         self.sent_fns: Set[bytes] = set()
         self.dead = False
         self.force_cancel_id: Optional[TaskID] = None
+        # deadline enforcement killed this worker for this task: the
+        # target fails with TaskTimeoutError (retriable), not cancelled
+        self.timeout_cancel_id: Optional[TaskID] = None
+        self.chaos_kill = False       # chaos plane SIGKILLed this worker
         self.send_lock = threading.Lock()
         self.ready = False
 
@@ -155,7 +160,12 @@ class ProcessWorkerPool:
         self._shutdown = False
         self._worker_seq = 0
         self._inline_max = GLOBAL_CONFIG.inline_object_max_bytes
-        self._inject_prob = GLOBAL_CONFIG.testing_inject_task_failure_prob
+        # fault injection routes through the seeded controller, polled
+        # PER TASK at payload build (the former per-pool snapshot of
+        # testing_inject_task_failure_prob went stale immediately: a
+        # probability set after pool construction was never observed)
+        from ray_tpu._private.chaos import get_controller
+        self._chaos = get_controller()
         # lease pipelining (reference: NormalTaskSubmitter
         # max_tasks_in_flight_per_worker + ReportWorkerBacklog): several
         # tasks ride one worker pipe so a wakeup executes a batch. Depth
@@ -463,6 +473,9 @@ class ProcessWorkerPool:
             self._worker.events.record(spec.task_id, spec.name, "started",
                                        self.node_index)
             out.append(payload)
+        for pending, _payload in items:
+            if self._chaos_assign(h, pending.spec):
+                return  # killed or dropped: inflight recovers retriably
         try:
             with h.send_lock:
                 # fn-blob strip under the send lock (see _assign)
@@ -518,8 +531,13 @@ class ProcessWorkerPool:
             args_blob=args_blob,
             num_returns=spec.num_returns,
             return_ids=[o.binary() for o in return_ids],
-            inject_prob=self._inject_prob,
         )
+        fault = self._chaos.poll("task", node=self.node_index,
+                                 task=spec.name)
+        if fault is not None:
+            payload["inject_fault"] = fault["kind"]
+            if fault["kind"] == "hang":
+                payload["inject_hang_s"] = fault.get("hang_s", 0.2)
         if spec.placement_group_id is not None \
                 and spec.placement_group_capture_child_tasks:
             # capture context crosses the process boundary so nested
@@ -563,6 +581,27 @@ class ProcessWorkerPool:
             return self._worker._entry_value(oid, entry)
         return entry.value
 
+    def _chaos_assign(self, h: _Handle, spec: TaskSpec) -> bool:
+        """Chaos sites on the lease path: ``worker`` (SIGKILL the
+        assigned worker; everything inflight on it fails retriably) and
+        ``link`` (delay or drop the dispatch message). True = the
+        message must not be sent."""
+        fault = self._chaos.poll("worker", node=self.node_index,
+                                 task=spec.name)
+        if fault is not None:
+            h.chaos_kill = True
+            self._kill_handle(h)
+            return True
+        fault = self._chaos.poll("link", node=self.node_index,
+                                 task=spec.name)
+        if fault is not None:
+            if fault["kind"] == "drop":
+                # message lost on the wire: the lease hangs until a
+                # deadline or node-death path recovers it
+                return True
+            time.sleep(fault.get("delay_s", 0.05))
+        return False
+
     def _assign(self, h: _Handle, pending: PendingTask, payload: dict) -> None:
         spec = pending.spec
         contained = payload.pop("_contained")
@@ -578,6 +617,8 @@ class ProcessWorkerPool:
             self._by_task[spec.task_id] = h
         self._worker.events.record(spec.task_id, spec.name, "started",
                                    self.node_index)
+        if self._chaos_assign(h, spec):
+            return
         try:
             # fn-blob strip decided under the SEND lock: sends to one
             # handle serialize here, so check-then-strip cannot race a
@@ -819,7 +860,7 @@ class ProcessWorkerPool:
         self._worker.scheduler.notify_task_finished(
             exec_task_id, pending.node_index, spec.resources)
         if retry is not None:
-            self._worker.scheduler.submit(retry)
+            self._worker._submit_retry(retry)
 
     def _on_worker_failure(self, h: _Handle, cause) -> None:
         with self._lock:
@@ -851,6 +892,11 @@ class ProcessWorkerPool:
                 spec = inf.pending.spec
                 if h.force_cancel_id == exec_id:
                     exc: BaseException = rex.TaskCancelledError(exec_id)
+                elif h.timeout_cancel_id == exec_id:
+                    exc = rex.TaskTimeoutError(
+                        f"task {spec.name} exceeded its {spec.timeout_s}s "
+                        f"deadline (worker {h.pid} killed)",
+                        task_id=exec_id, timeout_s=spec.timeout_s)
                 elif h.oom_kill:
                     exc = rex.OutOfMemoryError(
                         f"worker killed by the memory monitor while "
@@ -858,6 +904,10 @@ class ProcessWorkerPool:
                 elif self._node_dead:
                     exc = rex.NodeDiedError(
                         f"node died while running {spec.name}")
+                elif h.chaos_kill:
+                    exc = rex.WorkerCrashedError(
+                        f"worker process {h.pid} killed while running "
+                        f"{spec.name} (chaos worker kill)")
                 else:
                     exc = rex.WorkerCrashedError(
                         f"worker process {h.pid} died while running "
@@ -1058,6 +1108,38 @@ class ProcessWorkerPool:
                 h.ctrl.send(("cancel", task_id.binary()))
             except (OSError, ValueError):
                 pass
+        return True
+
+    def cancel_for_timeout(self, task_id: TaskID) -> bool:
+        """Deadline enforcement: fail the attempt with a retriable
+        TaskTimeoutError — cancel()'s force path with a different
+        classification (the timeout counts against max_retries instead
+        of resolving the refs as cancelled)."""
+        with self._lock:
+            for item in self._queue:
+                if item[0].spec.task_id == task_id:
+                    self._queue.remove(item)
+                    queued = item[0]
+                    break
+            else:
+                queued = None
+        if queued is not None:
+            spec = queued.spec
+            return_ids = (getattr(spec, "_retry_return_ids", None)
+                          or spec.return_ids())
+            err = rex.TaskTimeoutError(
+                f"task {spec.name} timed out after {spec.timeout_s}s "
+                f"queued on node {self.node_index}",
+                task_id=task_id, timeout_s=spec.timeout_s)
+            retry = self._worker._handle_task_failure(spec, return_ids, err)
+            self._finish_task(queued, task_id, retry)
+            return True
+        with self._lock:
+            h = self._by_task.get(task_id)
+        if h is None:
+            return False
+        h.timeout_cancel_id = task_id
+        self._kill_handle(h)
         return True
 
     # ------------------------------------------------------------------

@@ -338,7 +338,13 @@ class RemoteNodePool(ProcessWorkerPool):
         if not ev.wait(timeout) or not slot or not slot[0]:
             self._fetches.pop(fid, None)
             return None
-        return slot[1]
+        data = slot[1]
+        fault = self._chaos.poll("transfer", node=self.node_index,
+                                 object=oid.hex()[:16])
+        if fault is not None and data:
+            keep = max(1, int(len(data) * fault.get("keep_fraction", 0.5)))
+            data = data[:keep]
+        return data
 
     def free_remote(self, oids: List[ObjectID]) -> None:
         self._send_daemon(("free", [o.binary() for o in oids]))

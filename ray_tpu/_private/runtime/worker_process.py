@@ -24,6 +24,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -356,6 +357,18 @@ class _WorkerRunner:
                 self.fn_cache[fn_id] = fn
             return fn(*args, **kwargs)
 
+        # cache the fn on ARRIVAL, not first successful run: the owner's
+        # sent_fns dedupe marks the blob delivered at send time, so a
+        # task that dies before run() (chaos injection, cancel) would
+        # otherwise leave this worker receiving fn_blob=None payloads
+        # for a fn it never cached
+        try:
+            if payload.get("fn_blob") is not None \
+                    and payload["fn_id"] not in self.fn_cache:
+                self.fn_cache[payload["fn_id"]] = \
+                    cloudpickle.loads(payload["fn_blob"])
+        except Exception:
+            pass  # run() retries the load and reports the real error
         self._run_payload(payload, run)
 
     def actor_create(self, payload: dict) -> None:
@@ -450,11 +463,13 @@ class _WorkerRunner:
             args, kwargs = cloudpickle.loads(payload["args_blob"])
             args = tuple(self._resolve(a) for a in args)
             kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
-            inject = payload.get("inject_prob", 0.0)
-            if inject > 0.0:
-                import random
-                if random.random() < inject:
-                    raise rex.WorkerCrashedError("injected failure (chaos)")
+            # the owner's seeded FaultController decided per task at
+            # payload build; the worker only enacts the chosen kind
+            inject = payload.get("inject_fault")
+            if inject == "hang":
+                time.sleep(payload.get("inject_hang_s", 0.2))
+            elif inject is not None:
+                raise rex.WorkerCrashedError("injected failure (chaos)")
             if task_id.binary() in self.cancelled:
                 raise rex.TaskCancelledError(task_id)
             result = run(args, kwargs)

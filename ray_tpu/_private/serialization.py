@@ -66,6 +66,28 @@ class SerializedObject:
         return off
 
     @classmethod
+    def frame_complete(cls, blob: memoryview | bytes) -> bool:
+        """Whether `blob` holds a whole to_bytes() frame. Wire fetches
+        must check this before from_bytes: memoryview slicing past the
+        end silently yields SHORT buffers, so a truncated transfer
+        would otherwise deserialize into corrupt data instead of being
+        retried as a lost object."""
+        view = memoryview(blob)
+        total = len(view)
+        if total < 8:
+            return False
+        off = 8 + int.from_bytes(view[:8], "little")
+        if off + 4 > total:
+            return False
+        nbuf = int.from_bytes(view[off:off + 4], "little")
+        off += 4
+        for _ in range(nbuf):
+            if off + 8 > total:
+                return False
+            off += 8 + int.from_bytes(view[off:off + 8], "little")
+        return off <= total
+
+    @classmethod
     def from_bytes(cls, blob: memoryview | bytes) -> "SerializedObject":
         view = memoryview(blob)
         meta_len = int.from_bytes(view[:8], "little")

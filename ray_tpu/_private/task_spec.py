@@ -81,6 +81,10 @@ class TaskSpec:
     serialized_func: Optional[bytes] = None  # for process workers
     func_id: Optional[bytes] = None  # sha1 of serialized_func (cached)
     attempt_number: int = 0
+    # per-attempt wall-clock deadline (submission to completion); on
+    # expiry the attempt is cancelled and retried as TaskTimeoutError,
+    # counting against max_retries. None = no deadline.
+    timeout_s: Optional[float] = None
     generator: bool = False  # streaming generator task
     class_key: Optional[Tuple] = None  # precomputed scheduling_class()
     # (task_id, ids) memo: return_ids() runs on both the submit and the

@@ -15,6 +15,7 @@ processes + the shm object store, keeping this module's semantics.
 from __future__ import annotations
 
 import collections
+import heapq
 import logging
 import os
 import threading
@@ -23,6 +24,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private.chaos import get_controller as _chaos_controller
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
                                   WorkerID, _Counter)
@@ -182,7 +184,7 @@ class TaskManager:
         if spec.attempt_number >= spec.max_retries:
             return False
         if isinstance(exc, (rex.WorkerCrashedError, rex.OutOfMemoryError,
-                            rex.NodeDiedError)):
+                            rex.NodeDiedError, rex.TaskTimeoutError)):
             return True  # system failures always retriable up to max_retries
         retry_exc = spec.retry_exceptions
         if retry_exc is True:
@@ -462,11 +464,27 @@ class Worker:
         self.dead_actors: set = set()
         self._actors_lock = threading.Lock()
 
-        self._running_tasks: Dict[TaskID, bool] = {}  # id -> cancelled?
+        # id -> False (running) | True (cancelled) | "timeout" (the
+        # deadline watcher failed this attempt; its results are zombie)
+        self._running_tasks: Dict[TaskID, Any] = {}
         # cancelled while window-leased but not yet executing (queued in
         # the executor): flagged here, honored at execution start
         self._precancelled: set = set()
+        # deadline expired while executor-queued: timed out at exec start
+        self._pretimeout: set = set()
         self._running_lock = threading.Lock()
+
+        # chaos plane: every injection decision flows through the
+        # process-wide seeded controller (see _private/chaos.py)
+        self._chaos = _chaos_controller()
+        self._tick_delay_entry = GLOBAL_CONFIG.entry("testing_tick_delay_s")
+        # per-task deadlines (spec.timeout_s): a lazily-started watcher
+        # cancels attempts past their deadline; each expiry counts
+        # against max_retries and surfaces TaskTimeoutError
+        self._deadline_cv = threading.Condition()
+        self._deadline_heap: List[tuple] = []
+        self._deadline_seq = _Counter()
+        self._deadline_thread: Optional[threading.Thread] = None
 
         # deferred unref queue: ObjectRef.__del__ may fire during GC while
         # runtime locks are held, so deletions drain on a dedicated thread
@@ -676,6 +694,16 @@ class Worker:
             return None
         data = pool.fetch_object(object_id)
         if data is not None:
+            from ray_tpu._private.serialization import SerializedObject
+            if not SerializedObject.frame_complete(data):
+                # partial transfer (chaos truncation or a dying daemon):
+                # treat as lost so lineage recovery rebuilds the object
+                # instead of deserializing short buffers into garbage
+                logger.warning("truncated transfer of %s from node %d "
+                               "(%d bytes); treating as lost",
+                               object_id.hex()[:16], node_index, len(data))
+                self._chaos.note_recovery("transfer")
+                return None
             self.transfer_stats["head_relayed_bytes"] += len(data)
             self.transfer_stats["head_relayed_objects"] += 1
         return data
@@ -827,6 +855,8 @@ class Worker:
             self.reference_counter.add_submitted_task_references(deps)
         self.task_manager.add_pending(spec, deps)
         self.events.record(spec.task_id, spec.name, "submitted")
+        if spec.timeout_s:
+            self._register_deadline(spec)
 
         # drop deps already available locally; a missing dep with no
         # pending producer was LOST and must reconstruct or the task
@@ -877,6 +907,8 @@ class Worker:
                 self.object_recovery.maybe_recover(d)
             pendings.append(PendingTask(spec=spec, deps=unresolved,
                                         execute=_noop_exec))
+            if spec.timeout_s:
+                self._register_deadline(spec)
             refs = []
             for oid in spec.return_ids():
                 ref = ObjectRef(oid, self.worker_id, _register=False)
@@ -936,7 +968,19 @@ class Worker:
             return self._node_pools.get(ns.parent)
         return None
 
+    def _chaos_tick(self) -> None:
+        """Dispatch-path injection point: the testing_tick_delay_s knob
+        (re-read live) plus the chaos ``sched_tick`` site, both
+        simulating a slow scheduling node."""
+        d = self._tick_delay_entry.value
+        if d > 0.0:
+            time.sleep(d)
+        fault = self._chaos.poll("sched_tick")
+        if fault is not None:
+            time.sleep(fault.get("delay_s", 0.05))
+
     def _dispatch(self, pending: PendingTask) -> None:
+        self._chaos_tick()
         self.events.record(pending.spec.task_id, pending.spec.name,
                            "dispatched", pending.node_index)
         boot = getattr(pending.spec, "_actor_boot", None)
@@ -959,6 +1003,7 @@ class Worker:
         pools batch into per-pool lease grants (one executor hop and
         one pipe message per worker per tick, instead of per task);
         everything else takes the per-task path."""
+        self._chaos_tick()
         groups: Dict[Any, List[PendingTask]] = {}
         local: List[tuple] = []
         fast: List[PendingTask] = []
@@ -1037,12 +1082,17 @@ class Worker:
                     break
                 spec = pending.spec
                 exec_id = spec.task_id
+                pre_timed_out = False
                 with rlock:
                     running[exec_id] = False
                     if self._precancelled \
                             and exec_id in self._precancelled:
                         self._precancelled.discard(exec_id)
                         running[exec_id] = True
+                    elif self._pretimeout \
+                            and exec_id in self._pretimeout:
+                        self._pretimeout.discard(exec_id)
+                        pre_timed_out = True
                 ctx.task_id = exec_id
                 ctx.put_counter = 0
                 record(exec_id, spec.name, "started", pending.node_index)
@@ -1051,21 +1101,50 @@ class Worker:
                 retry_task = None
                 ready = ()
                 try:
-                    if running.get(exec_id):
+                    if pre_timed_out:
+                        # deadline expired while executor-queued: fail
+                        # the attempt (retriably) without running it
+                        if self._claim_task_completion(exec_id) != "timeout":
+                            retry_task = self._handle_task_failure(
+                                spec, rids, rex.TaskTimeoutError(
+                                    f"task {spec.name} timed out after "
+                                    f"{spec.timeout_s}s before starting",
+                                    task_id=exec_id,
+                                    timeout_s=spec.timeout_s))
+                    elif running.get(exec_id) == "timeout":
+                        pass  # watcher already failed/retried it
+                    elif running.get(exec_id):
                         self._store_error(
                             spec, rids, rex.TaskCancelledError(exec_id))
                     else:
-                        if self._inject_entry is not None:
-                            self._maybe_inject_failure()
                         try:
+                            self._maybe_inject_failure()
                             result = spec.func(*spec.args)
                         except BaseException as e:  # noqa: BLE001
-                            retry_task = self._handle_task_failure(
-                                spec, rids, e)
+                            flag = self._claim_task_completion(exec_id)
+                            if flag == "timeout":
+                                pass  # watcher already failed/retried it
+                            elif flag:
+                                # cancelled mid-run: never retry
+                                self._store_error(
+                                    spec, rids,
+                                    rex.TaskCancelledError(exec_id))
+                            else:
+                                retry_task = self._handle_task_failure(
+                                    spec, rids, e)
                         else:
-                            put(rids[0], result)
-                            ready = (rids[0],)
-                            done.append((exec_id, rids[0]))
+                            flag = self._claim_task_completion(exec_id)
+                            if flag == "timeout":
+                                pass  # retry owns the return ids now
+                            elif flag:
+                                # cancel landed mid-run: drop the result
+                                self._store_error(
+                                    spec, rids,
+                                    rex.TaskCancelledError(exec_id))
+                            else:
+                                put(rids[0], result)
+                                ready = (rids[0],)
+                                done.append((exec_id, rids[0]))
                 finally:
                     with rlock:
                         running.pop(exec_id, None)
@@ -1080,7 +1159,7 @@ class Worker:
                         if done:
                             complete(done, has_ref)
                             done = []
-                        self.scheduler.submit(retry_task)
+                        self._submit_retry(retry_task)
                 if len(done) >= 256:
                     complete(done, has_ref)
                     done = []
@@ -1416,6 +1495,7 @@ class Worker:
         # spec.task_id, and the scheduler must be notified for THIS id
         # (and only after the retry has a fresh id) or its slot leaks
         exec_task_id = spec.task_id
+        pre_timed_out = False
         with self._running_lock:
             # value is the cancellation flag: False = running, flipped
             # to True by cancel_task (an Event per task cost ~2us each)
@@ -1423,6 +1503,9 @@ class Worker:
             if self._precancelled and exec_task_id in self._precancelled:
                 self._precancelled.discard(exec_task_id)
                 self._running_tasks[exec_task_id] = True
+            elif self._pretimeout and exec_task_id in self._pretimeout:
+                self._pretimeout.discard(exec_task_id)
+                pre_timed_out = True
 
         prev_task = self._context.task_id
         prev_put = self._context.put_counter
@@ -1448,6 +1531,17 @@ class Worker:
             env_vars_push(env_vars)
         env_ctx = None
         try:
+            if pre_timed_out:
+                # deadline expired while executor-queued: fail the
+                # attempt (retriably) without running it
+                if self._claim_task_completion(exec_task_id) != "timeout":
+                    retry_task = self._handle_task_failure(
+                        spec, return_ids, rex.TaskTimeoutError(
+                            f"task {spec.name} timed out after "
+                            f"{spec.timeout_s}s before starting",
+                            task_id=exec_task_id,
+                            timeout_s=spec.timeout_s))
+                return
             try:
                 # INSIDE the try: an env build failure (bad pip spec,
                 # missing package) must fail the TASK — store the error
@@ -1471,15 +1565,28 @@ class Worker:
             if dep_error is not None:
                 self._store_error(spec, return_ids, dep_error)
                 return
-            if self._running_tasks.get(exec_task_id):
+            flag = self._running_tasks.get(exec_task_id)
+            if flag == "timeout":
+                return  # watcher already failed/retried this attempt
+            if flag:
                 self._store_error(spec, return_ids,
                                   rex.TaskCancelledError(exec_task_id))
                 return
-            self._maybe_inject_failure()
             try:
+                self._maybe_inject_failure()
                 result = spec.func(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001
-                retry_task = self._handle_task_failure(spec, return_ids, e)
+                flag = self._claim_task_completion(exec_task_id)
+                if flag == "timeout":
+                    return  # watcher already failed/retried the attempt
+                if flag:
+                    # cancelled mid-run: the failure is moot, and a
+                    # cancelled task must never retry
+                    self._store_error(spec, return_ids,
+                                      rex.TaskCancelledError(exec_task_id))
+                    return
+                retry_task = self._handle_task_failure(spec,
+                                                       return_ids, e)
                 return
             finally:
                 # tear the env down BEFORE results publish: a caller
@@ -1488,6 +1595,18 @@ class Worker:
                 if env_ctx is not None:
                     env_ctx.__exit__(None, None, None)
                     env_ctx = None
+            flag = self._claim_task_completion(exec_task_id)
+            if flag == "timeout":
+                # the deadline fired mid-run: the watcher already
+                # failed/retried the attempt, and the retry owns the
+                # return ids now — suppress this zombie's results
+                return
+            if flag:
+                # cancel landed while the func ran (thread mode is
+                # cooperative): discard the result
+                self._store_error(spec, return_ids,
+                                  rex.TaskCancelledError(exec_task_id))
+                return
             ready_oids = self._store_returns(spec, return_ids, result)
         finally:
             if env_ctx is not None:
@@ -1516,7 +1635,7 @@ class Worker:
             # resubmit AFTER the finished notification so the scheduler
             # releases this execution's slot before seeing the retry
             if retry_task is not None:
-                self.scheduler.submit(retry_task)
+                self._submit_retry(retry_task)
 
     # serializes thread-mode env'd tasks: sys.path / sys.modules are
     # process-global, and two concurrent tasks with DIFFERENT
@@ -1638,8 +1757,15 @@ class Worker:
             self.task_manager.num_retries += 1
             logger.warning("retrying task %s (attempt %d/%d): %s", spec.name,
                            spec.attempt_number, spec.max_retries, exc)
+            msg = str(exc)
+            if "(chaos" in msg:
+                # an injected fault reached the retry machinery: count
+                # the recovery against its site
+                self._chaos.note_recovery(
+                    "worker" if "chaos worker kill" in msg else "task")
             # resubmit under the ORIGINAL return ids
             spec._retry_return_ids = return_ids  # type: ignore[attr-defined]
+            spec._backoff = True  # failure retry: _submit_retry delays it
             deps = _top_level_deps(spec.args, spec.kwargs)
             self.task_manager.rekey_pending(old_id, spec, deps)
             unresolved = [d for d in deps if not self.memory_store.contains(d)]
@@ -1647,11 +1773,21 @@ class Worker:
                                execute=_noop_exec)
         if isinstance(exc, rex.TaskCancelledError):
             self._store_error(spec, return_ids, exc)
+        elif isinstance(exc, rex.TaskTimeoutError):
+            # exhausted deadline retries: one summary error chaining the
+            # last per-attempt timeout (`raise ... from last_err`)
+            final = rex.TaskTimeoutError(
+                f"task {spec.name} timed out after {spec.attempt_number + 1} "
+                f"attempt(s) of {spec.timeout_s}s each",
+                task_id=spec.task_id, timeout_s=spec.timeout_s)
+            final.__cause__ = exc
+            self._store_error(spec, return_ids, final)
         else:
             tb = "".join(traceback.format_exception(type(exc), exc,
                                                     exc.__traceback__))
-            self._store_error(spec, return_ids,
-                              rex.TaskError(spec.name, exc, tb))
+            err = rex.TaskError(spec.name, exc, tb)
+            err.__cause__ = exc  # retry exhaustion chains the last failure
+            self._store_error(spec, return_ids, err)
         return None
 
     def _store_error(self, spec: TaskSpec, return_ids, exc: BaseException):
@@ -1660,17 +1796,152 @@ class Worker:
             self.scheduler.notify_object_ready(oid)
         self.task_manager.complete(spec.task_id)
 
-    _inject_entry = None
-
     def _maybe_inject_failure(self):
-        ent = Worker._inject_entry
-        if ent is None:
-            ent = Worker._inject_entry = GLOBAL_CONFIG.entry(
-                "testing_inject_task_failure_prob")
-        if ent.value > 0.0:
-            import random
-            if random.random() < ent.value:
-                raise rex.WorkerCrashedError("injected failure (chaos test)")
+        """Thread-mode ``task`` injection site. The controller also
+        honors the live testing_inject_task_failure_prob knob."""
+        fault = self._chaos.poll("task")
+        if fault is None:
+            return
+        if fault["kind"] == "hang":
+            time.sleep(fault.get("hang_s", 0.2))
+            return
+        raise rex.WorkerCrashedError("injected failure (chaos)")
+
+    # ------------------------------------------------------------------
+    # Supervision: retry backoff + per-task deadlines
+    # ------------------------------------------------------------------
+    def _claim_task_completion(self, exec_task_id: TaskID):
+        """Atomically end an attempt's cancellable window and return the
+        flag it finished under: "timeout" means the deadline watcher
+        already failed/retried the attempt (suppress the zombie's
+        results), True means cancel_task flipped it mid-run (store
+        TaskCancelledError, never retry), False/None is a clean finish."""
+        with self._running_lock:
+            return self._running_tasks.pop(exec_task_id, None)
+
+    def _submit_retry(self, retry_task: PendingTask) -> None:
+        """Resubmit a failed attempt's retry after exponential backoff
+        (base delay doubling per attempt, capped, with seeded jitter) so
+        a flapping node is not hammered with immediate resubmissions.
+        Dep-requeues (no attempt bump) resubmit immediately. Call AFTER
+        the attempt's finished-notification, like scheduler.submit."""
+        spec = retry_task.spec
+        if not getattr(spec, "_backoff", False):
+            self.scheduler.submit(retry_task)
+            return
+        spec._backoff = False
+        base = GLOBAL_CONFIG.task_retry_delay_s
+        delay = 0.0
+        if base > 0.0:
+            delay = min(base * (2 ** max(spec.attempt_number - 1, 0)),
+                        GLOBAL_CONFIG.task_retry_max_delay_s)
+            if GLOBAL_CONFIG.task_retry_jitter:
+                delay *= self._chaos.backoff_jitter(spec.attempt_number,
+                                                    spec.name)
+        # per-attempt delays kept on the spec so tests can assert growth
+        delays = getattr(spec, "_retry_delays", None)
+        if delays is None:
+            delays = spec._retry_delays = []  # type: ignore[attr-defined]
+        delays.append(delay)
+        if delay <= 0.0:
+            self._submit_retry_now(retry_task)
+            return
+        t = threading.Timer(delay, self._submit_retry_now, (retry_task,))
+        t.daemon = True
+        t.start()
+
+    def _submit_retry_now(self, retry_task: PendingTask) -> None:
+        if not self.alive:
+            return
+        spec = retry_task.spec
+        try:
+            if spec.timeout_s:
+                self._register_deadline(spec)
+            self.scheduler.submit(retry_task)
+        except Exception:
+            logger.exception("retry submission failed for %s", spec.name)
+
+    def _register_deadline(self, spec: TaskSpec) -> None:
+        """Arm the per-attempt deadline for spec's CURRENT task id; the
+        watcher thread starts lazily with the first armed deadline."""
+        if not spec.timeout_s or spec.timeout_s <= 0:
+            return
+        with self._deadline_cv:
+            heapq.heappush(self._deadline_heap,
+                           (time.monotonic() + spec.timeout_s,
+                            self._deadline_seq.next(), spec.task_id, spec))
+            if self._deadline_thread is None:
+                self._deadline_thread = threading.Thread(
+                    target=self._deadline_loop, daemon=True,
+                    name="ray_tpu_deadline")
+                self._deadline_thread.start()
+            self._deadline_cv.notify()
+
+    def _deadline_loop(self) -> None:
+        while self.alive:
+            with self._deadline_cv:
+                if not self._deadline_heap:
+                    self._deadline_cv.wait(timeout=0.5)
+                    continue
+                now = time.monotonic()
+                due_at = self._deadline_heap[0][0]
+                if due_at > now:
+                    self._deadline_cv.wait(
+                        timeout=min(due_at - now, 0.5))
+                    continue
+                _, _, tid, spec = heapq.heappop(self._deadline_heap)
+            try:
+                self._on_task_deadline(spec, tid)
+            except Exception:
+                logger.exception("deadline enforcement failed for %s",
+                                 spec.name)
+
+    def _on_task_deadline(self, spec: TaskSpec, tid: TaskID) -> None:
+        """One expired deadline. ``tid`` is the attempt the deadline was
+        armed for; a later attempt id on the spec means that attempt
+        already resolved (each retry re-arms its own deadline)."""
+        if spec.task_id is not tid and spec.task_id != tid:
+            return
+        if self.task_manager.get_pending_spec(tid) is None:
+            return  # attempt completed under the wire
+        err = rex.TaskTimeoutError(
+            f"task {spec.name} exceeded its {spec.timeout_s}s deadline "
+            f"(attempt {spec.attempt_number + 1})",
+            task_id=tid, timeout_s=spec.timeout_s)
+        return_ids = (getattr(spec, "_retry_return_ids", None)
+                      or spec.return_ids())
+        # (a) still queued in the scheduler: pull it out (no slot held,
+        #     so no finished-notification is owed)
+        if self.scheduler.cancel(tid):
+            retry = self._handle_task_failure(spec, return_ids, err)
+            if retry is not None:
+                self._submit_retry(retry)
+            return
+        # (b) leased to a process/remote pool: force-kill the attempt
+        #     there, classified as a timeout (retriable)
+        pools = list(self._node_pools.values())
+        if self.process_pool is not None and self.process_pool not in pools:
+            pools.append(self.process_pool)
+        for pool in pools:
+            cancel_to = getattr(pool, "cancel_for_timeout", None)
+            if cancel_to is not None and cancel_to(tid):
+                return  # pool failure path raises TaskTimeoutError
+        # (c) thread mode: running (flag the attempt as timed out and
+        #     fail it now — the zombie thread's results are suppressed)
+        #     or executor-queued (timed out at execution start)
+        synthesize = False
+        with self._running_lock:
+            flag = self._running_tasks.get(tid)
+            if flag is False:
+                self._running_tasks[tid] = "timeout"
+                synthesize = True
+            elif flag is None and spec.task_id == tid \
+                    and self.task_manager.get_pending_spec(tid) is not None:
+                self._pretimeout.add(tid)
+        if synthesize:
+            retry = self._handle_task_failure(spec, return_ids, err)
+            if retry is not None:
+                self._submit_retry(retry)
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -1760,6 +2031,8 @@ class Worker:
 
     def shutdown(self) -> None:
         self.alive = False
+        with self._deadline_cv:
+            self._deadline_cv.notify_all()  # release the watcher promptly
         self._drain_out_of_scope()
         self.placement_groups.shutdown()
         with self._actors_lock:
@@ -1963,6 +2236,9 @@ def shutdown() -> None:
         # _system_config is scoped to one init/shutdown cycle; a leaked
         # worker_mode=process would silently re-route the next runtime
         GLOBAL_CONFIG.reset()
+        # chaos schedules are scoped the same way: an armed plan must
+        # not leak into the next runtime's fault decisions
+        _chaos_controller().reset()
 
 
 def is_initialized() -> bool:

@@ -117,6 +117,23 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class TaskTimeoutError(RayTpuError, TimeoutError):
+    """A task exceeded its per-attempt ``timeout_s`` deadline and was
+    cancelled by the supervision layer. Retriable: each timeout counts
+    one attempt against ``max_retries``; when retries are exhausted the
+    final error chains the last per-attempt timeout as ``__cause__``."""
+
+    def __init__(self, msg: str = "task timed out", task_id=None,
+                 timeout_s=None):
+        self.task_id = task_id
+        self.timeout_s = timeout_s
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (TaskTimeoutError,
+                (self.args[0], self.task_id, self.timeout_s))
+
+
 class TaskCancelledError(RayTpuError):
     def __init__(self, task_id=None):
         self.task_id = task_id

@@ -23,6 +23,7 @@ _DEFAULT_OPTIONS = dict(
     num_returns=1,
     max_retries=None,
     retry_exceptions=False,
+    timeout_s=None,
     name=None,
     scheduling_strategy=None,
     placement_group=None,
@@ -169,6 +170,7 @@ class RemoteFunction:
             resources=self._resources,
             max_retries=max_retries,
             retry_exceptions=retry_exceptions,
+            timeout_s=opts["timeout_s"],
             serialized_func=self._fn_blob,
             func_id=self._fn_id,
             class_key=self._class_key,
@@ -193,6 +195,7 @@ class RemoteFunction:
                     resources=self._resources,
                     max_retries=max_retries,
                     retry_exceptions=opts["retry_exceptions"],
+                    timeout_s=opts["timeout_s"],
                     serialized_func=self._fn_blob,
                     func_id=self._fn_id,
                     class_key=self._class_key,
@@ -254,6 +257,7 @@ class RemoteFunction:
             resources=_build_resources(opts),
             max_retries=max_retries,
             retry_exceptions=opts["retry_exceptions"],
+            timeout_s=opts["timeout_s"],
             task_type=TaskType.NORMAL_TASK,
             scheduling_strategy=strategy,
             placement_group_id=pg_id,

@@ -67,12 +67,30 @@ def list_objects() -> List[Dict[str, Any]]:
 
 @_client_dispatch
 def list_nodes() -> List[Dict[str, Any]]:
+    import time
+
     w = worker_mod.get_worker()
+    now = time.monotonic()
     return [
         {"node_id": e.node_id.hex(), "index": e.index, "state": e.state,
-         "kind": e.kind, "resources": dict(e.resources)}
+         "kind": e.kind, "resources": dict(e.resources),
+         # seconds since the GCS last recorded a heartbeat; compare
+         # against config node_heartbeat_timeout_s to spot nodes the
+         # staleness monitor is about to declare dead
+         "heartbeat_age_s": round(now - e.last_heartbeat, 3)}
         for e in w.gcs.node_table()
     ]
+
+
+@_client_dispatch
+def list_faults() -> List[Dict[str, Any]]:
+    """Faults the chaos controller has injected this run, in injection
+    order: {seq, site, kind, when, context}. Same-seed runs of the same
+    workload produce the identical sequence — the reproducibility
+    receipt for chaos-soak tests."""
+    from ray_tpu._private.chaos import get_controller
+
+    return get_controller().list_faults()
 
 
 @_client_dispatch

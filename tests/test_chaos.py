@@ -1,0 +1,484 @@
+"""Chaos plane + supervision: seeded fault injection, heartbeat
+staleness detection, retry backoff, per-task deadlines.
+
+Reference pattern: the reference repo's chaos tests (cluster_utils kill
+helpers + testing_inject_task_failure_prob) made fault timing
+probabilistic; ray_tpu's FaultController makes the schedule itself the
+test input — a seed + (site, when, kind) plan replays bit-for-bit, so
+the soak asserts BOTH correctness under faults and reproducibility of
+the fault sequence via state.list_faults().
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.exceptions as rex
+from ray_tpu import chaos
+from ray_tpu._private.chaos import FaultController, FaultPlan
+
+
+def wait_for(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ----------------------------------------------------------------------
+# FaultController unit tests (no runtime)
+# ----------------------------------------------------------------------
+
+class TestFaultController:
+    def test_plan_validates_sites_and_kinds(self):
+        with pytest.raises(ValueError):
+            FaultPlan(0, [("no_such_site", 0, "kill")])
+        with pytest.raises(ValueError):
+            FaultPlan(0, [("task", 0, "kill")])  # kind not valid for site
+
+    def test_scheduled_fault_fires_at_exact_arrival(self):
+        c = FaultController()
+        c.arm(FaultPlan(3, [("task", 2, "exception")]))
+        assert c.poll("task") is None
+        assert c.poll("task") is None
+        assert c.poll("task")["kind"] == "exception"
+        assert c.poll("task") is None
+        assert [(e["site"], e["when"], e["kind"])
+                for e in c.list_faults()] == [("task", 2, "exception")]
+
+    def test_plan_params_override_defaults(self):
+        c = FaultController()
+        c.arm(FaultPlan(0, [("link", 0, "delay", {"delay_s": 0.7}),
+                            ("transfer", 0, "truncate")]))
+        assert c.poll("link")["delay_s"] == 0.7
+        assert c.poll("transfer")["keep_fraction"] == 0.5  # default
+
+    def test_probability_draws_are_seed_deterministic(self):
+        runs = []
+        for _ in range(2):
+            c = FaultController()
+            c.arm(FaultPlan(11))
+            c.set_probability("task", 0.3)
+            runs.append([c.poll("task") is not None for _ in range(60)])
+        assert runs[0] == runs[1]
+        assert any(runs[0]) and not all(runs[0])
+
+    def test_counters_track_injection_and_recovery(self):
+        c = FaultController()
+        c.arm(FaultPlan(0, [("worker", 0, "kill"), ("task", 0,
+                                                    "exception")]))
+        assert c.poll("worker")["kind"] == "kill"
+        assert c.poll("task")["kind"] == "exception"
+        c.note_recovery("worker")
+        ctr = c.counters()
+        assert ctr["injected"] == {"worker": 1, "task": 1}
+        assert ctr["recovered"] == {"worker": 1}
+        assert ctr["injected_total"] == 2 and ctr["recovered_total"] == 1
+
+    def test_disarmed_controller_counts_nothing(self):
+        c = FaultController()
+        assert c.poll("worker") is None
+        c.arm(FaultPlan(0, [("worker", 0, "kill")]))
+        c.disarm()
+        assert c.poll("worker") is None
+        assert c.counters()["injected_total"] == 0
+
+    def test_backoff_jitter_deterministic_in_range(self):
+        c = FaultController()
+        c.arm(FaultPlan(5))
+        a = [c.backoff_jitter(i, "t1") for i in range(4)]
+        b = [c.backoff_jitter(i, "t1") for i in range(4)]
+        assert a == b
+        assert all(0.5 <= x < 1.0 for x in a)
+        assert a != [c.backoff_jitter(i, "t2") for i in range(4)]
+
+    def test_config_prob_read_live_per_poll(self):
+        """Regression: testing_inject_task_failure_prob used to be
+        snapshotted at ProcessWorkerPool construction; the controller
+        must observe the live value on every task poll."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        c = FaultController()
+        ent = GLOBAL_CONFIG.entry("testing_inject_task_failure_prob")
+        saved = ent.value
+        try:
+            ent.value = 0.0
+            assert c.poll("task") is None
+            ent.value = 1.0  # flipped AFTER the controller existed
+            assert c.poll("task")["kind"] == "exception"
+            ent.value = 0.0
+            assert c.poll("task") is None
+        finally:
+            ent.value = saved
+
+
+# ----------------------------------------------------------------------
+# retry backoff + exhaustion chaining (thread mode)
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def chaos_ray():
+    """Thread-mode runtime with a visible (but fast) backoff base."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=4,
+                 _system_config={"task_retry_delay_s": 0.1,
+                                 "task_retry_max_delay_s": 1.0})
+    yield ray_tpu
+    ray_tpu.shutdown()  # also resets the chaos controller
+
+
+def test_retries_back_off_exponentially(chaos_ray):
+    chaos.arm(chaos.FaultPlan(21, faults=[("task", 0, "exception"),
+                                          ("task", 1, "exception")]))
+
+    @ray_tpu.remote(max_retries=3)
+    def f():
+        return "ok"
+
+    t0 = time.monotonic()
+    assert ray_tpu.get(f.remote(), timeout=30) == "ok"
+    elapsed = time.monotonic() - t0
+    # two retries: 0.1 * jitter + 0.2 * jitter, jitter in [0.5, 1.0)
+    assert elapsed >= 0.15, elapsed
+    ctr = chaos.counters()
+    assert ctr["injected"]["task"] == 2
+    assert ctr["recovered"]["task"] == 2
+
+
+def test_exhaustion_chains_last_underlying_error(chaos_ray):
+    """Satellite: the final retries-exhausted error must chain the last
+    underlying exception (raise ... from), not just repr it."""
+    chaos.arm(chaos.FaultPlan(22, faults=[("task", 0, "exception"),
+                                          ("task", 1, "exception")]))
+
+    @ray_tpu.remote(max_retries=1)
+    def doomed():
+        return "unreachable"
+
+    ref = doomed.remote()
+    with pytest.raises(rex.TaskError):
+        ray_tpu.get(ref, timeout=30)
+    from ray_tpu._private import worker as worker_mod
+    entry = worker_mod.get_worker().memory_store.get_entry(
+        ref.object_id())
+    assert isinstance(entry.value, rex.TaskError)
+    assert isinstance(entry.value.__cause__, rex.WorkerCrashedError)
+    assert "chaos" in str(entry.value.__cause__)
+
+
+# ----------------------------------------------------------------------
+# per-task deadlines (thread mode; process mode below)
+# ----------------------------------------------------------------------
+
+def test_timeout_s_thread_mode_chains_cause(chaos_ray):
+    @ray_tpu.remote(max_retries=1, timeout_s=0.3)
+    def hang():
+        time.sleep(5)
+
+    t0 = time.monotonic()
+    with pytest.raises(rex.TaskTimeoutError) as ei:
+        ray_tpu.get(hang.remote(), timeout=30)
+    # retried once (with backoff), then exhausted — never waits out the
+    # full sleeps
+    assert time.monotonic() - t0 < 4.0
+    assert "2 attempt(s)" in str(ei.value)
+    assert isinstance(ei.value.__cause__, rex.TaskTimeoutError)
+    assert "deadline" in str(ei.value.__cause__)
+
+
+def test_timeout_s_via_options(chaos_ray):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(5)
+
+    with pytest.raises(rex.TaskTimeoutError):
+        ray_tpu.get(hang.options(timeout_s=0.2, max_retries=0).remote(),
+                    timeout=30)
+
+
+def test_timeout_s_fast_task_unaffected(chaos_ray):
+    @ray_tpu.remote(timeout_s=5.0)
+    def quick(x):
+        return x + 1
+
+    assert ray_tpu.get([quick.remote(i) for i in range(8)],
+                       timeout=30) == list(range(1, 9))
+
+
+def test_timeout_s_fires_while_still_queued(chaos_ray):
+    """A task whose deadline expires before it is ever scheduled must
+    fail with TaskTimeoutError, not sit in the queue forever."""
+    @ray_tpu.remote(num_cpus=1)
+    def blocker():
+        time.sleep(1.5)
+
+    blockers = [blocker.remote() for _ in range(16)]
+
+    @ray_tpu.remote(max_retries=0, timeout_s=0.2)
+    def victim():
+        return 1
+
+    with pytest.raises(rex.TaskTimeoutError):
+        ray_tpu.get(victim.remote(), timeout=30)
+    ray_tpu.get(blockers, timeout=30)
+
+
+# ----------------------------------------------------------------------
+# cancel coverage: force x recursive, thread AND process mode
+# ----------------------------------------------------------------------
+
+class TestCancelThreadMode:
+    def test_cancel_running_cooperative(self, chaos_ray):
+        @ray_tpu.remote
+        def naps():
+            time.sleep(1.0)
+            return 1
+
+        ref = naps.remote()
+        time.sleep(0.1)  # let it start
+        ray_tpu.cancel(ref, force=False, recursive=True)
+        with pytest.raises(rex.TaskCancelledError):
+            ray_tpu.get(ref, timeout=30)
+
+    def test_cancel_not_yet_scheduled(self, chaos_ray):
+        @ray_tpu.remote(num_cpus=1)
+        def blocker():
+            time.sleep(1.0)
+
+        blockers = [blocker.remote() for _ in range(16)]
+
+        @ray_tpu.remote
+        def queued():
+            return 1
+
+        victim = queued.remote()
+        time.sleep(0.05)
+        ray_tpu.cancel(victim, recursive=True)
+        t0 = time.monotonic()
+        with pytest.raises(rex.TaskCancelledError):
+            ray_tpu.get(victim, timeout=30)
+        # a queued cancel resolves immediately — it must not wait for a
+        # worker slot
+        assert time.monotonic() - t0 < 0.5
+        ray_tpu.get(blockers, timeout=30)
+
+    def test_cancelled_task_is_not_retried(self, chaos_ray):
+        @ray_tpu.remote(max_retries=5)
+        def naps():
+            time.sleep(1.0)
+
+        ref = naps.remote()
+        time.sleep(0.1)
+        ray_tpu.cancel(ref)
+        with pytest.raises(rex.TaskCancelledError):
+            ray_tpu.get(ref, timeout=30)
+
+
+class TestCancelProcessMode:
+    @pytest.fixture()
+    def proc_ray(self):
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, num_workers=2,
+                     _system_config={"worker_mode": "process"})
+        yield ray_tpu
+        ray_tpu.shutdown()
+
+    def test_force_cancel_running(self, proc_ray):
+        @ray_tpu.remote
+        def naps():
+            time.sleep(30)
+
+        ref = naps.remote()
+        time.sleep(0.3)
+        ray_tpu.cancel(ref, force=True, recursive=True)
+        with pytest.raises(rex.TaskCancelledError):
+            ray_tpu.get(ref, timeout=30)
+
+    def test_soft_cancel_queued_on_pool(self, proc_ray):
+        # both workers busy -> the victim waits in the pool's queue
+        @ray_tpu.remote
+        def blocker():
+            time.sleep(1.0)
+
+        blockers = [blocker.remote() for _ in range(2)]
+        time.sleep(0.2)
+
+        @ray_tpu.remote
+        def queued():
+            return 1
+
+        victim = queued.remote()
+        time.sleep(0.1)
+        ray_tpu.cancel(victim, force=False)
+        with pytest.raises(rex.TaskCancelledError):
+            ray_tpu.get(victim, timeout=30)
+        ray_tpu.get(blockers, timeout=30)
+
+    def test_timeout_s_process_mode(self, proc_ray):
+        @ray_tpu.remote(max_retries=1, timeout_s=0.4)
+        def hang():
+            time.sleep(30)
+
+        t0 = time.monotonic()
+        with pytest.raises(rex.TaskTimeoutError):
+            ray_tpu.get(hang.remote(), timeout=60)
+        assert time.monotonic() - t0 < 10.0
+
+
+# ----------------------------------------------------------------------
+# observability: state verbs + metrics
+# ----------------------------------------------------------------------
+
+def test_list_nodes_reports_heartbeat_age(chaos_ray):
+    from ray_tpu.util.state import list_nodes
+
+    rows = list_nodes()
+    assert rows
+    for r in rows:
+        assert "heartbeat_age_s" in r
+        assert r["heartbeat_age_s"] >= 0.0
+
+
+def test_list_faults_state_verb(chaos_ray):
+    from ray_tpu.util.state import list_faults
+
+    chaos.arm(chaos.FaultPlan(31, faults=[("task", 0, "exception")]))
+
+    @ray_tpu.remote(max_retries=2)
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=30) == 1
+    log = list_faults()
+    assert [(e["site"], e["kind"]) for e in log] == [("task", "exception")]
+    assert log[0]["seq"] == 0
+
+
+def test_metrics_export_chaos_counters(chaos_ray):
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.metrics import render_all
+
+    chaos.arm(chaos.FaultPlan(32, faults=[("task", 0, "exception")]))
+
+    @ray_tpu.remote(max_retries=2)
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=30) == 1
+    text = render_all(worker_mod.get_worker())
+    assert 'ray_tpu_chaos_injected_total{site="task"} 1' in text
+    assert 'ray_tpu_chaos_recovered_total{site="task"} 1' in text
+
+
+# ----------------------------------------------------------------------
+# heartbeat staleness: connected but silent node must die (regression)
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_heartbeat_staleness_marks_connected_node_dead():
+    """A node whose daemon stays connected (probes answered!) but whose
+    heartbeats are lost must be marked DEAD within
+    node_heartbeat_timeout_s, and its in-flight tasks respawned."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster(initialize_head=True,
+                head_node_args=dict(
+                    num_cpus=2, num_workers=2,
+                    _system_config={"node_heartbeat_timeout_s": 1.0}))
+    try:
+        n1 = c.add_node(num_cpus=4, num_workers=2)
+        c.wait_for_nodes()
+
+        @ray_tpu.remote(max_retries=5)
+        def slow(i):
+            time.sleep(0.1)
+            return i
+
+        refs = [slow.remote(i) for i in range(12)]
+        time.sleep(0.15)  # let tasks land on n1
+        chaos.set_probability("heartbeat", 1.0)  # drop every heartbeat
+        t0 = time.monotonic()
+        assert wait_for(lambda: n1.state == "DEAD", timeout=10)
+        # detected within the timeout plus a few health-check periods
+        assert time.monotonic() - t0 < 5.0
+        chaos.disarm()
+        from ray_tpu._private import worker as worker_mod
+        entry = worker_mod.get_worker().gcs._nodes[n1.node_id]
+        assert "heartbeat" in (entry.death_reason or "")
+        # the dead node's tasks respawn on the head and finish correctly
+        assert ray_tpu.get(refs, timeout=60) == list(range(12))
+    finally:
+        chaos.disarm()
+        c.shutdown()
+
+
+# ----------------------------------------------------------------------
+# the seeded chaos soak (tentpole acceptance)
+# ----------------------------------------------------------------------
+
+SOAK_PLAN = [
+    ("worker", 1, "kill"),
+    ("worker", 9, "kill"),
+    ("task", 3, "exception"),
+    ("task", 11, "exception"),
+    ("task", 17, "hang", {"hang_s": 0.1}),
+    ("link", 5, "delay", {"delay_s": 0.05}),
+]
+
+
+def _soak_run(seed):
+    from ray_tpu.util.state import list_faults
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_workers=2,
+                 _system_config={"worker_mode": "process",
+                                 "object_store_memory": 32 * 1024 * 1024,
+                                 "task_retry_delay_s": 0.02})
+    try:
+        chaos.arm(chaos.FaultPlan(seed, faults=SOAK_PLAN))
+
+        @ray_tpu.remote(max_retries=4)
+        def stage1(i):
+            return np.arange(64, dtype=np.float64) * i
+
+        @ray_tpu.remote(max_retries=4)
+        def stage2(a):
+            return float(a.sum())
+
+        refs = [stage2.remote(stage1.remote(i)) for i in range(24)]
+        out = ray_tpu.get(refs, timeout=120)
+        log = [(e["site"], e["when"], e["kind"]) for e in list_faults()]
+        counters = chaos.counters()
+        return out, log, counters
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_chaos_soak_seeded_and_reproducible():
+    """North-star-style two-stage numpy pipeline under >=3 distinct
+    fault kinds in ONE run: results stay bit-correct, and the same seed
+    reproduces the identical fault sequence."""
+    expected = [float((np.arange(64, dtype=np.float64) * i).sum())
+                for i in range(24)]
+    out1, log1, ctr1 = _soak_run(1234)
+    assert out1 == expected  # bit-correct despite kills/exceptions
+    kinds = {k for _, _, k in log1}
+    assert {"kill", "exception"} <= kinds and len(kinds) >= 3, log1
+    assert ctr1["injected_total"] >= len(SOAK_PLAN)
+    assert ctr1["recovered_total"] >= 3  # kills + task exceptions retried
+
+    out2, log2, _ = _soak_run(1234)
+    assert out2 == expected
+    # the reproducibility receipt: identical fault set, and per-site the
+    # identical ordered sequence (cross-site log order is wall-clock
+    # interleaving, not part of the contract)
+    assert sorted(log2) == sorted(log1)
+    for site in {s for s, _, _ in log1}:
+        assert [e for e in log1 if e[0] == site] == \
+            [e for e in log2 if e[0] == site]
