@@ -122,11 +122,14 @@ print("E2E_JSON:" + json.dumps(r))
 """
 
 
-def _e2e_subprocess(n: int, mode: str, batched: bool = False) -> dict:
+def _e2e_subprocess(n: int, mode: str, batched: bool = False,
+                    extra_env: dict = None) -> dict:
     """Run one e2e measurement in a fresh interpreter (no jax/XLA heap
     from the device sections; CPU platform — the task path touches no
-    accelerator)."""
+    accelerator). extra_env lets a section flip config knobs via their
+    RAY_TPU_* env overrides (the log_overhead A/B uses it)."""
     env = spawn_env.child_env()
+    env.update(extra_env or {})
     code = _E2E_CHILD.format(repo=REPO, n=n, mode=mode, batched=batched)
     timeout = max(30.0, min(300.0, _remaining() - 10.0))
     out = subprocess.run([sys.executable, "-c", code], env=env,
@@ -326,6 +329,38 @@ def main() -> int:
             e2e[label] = None
         OUT["e2e_tasks_per_sec"] = dict(e2e)
         OUT["e2e_budget_us"] = dict(budgets)
+        _emit()
+
+    # --- log plane: stdout/stderr capture overhead ---------------------
+    # A/B of the e2e harness with capture disabled (RAY_TPU_LOG_CAPTURE=0
+    # — no session dir, no per-worker files, no monitor thread). The e2e
+    # numbers above ran with capture ON (the default), so only the OFF
+    # side needs measuring; the claim under test is that the capture
+    # machinery stays within ~10% of the uninstrumented path.
+    if section("log_overhead", 25):
+        lo = {}
+        for label, mode, n in (("thread", "thread", n_thread),
+                               ("process", "process", n_proc)):
+            try:
+                on = e2e.get(label)
+                if on is None:
+                    on = round(_e2e_subprocess(n, mode)["tasks_per_sec"],
+                               1)
+                off = round(_e2e_subprocess(
+                    n, mode,
+                    extra_env={"RAY_TPU_LOG_CAPTURE": "0"})
+                    ["tasks_per_sec"], 1)
+                lo[label] = {
+                    "capture_on_tasks_per_sec": on,
+                    "capture_off_tasks_per_sec": off,
+                    "overhead_pct": round(100.0 * (off - on) / off, 1),
+                }
+                print(f"  log overhead[{label}]: {on:.0f} tasks/s with "
+                      f"capture vs {off:.0f} without "
+                      f"({lo[label]['overhead_pct']}%)", file=sys.stderr)
+            except Exception:
+                traceback.print_exc()
+        OUT["log_overhead"] = lo or None
         _emit()
 
     # --- model perf: step time / tokens/s / MFU ------------------------

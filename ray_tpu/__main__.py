@@ -171,6 +171,62 @@ def _cmd_job(args) -> int:
     return 0 if status == "SUCCEEDED" else 1
 
 
+def _cmd_logs(args) -> int:
+    """List / print session log files (reference: `ray logs`).
+
+    Three sources, in order of preference: a running cluster over
+    ``--address ray://...`` (uses the list_logs/get_log state verbs,
+    including off-head nodes), an explicit ``--session-dir``, or the
+    newest ``/tmp/ray_tpu/session_*/logs`` on this machine
+    (postmortem reads straight off disk — no cluster needed)."""
+    from ray_tpu._private import log_plane
+
+    if args.address:
+        import ray_tpu
+        from ray_tpu.util import state
+
+        ray_tpu.init(address=args.address)
+        try:
+            if args.filename:
+                text = state.get_log(args.filename,
+                                     node_id=args.node_id or None,
+                                     tail=args.tail or None)
+                print(text, end="" if text.endswith("\n") else "\n")
+            else:
+                rows = state.list_logs(args.node_id or None)
+                if not rows:
+                    print("no log files")
+                for r in rows:
+                    print(f"{r['size_bytes']:>10}  "
+                          f"node={r.get('node_id', '')[:12]:<12}  "
+                          f"{r['filename']}")
+        finally:
+            ray_tpu.shutdown()
+        return 0
+
+    log_dir = args.session_dir or log_plane.latest_session_log_dir()
+    if not log_dir:
+        print("no session log dir found under /tmp/ray_tpu "
+              "(pass --session-dir or --address)", file=sys.stderr)
+        return 2
+    if args.filename:
+        try:
+            text = log_plane.read_log(log_dir, args.filename,
+                                      args.tail or None)
+        except (OSError, ValueError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        print(text, end="" if text.endswith("\n") else "\n")
+        return 0
+    print(f"session log dir: {log_dir}")
+    rows = log_plane.list_log_files(log_dir)
+    if not rows:
+        print("no log files")
+    for r in rows:
+        print(f"{r['size_bytes']:>10}  {r['filename']}")
+    return 0
+
+
 def _cmd_summary(args) -> int:
     """Summarize a timeline JSON produced by ray_tpu.timeline()."""
     with open(args.trace) as f:
@@ -239,6 +295,21 @@ def main(argv=None) -> int:
     p.add_argument("entrypoint", nargs=argparse.REMAINDER,
                    help="command to run (everything after 'job')")
     p.set_defaults(fn=_cmd_job)
+
+    p = sub.add_parser("logs", help="list or print session log files")
+    p.add_argument("filename", nargs="?", default="",
+                   help="capture file to print (omit to list files)")
+    p.add_argument("--tail", type=int, default=0,
+                   help="print only the last N lines")
+    p.add_argument("--node-id", default="",
+                   help="node id (hex, prefix ok); default: head/local")
+    p.add_argument("--address", default="",
+                   help="ray://host:port?key=... of a running head "
+                   "(reads over the cluster instead of local disk)")
+    p.add_argument("--session-dir", default="",
+                   help="explicit session logs dir (default: newest "
+                   "/tmp/ray_tpu/session_*/logs)")
+    p.set_defaults(fn=_cmd_logs)
 
     p = sub.add_parser("summary", help="summarize a timeline trace")
     p.add_argument("trace", help="JSON from ray_tpu.timeline(file)")

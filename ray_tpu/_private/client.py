@@ -56,7 +56,7 @@ class ClientSession:
 _STATE_VERBS = frozenset({
     "list_tasks", "list_actors", "list_objects", "list_nodes",
     "list_placement_groups", "summarize_tasks", "list_data_streams",
-    "list_faults",
+    "list_faults", "list_logs", "get_log",
 })
 
 
@@ -255,7 +255,7 @@ class ClientServer:
             self._pin(s, ObjectID(b))
         return True
 
-    def _op_state(self, s, verb: str) -> Any:
+    def _op_state(self, s, verb: str, *args) -> Any:
         import ray_tpu
         from ray_tpu.util import state as state_api
         if verb == "cluster_resources":
@@ -266,9 +266,10 @@ class ClientServer:
             return ray_tpu.nodes()
         # full state-observability verbs (reference: the GCS client
         # accessors backing `ray list ...` from any process); allowlist,
-        # not bare getattr — the verb string comes off the wire
+        # not bare getattr — the verb string comes off the wire (args
+        # too: parameterized verbs like get_log ship positionals)
         if verb in _STATE_VERBS:
-            return getattr(state_api, verb)()
+            return getattr(state_api, verb)(*args)
         raise ValueError(f"unknown state verb {verb!r}")
 
     def _op_kv(self, s, op: str, namespace: str, key: bytes,
@@ -591,8 +592,8 @@ class ClientWorker:
         self._rpc("kill_actor", actor_id.binary(), no_restart)
 
     # -- state ----------------------------------------------------------
-    def state(self, verb: str):
-        return self._rpc("state", verb)
+    def state(self, verb: str, *args):
+        return self._rpc("state", verb, *args)
 
     # -- cluster KV (GCS client accessor analog) -------------------------
     def kv_get(self, key: bytes, namespace: str = ""):

@@ -230,6 +230,17 @@ _d("task_retry_jitter", bool, True,
 
 # -- logging / observability ----------------------------------------------
 _d("log_dir", str, "", "session log dir; empty = /tmp/ray_tpu/session_*/logs")
+_d("log_capture", bool, True,
+   "capture worker stdout/stderr into per-process session log files; "
+   "off = no session log dir, no driver streaming, no list_logs/get_log "
+   "(the bench's capture-off baseline)")
+_d("log_rotation_bytes", int, 64 * 1024 * 1024,
+   "rotate a worker capture file when it exceeds this size; 0 = never")
+_d("log_rotation_backups", int, 3,
+   "rotated generations kept per capture file (file.1 .. file.N)")
+_d("log_to_driver_rate", int, 2000,
+   "max captured log lines re-emitted on the driver per second; "
+   "excess lines are dropped with a surfaced drop count")
 _d("metrics_export_port", int, 0, "prometheus text endpoint port; 0 = disabled")
 _d("event_buffer_size", int, 65536, "profile/trace event ring size per worker")
 

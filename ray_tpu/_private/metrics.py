@@ -199,6 +199,23 @@ def _render_core(worker) -> List[str]:
          sum(1 for e in worker.gcs.node_table()
              if e.state == "ALIVE"))
 
+    # log plane: driver-streaming volume + on-disk capture volume (the
+    # byte count is a session-dir scan — honest across processes, since
+    # workers write their files directly, not through this process)
+    lm = getattr(worker, "log_monitor", None)
+    emit("ray_tpu_log_lines_emitted_total", "counter",
+         "captured log lines re-emitted on the driver",
+         lm.lines_emitted if lm is not None else 0)
+    emit("ray_tpu_log_lines_dropped_total", "counter",
+         "captured log lines dropped by the driver rate limiter",
+         lm.lines_dropped if lm is not None else 0)
+    from ray_tpu._private import log_plane
+    log_dir = getattr(worker, "session_log_dir", None)
+    emit("ray_tpu_log_bytes_written_total", "counter",
+         "bytes resident in this session's log capture files",
+         sum(r["size_bytes"] for r in log_plane.list_log_files(log_dir))
+         if log_dir else 0)
+
     from ray_tpu._private.chaos import get_controller
     chaos = get_controller().counters()
     for name, desc, per_site, total in (

@@ -31,20 +31,31 @@ The daemon is a *multiplexer with a local object store*:
     of the arena/spill tier for a cross-node consumer) and ``free``.
 
 Head -> daemon messages:
-  ("spawn", num)              exec a worker process numbered `num`
+  ("spawn", num[, wid_hex])   exec a worker process numbered `num`
+                              (wid_hex names its log capture files)
   ("to_w", num, msg)          deliver msg on worker num's task pipe
   ("to_ctrl", num, msg)       deliver msg on worker num's control pipe
   ("kill", num)               SIGKILL worker num (force-cancel path)
   ("fetch", fid, oid_bin)     -> ("fetched", fid, ok, bytes)
   ("free", [oid_bin, ...])    drop objects from the local store
   ("ping", pid_)              -> ("pong", pid_, {num: pid})
+  ("log_list", rid)           -> ("log_listed", rid, rows)
+  ("log_read", rid, filename, tail)
+                              -> ("log_data", rid, ok, text_or_error)
   ("exit",)                   kill workers and exit
 
 Daemon -> head messages:
   ("w", num, msg)             message from worker num (maybe rewritten)
-  ("worker_died", num, code)  worker process exited
+  ("worker_died", num, code[, err_tail])
+                              worker process exited (err_tail: last
+                              lines of its .err capture, or "")
   ("fetched", fid, ok, data)  fetch reply
   ("pong", pid_, pids)        ping reply
+  ("log", fname, lines)       appended log lines from a capture file
+                              (unsolicited; the head's LogMonitor
+                              re-emits them on the driver)
+  ("log_listed", rid, rows)   log_list reply
+  ("log_data", rid, ok, text) log_read reply
 """
 
 from __future__ import annotations
@@ -62,7 +73,7 @@ from ray_tpu._private.ids import ObjectID
 
 class _WorkerSlot:
     __slots__ = ("num", "proc", "conn", "ctrl", "pid", "returns", "gets",
-                 "actor_bin", "send_lock")
+                 "actor_bin", "send_lock", "err_path")
 
     def __init__(self, num: int):
         self.num = num
@@ -85,6 +96,9 @@ class _WorkerSlot:
         # dedicated actor workers record their actor id (from the
         # actor_create payload) so a RESTARTED head can re-adopt them
         self.actor_bin: Optional[bytes] = None
+        # path of this worker's .err capture file (log plane), so a
+        # crash tail can ride the worker_died report to the head
+        self.err_path: Optional[str] = None
 
 
 PEER_CHUNK = 1 << 20  # ~1 MB frames (reference: ObjectBufferPool)
@@ -330,6 +344,29 @@ class NodeDaemon:
         # STATE living in their processes — survive the head restart.
         self._rejoin_timeout_s = rejoin_timeout_s
 
+        # log plane: this node's capture directory. The head points it
+        # somewhere meaningful via RAY_TPU_LOG_DIR when it spawns us
+        # (same-host clusters nest it under the head's session dir);
+        # self-started daemons get their own session dir. Workers'
+        # stdout/stderr land here, a tailer ships appended lines to
+        # the head, and log_list/log_read queries read from here.
+        from ray_tpu._private import log_plane
+
+        env_dir = os.environ.get("RAY_TPU_LOG_DIR", "")
+        self.log_dir = log_plane.resolve_session_log_dir(env_dir)
+        try:
+            self._log_rotate = int(os.environ.get(
+                log_plane.ENV_LOG_ROTATE_BYTES, "0") or 0)
+            self._log_backups = int(os.environ.get(
+                log_plane.ENV_LOG_ROTATE_BACKUPS, "0") or 0)
+        except ValueError:
+            self._log_rotate, self._log_backups = 0, 0
+        if not self._log_rotate:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+            self._log_rotate = GLOBAL_CONFIG.log_rotation_bytes
+            self._log_backups = GLOBAL_CONFIG.log_rotation_backups
+        self._log_offsets: Dict[str, int] = {}
+
         # workers dial this daemon, never the head (they may share no
         # filesystem/host with it)
         self._authkey = os.urandom(16)
@@ -398,7 +435,7 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     # worker lifecycle
     # ------------------------------------------------------------------
-    def _spawn(self, num: int) -> None:
+    def _spawn(self, num: int, wid_hex: Optional[str] = None) -> None:
         slot = _WorkerSlot(num)
         with self._lock:
             self._slots[num] = slot
@@ -406,12 +443,19 @@ class NodeDaemon:
         # the single-chip lease) — strip the plugin vars so a degraded
         # tunnel can't hang their `import jax`; worker_tpu_access
         # opts a node's workers back in (same knob process_pool honors)
-        from ray_tpu._private import spawn_env
+        from ray_tpu._private import log_plane, spawn_env
         from ray_tpu._private.config import GLOBAL_CONFIG
+        extra = {"RAY_TPU_AUTHKEY": self._authkey.hex()}
+        stem = (f"worker-{wid_hex}" if wid_hex
+                else f"worker-{num}-{os.getpid()}")
+        log_env = log_plane.child_log_env(
+            self.log_dir, stem, self._log_rotate, self._log_backups)
+        slot.err_path = log_env.get(log_plane.ENV_LOG_ERR)
+        extra.update(log_env)
         env = spawn_env.child_env(
             use_accelerator=GLOBAL_CONFIG.worker_tpu_access,
             inherit_sys_path=True,
-            extra={"RAY_TPU_AUTHKEY": self._authkey.hex()})
+            extra=extra)
         slot.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.runtime.worker_process",
              self._listener.address, self.store.arena.name,
@@ -426,8 +470,11 @@ class NodeDaemon:
         with self._lock:
             gone = self._slots.pop(slot.num, None)
         if gone is not None and not self._shutdown:
+            from ray_tpu._private import log_plane
+
+            tail = log_plane.err_tail_message(slot.err_path)
             self._send_head(("worker_died", slot.num,
-                             slot.proc.returncode))
+                             slot.proc.returncode, tail))
 
     def _accept_loop(self) -> None:
         from multiprocessing import AuthenticationError
@@ -547,6 +594,72 @@ class NodeDaemon:
             self._send_head(("fetched", fid, False, None))
         else:
             self._send_head(("fetched", fid, True, sobj.to_bytes()))
+
+    # ------------------------------------------------------------------
+    # log plane: queries + tailer (ship appended lines to the head)
+    # ------------------------------------------------------------------
+    def _serve_log_list(self, rid: int) -> None:
+        from ray_tpu._private import log_plane
+
+        self._send_head(("log_listed", rid,
+                         log_plane.list_log_files(self.log_dir)))
+
+    def _serve_log_read(self, rid: int, filename: str,
+                        tail: Optional[int]) -> None:
+        from ray_tpu._private import log_plane
+
+        try:
+            text = log_plane.read_log(self.log_dir, filename, tail)
+            self._send_head(("log_data", rid, True, text))
+        except (OSError, ValueError) as e:
+            self._send_head(("log_data", rid, False, str(e)))
+
+    def _log_tail_loop(self) -> None:
+        """Ship appended capture-file lines to the head every ~0.3s.
+
+        Reads bytes past the last shipped offset per file, splits
+        complete lines and batches them as ("log", fname, lines). The
+        head's LogMonitor attributes and re-emits them; when log
+        streaming is off the head just drops them. Partial trailing
+        lines stay unshipped until their newline arrives (and a
+        bounded per-tick read keeps one spamming worker from wedging
+        the daemon's send lock)."""
+        import time as _time
+
+        while not self._shutdown:
+            _time.sleep(0.3)
+            try:
+                names = sorted(os.listdir(self.log_dir))
+            except OSError:
+                continue
+            for n in names:
+                if not (n.endswith(".out") or n.endswith(".err")):
+                    continue
+                path = os.path.join(self.log_dir, n)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                pos = self._log_offsets.get(n, 0)
+                if size < pos:  # rotated underneath us
+                    pos = 0
+                if size == pos:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(pos)
+                        data = f.read(1 << 20)
+                except OSError:
+                    continue
+                last_nl = data.rfind(b"\n")
+                if last_nl < 0:
+                    self._log_offsets[n] = pos
+                    continue
+                self._log_offsets[n] = pos + last_nl + 1
+                lines = data[:last_nl].decode(
+                    "utf-8", "replace").split("\n")
+                if lines:
+                    self._send_head(("log", n, lines))
 
     # ------------------------------------------------------------------
     # peer transfer plane (direct node-to-node pulls)
@@ -792,6 +905,8 @@ class NodeDaemon:
     def run(self) -> None:
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="ray_tpu_node_accept").start()
+        threading.Thread(target=self._log_tail_loop, daemon=True,
+                         name="ray_tpu_node_log_tail").start()
         while not self._shutdown:
             try:
                 msg = self._head.recv()
@@ -818,7 +933,7 @@ class NodeDaemon:
                     "head rejected this node: %s", msg[1])
                 break
             if kind == "spawn":
-                self._spawn(msg[1])
+                self._spawn(msg[1], msg[2] if len(msg) > 2 else None)
             elif kind == "to_w":
                 num, payload = msg[1], msg[2]
                 with self._lock:
@@ -881,6 +996,17 @@ class NodeDaemon:
                 threading.Thread(
                     target=self._serve_fetch, args=(msg[1], msg[2]),
                     daemon=True, name="ray_tpu_node_fetch").start()
+            elif kind == "log_list":
+                # off the run loop, like fetch: disk reads must not
+                # stall task dispatch for the node
+                threading.Thread(
+                    target=self._serve_log_list, args=(msg[1],),
+                    daemon=True, name="ray_tpu_node_log_list").start()
+            elif kind == "log_read":
+                threading.Thread(
+                    target=self._serve_log_read,
+                    args=(msg[1], msg[2], msg[3]),
+                    daemon=True, name="ray_tpu_node_log_read").start()
             elif kind == "free":
                 for b in msg[1]:
                     self.store.free_object(ObjectID(b))
@@ -996,6 +1122,12 @@ def _main(argv) -> None:
     self-started with token "join" by `ray_tpu start --address=...`
     on another machine."""
     import json
+
+    # capture this daemon's own stdout/stderr first (dup2) when the
+    # spawner asked for it — import/startup failures land in the file
+    from ray_tpu._private import log_plane
+
+    log_plane.redirect_stdio_from_env()
 
     host, port, token = argv[0], int(argv[1]), argv[2]
     mem, inline_max = int(argv[3]), int(argv[4])

@@ -30,7 +30,7 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as rex
-from ray_tpu._private import spawn_env
+from ray_tpu._private import log_plane, spawn_env
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
@@ -114,7 +114,7 @@ class _Handle:
                  "inflight", "borrows",
                  "sent_fns", "dead", "force_cancel_id", "timeout_cancel_id",
                  "chaos_kill", "send_lock",
-                 "ready", "actor_rt", "oom_kill")
+                 "ready", "actor_rt", "oom_kill", "log_paths")
 
     def __init__(self, worker_num: int):
         self.actor_rt = None  # set for dedicated actor workers
@@ -138,6 +138,9 @@ class _Handle:
         self.chaos_kill = False       # chaos plane SIGKILLed this worker
         self.send_lock = threading.Lock()
         self.ready = False
+        # (out_path, err_path) of the capture files, when the session
+        # log dir exists — used to attach a crash's .err tail
+        self.log_paths: Optional[Tuple[str, str]] = None
 
 
 class ProcessWorkerPool:
@@ -221,10 +224,20 @@ class ProcessWorkerPool:
         # processes skip the site-level TPU plugin bootstrap, which
         # costs seconds of import, a device-lease fight, and (with a
         # degraded tunnel) an indefinite hang at `import jax`
+        extra = {"RAY_TPU_AUTHKEY": self._authkey.hex()}
+        log_dir = log_plane.get_session_log_dir()
+        if log_dir:
+            stem = f"worker-{h.worker_id.hex()[:12]}"
+            log_env = log_plane.child_log_env(
+                log_dir, stem, GLOBAL_CONFIG.log_rotation_bytes,
+                GLOBAL_CONFIG.log_rotation_backups)
+            h.log_paths = (log_env[log_plane.ENV_LOG_OUT],
+                           log_env[log_plane.ENV_LOG_ERR])
+            extra.update(log_env)
         env = spawn_env.child_env(
             use_accelerator=GLOBAL_CONFIG.worker_tpu_access,
             inherit_sys_path=True,
-            extra={"RAY_TPU_AUTHKEY": self._authkey.hex()})
+            extra=extra)
         h.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.runtime.worker_process",
              self._listener.address, self._shm.arena.name,
@@ -238,6 +251,15 @@ class ProcessWorkerPool:
     def _monitor_proc(self, h: _Handle) -> None:
         h.proc.wait()
         self._on_worker_failure(h, f"exit code {h.proc.returncode}")
+
+    @staticmethod
+    def _err_tail(h: _Handle) -> str:
+        """Last lines of the dead worker's .err capture — the actual
+        crash traceback — appended to WorkerCrashedError messages so
+        the real cause surfaces instead of just "worker died"."""
+        if h.log_paths is None:
+            return ""
+        return log_plane.err_tail_message(h.log_paths[1])
 
     def _accept_loop(self) -> None:
         from multiprocessing import AuthenticationError
@@ -907,11 +929,12 @@ class ProcessWorkerPool:
                 elif h.chaos_kill:
                     exc = rex.WorkerCrashedError(
                         f"worker process {h.pid} killed while running "
-                        f"{spec.name} (chaos worker kill)")
+                        f"{spec.name} (chaos worker kill)"
+                        + self._err_tail(h))
                 else:
                     exc = rex.WorkerCrashedError(
                         f"worker process {h.pid} died while running "
-                        f"{spec.name}: {cause}")
+                        f"{spec.name}: {cause}" + self._err_tail(h))
                 retry = self._worker._handle_task_failure(
                     spec, inf.return_ids, exc)
                 self._finish_task(inf.pending, exec_id, retry)

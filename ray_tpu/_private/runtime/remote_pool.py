@@ -94,6 +94,7 @@ class RemoteNodePool(ProcessWorkerPool):
         self._hqueues: Dict[int, queue.Queue] = {}
         self._fetches: Dict[int, Tuple[threading.Event, list]] = {}
         self._pings: Dict[int, Tuple[threading.Event, list]] = {}
+        self._logreqs: Dict[int, Tuple[threading.Event, list]] = {}
         self._req_seq = 0
         self._req_lock = threading.Lock()
         # blocking worker RPCs (get/wait) must not stall the demux
@@ -141,7 +142,13 @@ class RemoteNodePool(ProcessWorkerPool):
             elif kind == "worker_died":
                 q = self._hqueues.get(msg[1])
                 if q is not None:
-                    q.put(("__died__", msg[2]))
+                    # msg may carry the worker's .err tail (the remote
+                    # crash traceback) — fold it into the cause so
+                    # WorkerCrashedError surfaces the real reason
+                    cause = f"exit code {msg[2]}"
+                    if len(msg) > 3 and msg[3]:
+                        cause += msg[3]
+                    q.put(("__died__", cause))
             elif kind == "fetched":
                 slot = self._fetches.pop(msg[1], None)
                 if slot is not None:
@@ -152,11 +159,21 @@ class RemoteNodePool(ProcessWorkerPool):
                 if slot is not None:
                     slot[1][:] = [msg[2]]
                     slot[0].set()
+            elif kind == "log":
+                # appended capture lines shipped by the daemon's tailer
+                lm = getattr(self._worker, "log_monitor", None)
+                if lm is not None:
+                    lm.on_remote_lines(self, msg[1], msg[2])
+            elif kind in ("log_listed", "log_data"):
+                slot = self._logreqs.pop(msg[1], None)
+                if slot is not None:
+                    slot[1][:] = list(msg[2:])
+                    slot[0].set()
 
     def _on_daemon_lost(self) -> None:
         self._conn_dead = True
-        # unblock fetch/ping waiters
-        for table in (self._fetches, self._pings):
+        # unblock fetch/ping/log waiters
+        for table in (self._fetches, self._pings, self._logreqs):
             for ev, _slot in list(table.values()):
                 ev.set()
             table.clear()
@@ -213,7 +230,9 @@ class RemoteNodePool(ProcessWorkerPool):
             self._by_num[num] = h
         threading.Thread(target=self._queue_loop, args=(h, q), daemon=True,
                          name=f"ray_tpu_remote_w{num}").start()
-        self._send_daemon(("spawn", num))
+        # the wid names the worker's capture files daemon-side, so log
+        # filenames look identical on local and remote nodes
+        self._send_daemon(("spawn", num, h.worker_id.hex()[:12]))
         return h
 
     def adopt_worker(self, num: int, pid: Optional[int],
@@ -348,6 +367,44 @@ class RemoteNodePool(ProcessWorkerPool):
 
     def free_remote(self, oids: List[ObjectID]) -> None:
         self._send_daemon(("free", [o.binary() for o in oids]))
+
+    # -- log plane queries ---------------------------------------------
+    def _log_request(self, msg_tail: tuple,
+                     timeout: float) -> Optional[list]:
+        """One request/reply round-trip on the daemon link (same slot
+        idiom as fetch_object/_ping)."""
+        if self._conn_dead:
+            return None
+        rid = self._next_req()
+        ev: threading.Event = threading.Event()
+        slot: list = []
+        self._logreqs[rid] = (ev, slot)
+        if self._conn_dead:
+            self._logreqs.pop(rid, None)
+            return None
+        self._send_daemon((msg_tail[0], rid) + msg_tail[1:])
+        if not ev.wait(timeout) or not slot:
+            self._logreqs.pop(rid, None)
+            return None
+        return slot
+
+    def list_logs_remote(self, timeout: float = 5.0) -> List[dict]:
+        """{filename, size_bytes, mtime} rows from the node's log dir."""
+        slot = self._log_request(("log_list",), timeout)
+        return slot[0] if slot else []
+
+    def fetch_log_remote(self, filename: str, tail: Optional[int] = None,
+                         timeout: float = 5.0) -> str:
+        """Read a capture file off the node. Raises on daemon-side
+        errors (bad filename, missing file) and unreachable daemons."""
+        slot = self._log_request(("log_read", filename, tail), timeout)
+        if slot is None:
+            raise rex.NodeDiedError(
+                f"node {self.node_id.hex()[:16]} unreachable for log read")
+        ok, text = slot
+        if not ok:
+            raise FileNotFoundError(text)
+        return text
 
     def _resolve_for_ship(self, v: Any) -> Any:
         if not isinstance(v, ObjectRef):

@@ -599,6 +599,13 @@ def _main(argv: List[str]) -> None:
     fork-unsafe parent state (jax/TPU clients, threads) is never
     inherited. Connects back over AF_UNIX with an HMAC authkey handshake.
     """
+    # Capture stdout/stderr FIRST (dup2 onto fds 1/2) so every later
+    # byte — prints, import errors, interpreter crash tracebacks —
+    # lands in the session log files the pool named for us.
+    from ray_tpu._private import log_plane
+
+    log_plane.redirect_stdio_from_env()
+
     from multiprocessing.connection import Client
 
     address, arena_name, inline_max, worker_num = (

@@ -340,7 +340,8 @@ class Worker:
                  num_workers: Optional[int] = None,
                  scheduler_factory: Optional[Callable] = None,
                  job_id: Optional[JobID] = None,
-                 resources: Optional[Dict[str, float]] = None):
+                 resources: Optional[Dict[str, float]] = None,
+                 log_to_driver: bool = True):
         self.job_id = job_id or JobID.from_random()
         self.worker_id = WorkerID.from_random()
         self.alive = True
@@ -364,6 +365,22 @@ class Worker:
         self.num_workers = nworkers
         capacity_cpu = num_cpus if num_cpus is not None else float(nworkers)
         self._pool = _WorkQueue(nworkers)
+
+        # log plane: resolve the session log directory BEFORE any pool
+        # can exec a worker — spawners name each child's capture files
+        # inside it. A `log_dir` knob that is set but unusable raises
+        # (loud by design); the default /tmp path degrades to
+        # capture-off with a warning.
+        from ray_tpu._private import log_plane
+        self.session_log_dir: Optional[str] = None
+        if GLOBAL_CONFIG.log_capture:
+            try:
+                self.session_log_dir = log_plane.resolve_session_log_dir(
+                    GLOBAL_CONFIG.log_dir)
+            except OSError as e:
+                logger.warning("log capture disabled: cannot create "
+                               "session log dir (%s)", e)
+        log_plane.set_session_log_dir(self.session_log_dir)
 
         # P3 multi-process node runtime: process workers + shm object store
         # (reference: raylet WorkerPool + plasma). Thread mode keeps the
@@ -458,6 +475,32 @@ class Worker:
                 logger.warning("metrics endpoint disabled: cannot bind "
                                "port %d (%s)",
                                GLOBAL_CONFIG.metrics_export_port, e)
+
+        # log plane: announce the session dir in the GCS KV (clients /
+        # tools discover it there), mirror control-plane log records
+        # into logs/gcs.out, and start the driver-streaming monitor
+        self.log_to_driver = log_to_driver
+        self.log_monitor = None
+        self._gcs_log_handler = None
+        if self.session_log_dir is not None:
+            self.gcs.kv_put(b"session_log_dir",
+                            self.session_log_dir.encode(),
+                            namespace="session")
+            import logging as _logging
+            try:
+                h = _logging.FileHandler(
+                    os.path.join(self.session_log_dir, "gcs.out"),
+                    delay=True)
+                h.setFormatter(_logging.Formatter(
+                    "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+                h.setLevel(_logging.INFO)
+                _logging.getLogger("ray_tpu").addHandler(h)
+                self._gcs_log_handler = h
+            except OSError:
+                pass
+            if log_to_driver:
+                from ray_tpu._private.log_monitor import LogMonitor
+                self.log_monitor = LogMonitor(self, self.session_log_dir)
 
         # actors: ActorID -> _ActorRuntime (see actor.py)
         self.actors: Dict[ActorID, Any] = {}
@@ -1241,10 +1284,23 @@ class Worker:
         # the daemon (and the workers it spawns) never owns the head's
         # chip lease; strip accelerator plugin vars so a degraded tunnel
         # can't hang its `import jax` (see spawn_env docstring)
-        from ray_tpu._private import spawn_env
+        from ray_tpu._private import log_plane, spawn_env
+        extra = {"RAY_TPU_HEAD_AUTHKEY": self._head_server.authkey.hex()}
+        if self.session_log_dir is not None:
+            # the daemon's own node log dir nests under the head's
+            # session dir (same-host clusters; a true remote host just
+            # creates the path locally), and the daemon's own
+            # stdout/stderr capture files live inside it
+            node_dir = os.path.join(self.session_log_dir,
+                                    f"node-{token[:8]}")
+            extra["RAY_TPU_LOG_DIR"] = node_dir
+            extra.update(log_plane.child_log_env(
+                node_dir, f"node_daemon-{token[:8]}",
+                GLOBAL_CONFIG.log_rotation_bytes,
+                GLOBAL_CONFIG.log_rotation_backups))
         env = spawn_env.child_env(
             inherit_sys_path=True,
-            extra={"RAY_TPU_HEAD_AUTHKEY": self._head_server.authkey.hex()})
+            extra=extra)
         host, port = self._head_server.address
         import json as _json
         info = _json.dumps({"num_cpus": num_cpus, "num_tpus": num_tpus,
@@ -2033,6 +2089,22 @@ class Worker:
         self.alive = False
         with self._deadline_cv:
             self._deadline_cv.notify_all()  # release the watcher promptly
+        if self.log_monitor is not None:
+            # stop BEFORE the pools die: the final sweep re-emits any
+            # trailing captured output while the files still matter
+            self.log_monitor.stop()
+        if self._gcs_log_handler is not None:
+            import logging as _logging
+            _logging.getLogger("ray_tpu").removeHandler(
+                self._gcs_log_handler)
+            try:
+                self._gcs_log_handler.close()
+            except Exception:
+                pass
+            self._gcs_log_handler = None
+        from ray_tpu._private import log_plane
+        if log_plane.get_session_log_dir() == self.session_log_dir:
+            log_plane.set_session_log_dir(None)
         self._drain_out_of_scope()
         self.placement_groups.shutdown()
         with self._actors_lock:
@@ -2166,6 +2238,7 @@ def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
          scheduler: Optional[str] = None, ignore_reinit_error: bool = False,
          resources: Optional[Dict[str, float]] = None,
          address: Optional[str] = None,
+         log_to_driver: bool = True,
          _system_config: Optional[dict] = None, **kwargs) -> "Worker":
     global global_worker
     with _init_lock:
@@ -2206,7 +2279,8 @@ def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
         GLOBAL_CONFIG.freeze()
         global_worker = Worker(num_cpus=num_cpus, num_workers=num_workers,
                                scheduler_factory=scheduler_factory,
-                               resources=resources)
+                               resources=resources,
+                               log_to_driver=log_to_driver)
         if GLOBAL_CONFIG.gc_tuning:
             # see the config knob's docstring (including the freeze
             # caveat); shutdown() undoes both, restoring the HOST

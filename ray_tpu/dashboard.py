@@ -67,6 +67,12 @@ td[class^="st-"]::before { content: ""; display: inline-block; width: 8px;
   vertical-align: baseline; background: var(--critical); }
 td.st-alive::before, td.st-running::before { background: var(--good); }
 .links a { color: var(--text-secondary); margin-right: 10px; }
+#logfiles a { color: var(--series-1); margin-right: 14px;
+  text-decoration: none; }
+#logview { background: var(--surface-2); border-radius: 8px;
+  padding: 10px 14px; max-width: 880px; max-height: 320px;
+  overflow: auto; white-space: pre-wrap; font: 12px/1.4 ui-monospace,
+  monospace; display: none; }
 #chartwrap { position: relative; max-width: 880px; }
 #tp-tip { position: absolute; pointer-events: none; display: none;
   background: var(--surface-2); border: 1px solid var(--grid);
@@ -84,11 +90,14 @@ td.st-alive::before, td.st-running::before { background: var(--good); }
 <div class="panel"><h2>Task summary</h2><div id="tasks"></div></div>
 <div class="panel"><h2>Actors</h2><div id="actors"></div></div>
 <div class="panel"><h2>Data streams</h2><div id="streams"></div></div>
+<div class="panel"><h2>Logs</h2><div id="logfiles" class="sub"></div>
+<pre id="logview"></pre></div>
 <div class="panel links"><h2>Raw endpoints</h2>
 <a href="/api/summary">summary</a><a href="/api/tasks">tasks</a>
 <a href="/api/actors">actors</a><a href="/api/objects">objects</a>
 <a href="/api/nodes">nodes</a><a href="/api/placement_groups">pgs</a>
 <a href="/api/data_streams">streams</a>
+<a href="/api/logs">logs</a>
 <a href="/api/jobs">jobs</a><a href="/metrics">metrics</a></div>
 <script>
 "use strict";
@@ -176,12 +185,42 @@ function drawChart() {
   };
 }
 
+// Log viewer: file list is built with DOM nodes and the file body is
+// assigned via textContent — log content (worker prints, tracebacks)
+// can never render as markup (same escaping discipline as esc()).
+async function refreshLogs() {
+  const files = await fetch("/api/logs").then(r => r.json());
+  const el = document.getElementById("logfiles");
+  el.replaceChildren();
+  if (!files.length) { el.textContent = "no log files"; return; }
+  for (const f of files.slice(0, 60)) {
+    const a = document.createElement("a");
+    a.href = "#";
+    a.textContent = f.filename + " · " + f.size_bytes + "B · " +
+      String(f.node_id || "").slice(0, 8);
+    a.onclick = (ev) => { ev.preventDefault(); viewLog(f); };
+    el.appendChild(a);
+  }
+}
+
+async function viewLog(f) {
+  const r = await fetch("/api/log_file?filename=" +
+    encodeURIComponent(f.filename) + "&node_id=" +
+    encodeURIComponent(f.node_id || "") + "&tail=500")
+    .then(r => r.json());
+  const pre = document.getElementById("logview");
+  pre.style.display = "block";
+  pre.textContent = "--- " + f.filename + " ---\n" +
+    (r.lines ? r.lines.join("\n") : "error: " + r.error);
+}
+
 async function refresh() {
   try {
     const [s, actors] = await Promise.all([
       fetch("/api/summary").then(r => r.json()),
       fetch("/api/actors").then(r => r.json()),
     ]);
+    refreshLogs().catch(() => {});
     const nodes = s.nodes || [];
     document.getElementById("addr").textContent =
       "cluster overview \u00b7 refreshes every 2s";
@@ -259,6 +298,7 @@ class Dashboard:
             "/api/placement_groups":
                 lambda: state.list_placement_groups(),
             "/api/data_streams": lambda: state.list_data_streams(),
+            "/api/logs": lambda: state.list_logs(),
             "/api/jobs": lambda: {
                 j.hex(): meta
                 for j, meta in worker.gcs.job_table().items()},
@@ -274,24 +314,46 @@ class Dashboard:
             },
         }
 
+        def log_file(query) -> dict:
+            """/api/log_file?filename=...&node_id=...&tail=N — one
+            capture file's lines as JSON (the UI sets them via
+            textContent, so content never renders as markup)."""
+            filename = (query.get("filename") or [""])[0]
+            node_id = (query.get("node_id") or [""])[0] or None
+            tail_q = (query.get("tail") or [""])[0]
+            tail = int(tail_q) if tail_q else None
+            text = state.get_log(filename, node_id=node_id, tail=tail)
+            return {"filename": filename, "node_id": node_id,
+                    "lines": text.split("\n")}
+
+        query_routes = {
+            "/api/log_file": log_file,
+        }
+
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
-                if self.path == "/" or self.path == "/index.html":
+                from urllib.parse import parse_qs, urlsplit
+
+                parts = urlsplit(self.path)
+                path = parts.path
+                if path == "/" or path == "/index.html":
                     self._send(200, _INDEX.encode(), "text/html")
                     return
-                if self.path == "/metrics":
+                if path == "/metrics":
                     from ray_tpu._private.metrics import render_all
 
                     self._send(200, render_all(worker).encode(),
                                "text/plain; version=0.0.4")
                     return
-                fn = routes.get(self.path)
-                if fn is None:
+                qfn = query_routes.get(path)
+                fn = routes.get(path)
+                if qfn is None and fn is None:
                     self._send(404, b'{"error": "not found"}')
                     return
                 try:
-                    body = json.dumps(fn()).encode()
-                    self._send(200, body)
+                    data = (qfn(parse_qs(parts.query))
+                            if qfn is not None else fn())
+                    self._send(200, json.dumps(data).encode())
                 except Exception as e:  # noqa: BLE001
                     self._send(500,
                                json.dumps({"error": str(e)}).encode())

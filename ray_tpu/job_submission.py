@@ -49,7 +49,12 @@ class JobSubmissionClient:
     """submit_job/get_job_status/get_job_logs/stop_job/list_jobs."""
 
     def __init__(self, jobs_dir: Optional[str] = None):
-        self._dir = jobs_dir or tempfile.mkdtemp(prefix="ray_tpu_jobs_")
+        # job driver output belongs in the session log dir when a
+        # runtime is up: `job-<id>.out` sits next to the worker capture
+        # files, so list_logs / the CLI / the dashboard see it too
+        from ray_tpu._private import log_plane
+        self._dir = (jobs_dir or log_plane.get_session_log_dir()
+                     or tempfile.mkdtemp(prefix="ray_tpu_jobs_"))
         self._jobs: Dict[str, _Job] = {}
         self._lock = threading.Lock()
 
@@ -59,7 +64,7 @@ class JobSubmissionClient:
                    env_vars: Optional[Dict[str, str]] = None,
                    metadata: Optional[Dict[str, str]] = None) -> str:
         job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
-        log_path = os.path.join(self._dir, f"{job_id}.log")
+        log_path = os.path.join(self._dir, f"{job_id}.out")
         job = _Job(job_id, entrypoint, log_path, metadata)
         # job drivers talk to the cluster over ray:// — the head owns
         # the chip lease, so jobs default to CPU jax with the

@@ -10,7 +10,8 @@ that reads back the scheduler tensors").
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List
+import inspect
+from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import worker as worker_mod
 
@@ -19,13 +20,19 @@ def _client_dispatch(fn):
     """In client mode, run the verb HEAD-side over the session (the GCS
     client accessor analog — `ray list ...` from any process). The
     driver-side body below each decorated function only ever executes
-    in-process, where worker.scheduler/.gcs exist."""
+    in-process, where worker.scheduler/.gcs exist. Arguments (e.g.
+    get_log's filename/node_id/tail) normalize to positionals so they
+    ride the client's ("state", verb, *args) RPC unchanged."""
+    sig = inspect.signature(fn)
+
     @functools.wraps(fn)
-    def wrapper():
+    def wrapper(*args, **kwargs):
         w = worker_mod.get_worker()
         if getattr(w, "is_client", False):
-            return w.state(fn.__name__)
-        return fn()
+            bound = sig.bind(*args, **kwargs)
+            bound.apply_defaults()
+            return w.state(fn.__name__, *bound.args)
+        return fn(*args, **kwargs)
     return wrapper
 
 
@@ -109,6 +116,69 @@ def list_data_streams() -> List[Dict[str, Any]]:
     from ray_tpu.data._streaming import split_coordinator_stats
 
     return split_coordinator_stats()
+
+
+def _remote_log_node(w, node_id: str):
+    """The GCS entry for an off-head node addressed by id hex (prefix
+    match allowed, like the CLI's id handling elsewhere)."""
+    for e in w.gcs.node_table():
+        if e.node_id.hex().startswith(node_id):
+            return e
+    raise ValueError(f"unknown node_id: {node_id!r}")
+
+
+@_client_dispatch
+def list_logs(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Capture files in the session log dir, one row per file:
+    {filename, size_bytes, mtime, node_id}. ``node_id=None`` spans the
+    whole cluster (head dir + every remote node's dir, queried over
+    the daemon links)."""
+    from ray_tpu._private import log_plane
+
+    w = worker_mod.get_worker()
+    head_hex = w.node_id.hex()
+
+    def _head_rows() -> List[Dict[str, Any]]:
+        if w.session_log_dir is None:
+            return []
+        return [dict(r, node_id=head_hex)
+                for r in log_plane.list_log_files(w.session_log_dir)]
+
+    if node_id is None:
+        rows = _head_rows()
+        for e in w.gcs.node_table():
+            if e.kind == "remote" and e.state == "ALIVE" \
+                    and e.pool is not None:
+                rows.extend(dict(r, node_id=e.node_id.hex())
+                            for r in e.pool.list_logs_remote())
+        return rows
+    if head_hex.startswith(node_id):
+        return _head_rows()
+    e = _remote_log_node(w, node_id)
+    if e.kind != "remote" or e.pool is None:
+        # local virtual nodes share the head's session dir
+        return _head_rows()
+    return [dict(r, node_id=e.node_id.hex())
+            for r in e.pool.list_logs_remote()]
+
+
+@_client_dispatch
+def get_log(filename: str, node_id: Optional[str] = None,
+            tail: Optional[int] = None) -> str:
+    """Contents of one capture file (last ``tail`` lines when set).
+    ``node_id=None`` / the head's id reads the head session dir;
+    an off-head id fetches over that node's daemon link."""
+    from ray_tpu._private import log_plane
+
+    w = worker_mod.get_worker()
+    if node_id is not None and not w.node_id.hex().startswith(node_id):
+        e = _remote_log_node(w, node_id)
+        if e.kind == "remote" and e.pool is not None:
+            return e.pool.fetch_log_remote(filename, tail)
+    if w.session_log_dir is None:
+        raise FileNotFoundError("log capture is disabled (no session "
+                                "log dir)")
+    return log_plane.read_log(w.session_log_dir, filename, tail)
 
 
 @_client_dispatch
