@@ -363,6 +363,41 @@ def main() -> int:
         OUT["log_overhead"] = lo or None
         _emit()
 
+    # --- task event plane: lifecycle telemetry overhead ----------------
+    # A/B of the e2e harness with the task event aggregator disabled
+    # (RAY_TPU_TASK_EVENTS_MAX=0 — no submit/ready/dispatch/finish
+    # recording, no worker-side exec timestamps). The e2e numbers above
+    # ran with events ON (the default); the claim under test is that the
+    # telemetry stays within ~10% of the unrecorded path — on the
+    # BATCHED lanes, where per-task bookkeeping is most exposed.
+    if section("task_event_overhead", 25):
+        teo = {}
+        for label, mode, n, batched in (
+                ("thread_batched", "thread", n_thread, True),
+                ("process_batched", "process", n_proc, True)):
+            try:
+                on = e2e.get(label)
+                if on is None:
+                    on = round(_e2e_subprocess(n, mode, batched)
+                               ["tasks_per_sec"], 1)
+                off = round(_e2e_subprocess(
+                    n, mode, batched,
+                    extra_env={"RAY_TPU_TASK_EVENTS_MAX": "0"})
+                    ["tasks_per_sec"], 1)
+                teo[label] = {
+                    "events_on_tasks_per_sec": on,
+                    "events_off_tasks_per_sec": off,
+                    "overhead_pct": round(100.0 * (off - on) / off, 1),
+                }
+                print(f"  task event overhead[{label}]: {on:.0f} "
+                      f"tasks/s with events vs {off:.0f} without "
+                      f"({teo[label]['overhead_pct']}%)",
+                      file=sys.stderr)
+            except Exception:
+                traceback.print_exc()
+        OUT["task_event_overhead"] = teo or None
+        _emit()
+
     # --- model perf: step time / tokens/s / MFU ------------------------
     if section("mfu", 25 if device_smoke else 90):
         try:

@@ -60,11 +60,23 @@ def restore_cluster(path: str) -> dict:
 
 def timeline(filename: Optional[str] = None):
     """Chrome-trace events for task execution (reference: ray.timeline);
-    writes JSON to filename when given, else returns the event list."""
-    events = _worker.get_worker().events
+    writes JSON to filename when given, else returns the event list.
+
+    Sourced from the cluster-wide task event plane: per task, a dep-wait
+    span and a queue span on the scheduler lane plus an exec span on the
+    owning (node, worker) lane — remote-node timestamps aligned onto the
+    head's clock via the daemon handshake offset. Retries and failures
+    appear as instant events. Works over ray:// (renders head-side)."""
+    from ray_tpu.util.state import task_timeline
+
+    events = task_timeline()
     if filename is not None:
-        return events.dump_timeline(filename)
-    return events.timeline()
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(events, f)
+        return filename
+    return events
 
 
 def init(*args, **kwargs):

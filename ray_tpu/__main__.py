@@ -227,6 +227,30 @@ def _cmd_logs(args) -> int:
     return 0
 
 
+def _cmd_timeline(args) -> int:
+    """Dump the cluster's chrome-trace timeline to a JSON file
+    (reference: `ray timeline`). Connects over ray:// so the trace is
+    rendered head-side from the task event plane — spans from every
+    node land on one aligned clock axis."""
+    if not args.address:
+        print("timeline needs --address ray://host:port?key=... "
+              "(printed by `python -m ray_tpu start --head`)",
+              file=sys.stderr)
+        return 2
+    import ray_tpu
+
+    ray_tpu.init(address=args.address)
+    try:
+        path = ray_tpu.timeline(args.output)
+        with open(path) as f:
+            n = len(json.load(f))
+        print(f"wrote {path} ({n} events) — open in "
+              f"chrome://tracing or https://ui.perfetto.dev")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
 def _cmd_summary(args) -> int:
     """Summarize a timeline JSON produced by ray_tpu.timeline()."""
     with open(args.trace) as f:
@@ -310,6 +334,14 @@ def main(argv=None) -> int:
                    help="explicit session logs dir (default: newest "
                    "/tmp/ray_tpu/session_*/logs)")
     p.set_defaults(fn=_cmd_logs)
+
+    p = sub.add_parser("timeline", help="dump the cluster task "
+                       "timeline (chrome-trace JSON)")
+    p.add_argument("-o", "--output", default="trace.json",
+                   help="output path (default: trace.json)")
+    p.add_argument("--address", default="",
+                   help="ray://host:port?key=... of a running head")
+    p.set_defaults(fn=_cmd_timeline)
 
     p = sub.add_parser("summary", help="summarize a timeline trace")
     p.add_argument("trace", help="JSON from ray_tpu.timeline(file)")

@@ -56,7 +56,7 @@ class ClientSession:
 _STATE_VERBS = frozenset({
     "list_tasks", "list_actors", "list_objects", "list_nodes",
     "list_placement_groups", "summarize_tasks", "list_data_streams",
-    "list_faults", "list_logs", "get_log",
+    "list_faults", "list_logs", "get_log", "task_timeline",
 })
 
 
@@ -115,10 +115,15 @@ class ClientServer:
             except Exception:
                 pass
         s.pinned.clear()
-        try:
-            s.conn.close()
-        except Exception:
-            pass
+        # close under the send lock: a late reply thread that already
+        # passed Connection's closed-check must finish its write before
+        # the fd is freed, or the write can land on a recycled fd (a
+        # brand-new client's socket, corrupting its auth handshake)
+        with s.send_lock:
+            try:
+                s.conn.close()
+            except Exception:
+                pass
 
     def _handle(self, s: ClientSession, op: str, req_id: int,
                 payload: tuple) -> None:
@@ -391,8 +396,9 @@ class ClientWorker:
         if ready != ("ready",):
             raise ConnectionError("head did not acknowledge the client "
                                   f"session (got {ready!r})")
-        threading.Thread(target=self._reader, daemon=True,
-                         name="ray_tpu_client_reader").start()
+        self._reader_thread = threading.Thread(
+            target=self._reader, daemon=True, name="ray_tpu_client_reader")
+        self._reader_thread.start()
 
     # -- transport ----------------------------------------------------
     def _reader(self) -> None:
@@ -612,10 +618,35 @@ class ClientWorker:
     # -- lifecycle -------------------------------------------------------
     def shutdown(self) -> None:
         self.alive = False
+        # close() alone cannot interrupt a reader blocked in recv: the
+        # blocked syscall pins the open file description, so the socket
+        # never sends FIN (the head's serve thread lingers forever) while
+        # the freed fd NUMBER gets recycled to the next init()'s socket —
+        # where the stale reader then steals handshake bytes ("bad
+        # message length" / wrong-digest auth failures). A socket-level
+        # SHUT_RDWR acts on the shared description and DOES wake the
+        # reader with EOF; join it before closing so the fd cannot be
+        # recycled under a thread that still references it.
         try:
-            self._conn.close()
+            import os as _os
+            import socket as _socket
+            dup = _socket.socket(fileno=_os.dup(self._conn.fileno()))
+            try:
+                dup.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            finally:
+                dup.close()
         except Exception:
             pass
+        r = getattr(self, "_reader_thread", None)
+        if r is not None and r is not threading.current_thread():
+            r.join(timeout=2.0)
+        with self._send_lock:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
 
 
 def parse_client_address(address: str) -> Tuple[str, int, Optional[bytes]]:

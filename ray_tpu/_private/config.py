@@ -243,6 +243,12 @@ _d("log_to_driver_rate", int, 2000,
    "excess lines are dropped with a surfaced drop count")
 _d("metrics_export_port", int, 0, "prometheus text endpoint port; 0 = disabled")
 _d("event_buffer_size", int, 65536, "profile/trace event ring size per worker")
+_d("task_events_max", int, 16384,
+   "bounded ring of FINISHED/FAILED task event records kept head-side "
+   "(feeds state.list_tasks(detail=True) and ray_tpu.timeline()); "
+   "eviction drops finished records before failed ones so failures "
+   "outlive successes; 0 disables task event recording entirely (the "
+   "bench A/B baseline)")
 
 # -- testing / fault injection --------------------------------------------
 _d("testing_inject_task_failure_prob", float, 0.0,

@@ -211,10 +211,21 @@ def _render_core(worker) -> List[str]:
          lm.lines_dropped if lm is not None else 0)
     from ray_tpu._private import log_plane
     log_dir = getattr(worker, "session_log_dir", None)
+    log_resident = (sum(r["size_bytes"]
+                        for r in log_plane.list_log_files(log_dir))
+                    if log_dir else 0)
+    emit("ray_tpu_log_bytes_resident", "gauge",
+         "bytes resident in this session's log capture files "
+         "(shrinks under log rotation)", log_resident)
     emit("ray_tpu_log_bytes_written_total", "counter",
-         "bytes resident in this session's log capture files",
-         sum(r["size_bytes"] for r in log_plane.list_log_files(log_dir))
-         if log_dir else 0)
+         "DEPRECATED: renamed to ray_tpu_log_bytes_resident (a gauge; "
+         "this value shrinks under rotation and was never a true "
+         "counter); will be removed next release", log_resident)
+
+    # task event plane: latency-breakdown histograms + failure counters
+    from ray_tpu._private import task_events
+    lines.extend(task_events.render_prometheus(
+        getattr(worker, "task_events", None)))
 
     from ray_tpu._private.chaos import get_controller
     chaos = get_controller().counters()

@@ -65,6 +65,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 from multiprocessing.connection import Client, Listener
 from typing import Any, Dict, Optional
 
@@ -422,6 +423,10 @@ class NodeDaemon:
             self._head.send(make_wire_hello(
                 node_token, os.getpid(), self.store.arena.name,
                 tuple(self.peer_address)))
+        # clock handshake: one wall/perf sample right after the hello;
+        # the head derives clock_offset = head_wall - daemon_wall so
+        # worker-side execution windows land on the head's time axis
+        self._head.send(("clock", time.time(), time.perf_counter()))
 
     # ------------------------------------------------------------------
     def _send_head(self, msg: tuple) -> None:
@@ -583,7 +588,9 @@ class NodeDaemon:
                     out.append(("remote_shm", entry[2]))
                 else:
                     out.append(entry)
-            return (msg[0], task_id_bin, out)
+            # preserve any trailing fields (e.g. the execution-window
+            # timing tuple the task event plane rides on)
+            return (msg[0], task_id_bin, out) + tuple(msg[3:])
         if kind == "err":
             slot.returns.pop(msg[1], None)
         return msg
@@ -1072,6 +1079,9 @@ class NodeDaemon:
                 continue
             with self._head_lock:
                 self._head = head
+            # re-run the clock handshake: the new head computes a fresh
+            # clock_offset for this link
+            self._send_head(("clock", time.time(), time.perf_counter()))
             return True
         return False
 

@@ -145,6 +145,10 @@ class _Handle:
 
 class ProcessWorkerPool:
     is_remote = False
+    # head_wall - node_wall at handshake; local pools share the head's
+    # clock. RemoteNodePool overwrites this from the daemon's "clock"
+    # message so worker execution windows land on the head's time axis.
+    clock_offset = 0.0
 
     def __init__(self, worker, num_workers: int, shm_store,
                  node_index: int = 0):
@@ -694,12 +698,15 @@ class ProcessWorkerPool:
                         # a worker's buffered batch completions
                         for sub in msg[1]:
                             if sub[0] == "done" and h.actor_rt is None:
-                                dones.append((h, TaskID(sub[1]), sub[2]))
+                                dones.append((h, TaskID(sub[1]), sub[2],
+                                              sub[3] if len(sub) > 3
+                                              else None))
                             else:
                                 dones = self._flush_dones_safe(dones)
                                 self._handle_worker_msg(h, sub)
                     elif kind == "done" and h.actor_rt is None:
-                        dones.append((h, TaskID(msg[1]), msg[2]))
+                        dones.append((h, TaskID(msg[1]), msg[2],
+                                      msg[3] if len(msg) > 3 else None))
                     else:
                         # per-worker message order is a protocol
                         # invariant (e.g. an rpc_put's borrow attaches
@@ -747,13 +754,15 @@ class ProcessWorkerPool:
                 if h.actor_rt is not None:
                     h.actor_rt._on_remote_done(TaskID(msg[1]), msg[2])
                 else:
-                    self._on_done(h, TaskID(msg[1]), msg[2])
+                    self._on_done(h, TaskID(msg[1]), msg[2],
+                                  msg[3] if len(msg) > 3 else None)
             elif kind == "err":
                 if h.actor_rt is not None:
                     h.actor_rt._on_remote_err(TaskID(msg[1]), msg[2],
                                               msg[3])
                 else:
-                    self._on_err(h, TaskID(msg[1]), msg[2], msg[3])
+                    self._on_err(h, TaskID(msg[1]), msg[2], msg[3],
+                                 msg[4] if len(msg) > 4 else None)
             elif kind == "rpc":
                 self._on_rpc(h, msg[1], msg[2], msg[3])
         except Exception:
@@ -801,13 +810,19 @@ class ProcessWorkerPool:
         for oid in self._store_entries(return_ids, entries):
             self._worker.scheduler.notify_object_ready(oid)
 
-    def _on_done(self, h: _Handle, task_id: TaskID, entries: list) -> None:
+    def _on_done(self, h: _Handle, task_id: TaskID, entries: list,
+                 timing=None) -> None:
         inf = h.inflight.get(task_id)
         if inf is None:
             return  # force-cancel raced the completion
         pending, spec = inf.pending, inf.pending.spec
         self.store_result_entries(inf.return_ids, entries)
         self._worker.task_manager.complete(spec.task_id)
+        te = self._worker.task_events
+        if te is not None:
+            te.record_finished_batch(
+                ((task_id, timing, h.worker_id.hex(), self.node_index),),
+                offset=self.clock_offset)
         self._finish_task(pending, task_id, None)
         self._release(h, task_id)
 
@@ -824,14 +839,16 @@ class ProcessWorkerPool:
         finished: List[tuple] = []
         taken: List[tuple] = []
         events = self._worker.events
+        te = self._worker.task_events
+        te_rows: List[tuple] = []
         with self._lock:
-            for h, task_id, entries in dones:
+            for h, task_id, entries, timing in dones:
                 inf = h.inflight.pop(task_id, None)
                 if inf is None:
                     continue  # force-cancel/failure raced the completion
                 self._by_task.pop(task_id, None)
-                taken.append((h, task_id, entries, inf))
-        for h, task_id, entries, inf in taken:
+                taken.append((h, task_id, entries, timing, inf))
+        for h, task_id, entries, timing, inf in taken:
             spec = inf.pending.spec
             try:
                 ready_oids.extend(
@@ -839,6 +856,9 @@ class ProcessWorkerPool:
                 self._worker.task_manager.complete(spec.task_id)
                 events.record(task_id, spec.name, "finished",
                               self.node_index)
+                if te is not None:
+                    te_rows.append((task_id, timing, h.worker_id.hex(),
+                                    self.node_index))
                 deps = _top_level_deps(spec.args, spec.kwargs)
                 if deps:
                     self._worker.reference_counter \
@@ -848,15 +868,17 @@ class ProcessWorkerPool:
                                  spec.name)
             finished.append((task_id, inf.pending.node_index,
                              spec.resources))
+        if te_rows:
+            te.record_finished_batch(te_rows, offset=self.clock_offset)
         self._worker.scheduler.notify_batch(ready_oids, finished)
-        for h, task_id, _entries, inf in taken:
+        for h, task_id, _entries, _timing, inf in taken:
             for oid in inf.borrows:
                 self._worker.reference_counter.remove_borrower(
                     oid, h.worker_id)
             self._mark_idle(h)
 
     def _on_err(self, h: _Handle, task_id: TaskID, exc_blob: bytes,
-                tb: str) -> None:
+                tb: str, timing=None) -> None:
         inf = h.inflight.get(task_id)
         if inf is None:
             return  # force-cancel raced the error
@@ -866,6 +888,13 @@ class ProcessWorkerPool:
         except Exception:
             exc = RuntimeError("worker error (exception undeserializable)")
         exc._ray_tpu_traceback = tb
+        te = self._worker.task_events
+        if te is not None:
+            # attach the execution window before the failure hooks
+            # finalize (retry or terminal) this attempt's record
+            te.record_exec(task_id, timing, node=self.node_index,
+                           worker=h.worker_id.hex(),
+                           offset=self.clock_offset)
         retry = self._worker._handle_task_failure(spec, inf.return_ids, exc)
         self._finish_task(pending, task_id, retry)
         self._release(h, task_id)

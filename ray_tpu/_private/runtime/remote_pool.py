@@ -37,6 +37,7 @@ import os
 import queue
 import subprocess
 import threading
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from multiprocessing.connection import Listener
@@ -169,6 +170,12 @@ class RemoteNodePool(ProcessWorkerPool):
                 if slot is not None:
                     slot[1][:] = list(msg[2:])
                     slot[0].set()
+            elif kind == "clock":
+                # clock handshake sample sent right after the daemon's
+                # hello (and after every rejoin): maps daemon wall-clock
+                # timestamps onto the head's axis. Error ~ one-way link
+                # latency, far below task-span granularity.
+                self.clock_offset = time.time() - msg[1]
 
     def _on_daemon_lost(self) -> None:
         self._conn_dead = True
@@ -571,8 +578,22 @@ class HeadServer:
             except AuthenticationError:
                 continue  # port-scan / bad-key dial must not kill accepts
             except (OSError, EOFError):
-                return
+                # mid-handshake death of ONE dialer (peer hung up inside
+                # deliver_challenge) must not kill the accept loop — that
+                # would leave the whole cluster unreachable (later dials
+                # complete TCP against the backlog, then hang in auth
+                # forever). Only a closed listener ends the loop.
+                if self._closed:
+                    return
+                time.sleep(0.01)  # if the LISTENER broke, don't spin hot
+                continue
             try:
+                # bound the hello wait: the accept loop is single-threaded,
+                # so one authenticated-but-silent peer would block every
+                # later registration behind it
+                if not conn.poll(10.0):
+                    conn.close()
+                    continue
                 hello = conn.recv()
             except (EOFError, OSError):
                 conn.close()

@@ -439,6 +439,9 @@ class _WorkerRunner:
             env_saved = {k: _os.environ.get(k) for k in env_vars}
             _os.environ.update(env_vars)
         env_ctx = None
+        # execution window (wall clock: the owner aligns remote-node
+        # walls onto the head axis via the daemon's clock handshake)
+        t0 = t1 = time.time()
         try:
             if payload.get("working_dir_pkg") or payload.get("pip"):
                 # runtime env agent, worker half: extract/build into
@@ -473,6 +476,7 @@ class _WorkerRunner:
             if task_id.binary() in self.cancelled:
                 raise rex.TaskCancelledError(task_id)
             result = run(args, kwargs)
+            t1 = time.time()
             num_returns = payload["num_returns"]
             if num_returns == 1:
                 values = [result]
@@ -486,7 +490,7 @@ class _WorkerRunner:
             return_ids = [ObjectID(b) for b in payload["return_ids"]]
             entries = [self.store_value(oid, v)
                        for oid, v in zip(return_ids, values)]
-            self._emit(("done", payload["task_id"], entries))
+            self._emit(("done", payload["task_id"], entries, (t0, t1)))
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
             try:
@@ -494,7 +498,8 @@ class _WorkerRunner:
             except Exception:
                 blob = cloudpickle.dumps(
                     RuntimeError(f"[unpicklable {type(e).__name__}] {e}"))
-            self._emit(("err", payload["task_id"], blob, tb))
+            self._emit(("err", payload["task_id"], blob, tb,
+                        (t0, time.time())))
         finally:
             if env_ctx is not None:
                 env_ctx.__exit__(None, None, None)

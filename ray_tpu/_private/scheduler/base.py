@@ -23,6 +23,12 @@ class PendingTask:
 class SchedulerBase:
     """Submission boundary. Implementations must be thread-safe."""
 
+    # Optional TaskEventAggregator the worker attaches after
+    # construction; implementations call record_ready_batch() when a
+    # dep-blocked task's last dependency lands (no-dep tasks skip the
+    # hook entirely: READY defaults to SUBMITTED at read time).
+    task_events = None
+
     def submit(self, task: PendingTask) -> None:
         raise NotImplementedError
 

@@ -151,6 +151,7 @@ class EventScheduler(SchedulerBase):
 
     def notify_object_ready(self, object_id: ObjectID) -> None:
         to_dispatch = []
+        newly_ready = []
         with self._lock:
             for task in self._waiters.pop(object_id, []):
                 tid = task.spec.task_id
@@ -160,7 +161,12 @@ class EventScheduler(SchedulerBase):
                 if self._dep_count[tid] == 0:
                     del self._dep_count[tid]
                     self._ready.append(task)
+                    newly_ready.append(tid)
             to_dispatch = self._drain_ready_locked()
+        if newly_ready:
+            te = self.task_events
+            if te is not None:
+                te.record_ready_batch(newly_ready)
         self._run_dispatch(to_dispatch)
 
     def notify_task_finished(self, task_id: TaskID, node_index: int,

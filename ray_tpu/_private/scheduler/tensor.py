@@ -598,6 +598,17 @@ class TensorScheduler(SchedulerBase):
                     dec_slots.extend(w)
         if dec_slots:
             np.subtract.at(self._indeg, np.asarray(dec_slots, dtype=np.int64), 1)
+            te = self.task_events
+            if te is not None:
+                # slots whose last dependency just landed (dep-blocked
+                # tasks only: no-dep admissions never enter dec_slots)
+                tid_of = self._tid_of
+                newly_ready = [tid_of[s] for s in set(dec_slots)
+                               if self._state[s] == WAITING
+                               and self._indeg[s] <= 0
+                               and s in tid_of]
+                if newly_ready:
+                    te.record_ready_batch(newly_ready)
 
         # 3) completions: release resources, free slots
         while self._finish_q:

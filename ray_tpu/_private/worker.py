@@ -48,6 +48,14 @@ def _noop_exec(task, node_index) -> None:
     worker's dispatcher, not the task) — shared, not a per-task lambda."""
 
 
+def _task_error_type(exc: BaseException) -> str:
+    """Error-type label for task event records: unwrap one chaining
+    level so TaskError(ValueError) reports "ValueError", not the
+    wrapper."""
+    cause = getattr(exc, "__cause__", None)
+    return type(cause).__name__ if cause is not None else type(exc).__name__
+
+
 class _TaskContext(threading.local):
     """Per-thread execution context (reference: WorkerContext)."""
 
@@ -463,6 +471,13 @@ class Worker:
         # observability: task profile events + optional Prometheus port
         from ray_tpu._private.events import EventBuffer
         self.events = EventBuffer()
+        # task event plane: cluster-wide lifecycle records (None when
+        # task_events_max=0 — every producer hook is a None check)
+        from ray_tpu._private.task_events import TaskEventAggregator
+        self.task_events = (TaskEventAggregator()
+                            if GLOBAL_CONFIG.task_events_max != 0
+                            else None)
+        self.scheduler.task_events = self.task_events
         self.metrics_server = None
         if GLOBAL_CONFIG.metrics_export_port:
             from ray_tpu._private.metrics import MetricsServer
@@ -897,7 +912,11 @@ class Worker:
         if deps:
             self.reference_counter.add_submitted_task_references(deps)
         self.task_manager.add_pending(spec, deps)
-        self.events.record(spec.task_id, spec.name, "submitted")
+        self.events.record(spec.task_id, spec.name, "submitted",
+                           attempt=spec.attempt_number)
+        if (self.task_events is not None
+                and spec.task_type == TaskType.NORMAL_TASK):
+            self.task_events.record_submitted(spec)
         if spec.timeout_s:
             self._register_deadline(spec)
 
@@ -939,6 +958,9 @@ class Worker:
         self.task_manager.add_pending_batch(specs)
         self.events.record_batch(((s.task_id, s.name) for s in specs),
                                  "submitted")
+        if self.task_events is not None:
+            self.task_events.record_submitted_batch(
+                s for s in specs if s.task_type == TaskType.NORMAL_TASK)
         pendings: List[PendingTask] = []
         out: List[List[ObjectRef]] = []
         for spec in specs:
@@ -1025,7 +1047,12 @@ class Worker:
     def _dispatch(self, pending: PendingTask) -> None:
         self._chaos_tick()
         self.events.record(pending.spec.task_id, pending.spec.name,
-                           "dispatched", pending.node_index)
+                           "dispatched", pending.node_index,
+                           attempt=pending.spec.attempt_number)
+        te = self.task_events
+        if te is not None:
+            te.record_dispatched_batch(
+                ((pending.spec.task_id, pending.node_index),))
         boot = getattr(pending.spec, "_actor_boot", None)
         pool = self.pool_for_node(pending.node_index)
         if boot is not None:
@@ -1050,6 +1077,8 @@ class Worker:
         groups: Dict[Any, List[PendingTask]] = {}
         local: List[tuple] = []
         fast: List[PendingTask] = []
+        te = self.task_events
+        te_rows: List[tuple] = []
         record = self.events.record
         for pending in pendings:
             spec = pending.spec
@@ -1060,6 +1089,8 @@ class Worker:
             elif pool is not None and not pool.is_remote:
                 record(spec.task_id, spec.name, "dispatched",
                        pending.node_index)
+                if te is not None:
+                    te_rows.append((spec.task_id, pending.node_index))
                 groups.setdefault(pool, []).append(pending)
             elif pool is None:
                 # host-thread execution. Plain tasks (no deps to
@@ -1080,9 +1111,16 @@ class Worker:
                 else:
                     record(spec.task_id, spec.name, "dispatched",
                            pending.node_index)
+                    if te is not None:
+                        te_rows.append((spec.task_id,
+                                        pending.node_index))
                     local.append((self._execute_task, (pending,)))
             else:
                 self._dispatch(pending)
+        if te is not None and (te_rows or fast):
+            te.record_dispatched_batch(
+                te_rows + [(p.spec.task_id, p.node_index)
+                           for p in fast])
         if fast:
             self.events.record_batch(
                 ((p.spec.task_id, p.spec.name) for p in fast),
@@ -1117,6 +1155,9 @@ class Worker:
         complete = self.task_manager.complete_batch_with_refs
         has_ref = self.reference_counter.has_reference
         done: List[tuple] = []
+        te = self.task_events
+        te_done: List[tuple] = []
+        wkey = threading.get_ident()
         try:
             while True:
                 try:
@@ -1162,7 +1203,9 @@ class Worker:
                     else:
                         try:
                             self._maybe_inject_failure()
+                            t0 = time.time()
                             result = spec.func(*spec.args)
+                            t1 = time.time()
                         except BaseException as e:  # noqa: BLE001
                             flag = self._claim_task_completion(exec_id)
                             if flag == "timeout":
@@ -1188,6 +1231,10 @@ class Worker:
                                 put(rids[0], result)
                                 ready = (rids[0],)
                                 done.append((exec_id, rids[0]))
+                                if te is not None:
+                                    te_done.append(
+                                        (exec_id, (t0, t1), wkey,
+                                         pending.node_index))
                 finally:
                     with rlock:
                         running.pop(exec_id, None)
@@ -1206,11 +1253,16 @@ class Worker:
                 if len(done) >= 256:
                     complete(done, has_ref)
                     done = []
+                if len(te_done) >= 256:
+                    te.record_finished_batch(te_done)
+                    te_done = []
         finally:
             ctx.task_id = prev_task
             ctx.put_counter = prev_put
             if done:
                 complete(done, has_ref)
+            if te_done:
+                te.record_finished_batch(te_done)
             self.placement_groups.poke()
 
     def _run_pool_batch(self, pool, batch: List[PendingTask]) -> None:
@@ -1630,7 +1682,9 @@ class Worker:
                 return
             try:
                 self._maybe_inject_failure()
+                t0 = time.time()
                 result = spec.func(*args, **kwargs)
+                t1 = time.time()
             except BaseException as e:  # noqa: BLE001
                 flag = self._claim_task_completion(exec_task_id)
                 if flag == "timeout":
@@ -1664,6 +1718,12 @@ class Worker:
                                   rex.TaskCancelledError(exec_task_id))
                 return
             ready_oids = self._store_returns(spec, return_ids, result)
+            if self.task_events is not None:
+                # no-op for records _store_returns already failed
+                # (num_returns mismatch -> _store_error finalized them)
+                self.task_events.record_finished_batch(
+                    ((exec_task_id, (t0, t1), threading.get_ident(),
+                      pending.node_index),))
         finally:
             if env_ctx is not None:
                 env_ctx.__exit__(None, None, None)
@@ -1824,6 +1884,11 @@ class Worker:
             spec._backoff = True  # failure retry: _submit_retry delays it
             deps = _top_level_deps(spec.args, spec.kwargs)
             self.task_manager.rekey_pending(old_id, spec, deps)
+            if self.task_events is not None:
+                # old attempt -> failed ring (flagged retried); the new
+                # attempt id opens its own record
+                self.task_events.record_retry(
+                    old_id, _task_error_type(exc), spec)
             unresolved = [d for d in deps if not self.memory_store.contains(d)]
             return PendingTask(spec=spec, deps=unresolved,
                                execute=_noop_exec)
@@ -1847,6 +1912,11 @@ class Worker:
         return None
 
     def _store_error(self, spec: TaskSpec, return_ids, exc: BaseException):
+        if self.task_events is not None:
+            # terminal failure (retries, if any, were exhausted)
+            self.task_events.record_failed(
+                spec.task_id, _task_error_type(exc), name=spec.name,
+                attempt=spec.attempt_number)
         for oid in return_ids:
             self.memory_store.put(oid, exc, is_exception=True)
             self.scheduler.notify_object_ready(oid)

@@ -65,7 +65,8 @@ th { color: var(--text-secondary); font-weight: 500; }
 td[class^="st-"]::before { content: ""; display: inline-block; width: 8px;
   height: 8px; border-radius: 50%; margin-right: 6px;
   vertical-align: baseline; background: var(--critical); }
-td.st-alive::before, td.st-running::before { background: var(--good); }
+td.st-alive::before, td.st-running::before,
+td.st-finished::before { background: var(--good); }
 .links a { color: var(--text-secondary); margin-right: 10px; }
 #logfiles a { color: var(--series-1); margin-right: 14px;
   text-decoration: none; }
@@ -74,6 +75,13 @@ td.st-alive::before, td.st-running::before { background: var(--good); }
   overflow: auto; white-space: pre-wrap; font: 12px/1.4 ui-monospace,
   monospace; display: none; }
 #chartwrap { position: relative; max-width: 880px; }
+/* task latency breakdown bar: dep-wait | queue | exec segments */
+.bd { display: inline-flex; width: 140px; height: 8px; border-radius: 4px;
+  overflow: hidden; background: var(--surface-2); vertical-align: middle; }
+.bd span { display: block; height: 100%; }
+.bd-dep { background: var(--grid); }
+.bd-q { background: var(--text-secondary); }
+.bd-ex { background: var(--series-1); }
 #tp-tip { position: absolute; pointer-events: none; display: none;
   background: var(--surface-2); border: 1px solid var(--grid);
   border-radius: 6px; padding: 4px 8px; font-size: 12px; }
@@ -88,6 +96,8 @@ td.st-alive::before, td.st-running::before { background: var(--good); }
   <div id="tp-tip"></div></div></div>
 <div class="panel"><h2>Nodes</h2><div id="nodes"></div></div>
 <div class="panel"><h2>Task summary</h2><div id="tasks"></div></div>
+<div class="panel"><h2>Recent tasks (dep-wait &middot; queue &middot; exec)</h2>
+<div id="taskdetail"></div></div>
 <div class="panel"><h2>Actors</h2><div id="actors"></div></div>
 <div class="panel"><h2>Data streams</h2><div id="streams"></div></div>
 <div class="panel"><h2>Logs</h2><div id="logfiles" class="sub"></div>
@@ -97,6 +107,8 @@ td.st-alive::before, td.st-running::before { background: var(--good); }
 <a href="/api/actors">actors</a><a href="/api/objects">objects</a>
 <a href="/api/nodes">nodes</a><a href="/api/placement_groups">pgs</a>
 <a href="/api/data_streams">streams</a>
+<a href="/api/task_events">task_events</a>
+<a href="/api/timeline">timeline</a>
 <a href="/api/logs">logs</a>
 <a href="/api/jobs">jobs</a><a href="/metrics">metrics</a></div>
 <script>
@@ -135,6 +147,42 @@ function rows(list, cols, stateCols) {
     }).join("")}</tr>`
   ).join("");
   return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
+}
+
+function fmtS(v) {
+  v = Number(v) || 0;
+  return v >= 1 ? v.toFixed(2) + "s" : (v * 1000).toFixed(1) + "ms";
+}
+
+function taskDetailRows(list) {
+  // FINISHED/FAILED ring rows, newest first, with a latency breakdown
+  // bar per row. Durations pass through Number() and names/states
+  // through esc() — ring content never renders as markup.
+  const done = (list || []).filter(r => r.end_at)
+    .sort((a, b) => (b.end_at || 0) - (a.end_at || 0)).slice(0, 25);
+  if (!done.length) { return '<div class="sub">none yet</div>'; }
+  const head = ["task", "state", "node", "attempt", "dep-wait",
+                "queue", "exec", "breakdown", "error"]
+    .map(c => `<th>${c}</th>`).join("");
+  const body = done.map(r => {
+    const d = Number(r.dep_wait_s) || 0, q = Number(r.queue_s) || 0,
+          ex = Number(r.exec_s) || 0;
+    const tot = (d + q + ex) || 1;
+    const bar = '<div class="bd">' +
+      [["bd-dep", d], ["bd-q", q], ["bd-ex", ex]].map(([cls, v]) =>
+        `<span class="${cls}" style="width:${
+          (100 * v / tot).toFixed(1)}%"></span>`).join("") + "</div>";
+    const cls = /^[a-z_]+$/.test(String(r.state).toLowerCase()) ?
+      String(r.state).toLowerCase() : "other";
+    return `<tr><td>${esc(r.name)}</td>` +
+      `<td class="st-${cls}">${esc(r.state)}</td>` +
+      `<td>${Number(r.node_index)}</td>` +
+      `<td>${Number(r.attempt) || 0}</td>` +
+      `<td>${fmtS(d)}</td><td>${fmtS(q)}</td><td>${fmtS(ex)}</td>` +
+      `<td>${bar}</td><td>${esc(r.error_type || "")}</td></tr>`;
+  }).join("");
+  return `<table><thead><tr>${head}</tr></thead>` +
+    `<tbody>${body}</tbody></table>`;
 }
 
 function drawChart() {
@@ -216,9 +264,10 @@ async function viewLog(f) {
 
 async function refresh() {
   try {
-    const [s, actors] = await Promise.all([
+    const [s, actors, taskEvents] = await Promise.all([
       fetch("/api/summary").then(r => r.json()),
       fetch("/api/actors").then(r => r.json()),
+      fetch("/api/task_events").then(r => r.json()).catch(() => []),
     ]);
     refreshLogs().catch(() => {});
     const nodes = s.nodes || [];
@@ -248,6 +297,20 @@ async function refresh() {
       tile("ingest overlap", (s.data_streams || []).length ?
            (100 * (s.data_streams[s.data_streams.length - 1]
                      .overlap_fraction || 0)).toFixed(0) + "%" : "–");
+    const lat = s.task_latency;
+    if (lat && lat.n) {
+      document.getElementById("tiles").innerHTML +=
+        tile("exec p50 / p95",
+             fmtS(lat.exec_p50_s) + " / " + fmtS(lat.exec_p95_s)) +
+        tile("queue p50 / p95",
+             fmtS(lat.queue_p50_s) + " / " + fmtS(lat.queue_p95_s)) +
+        tile("tasks failed", lat.failed_total,
+             lat.failed_total ? "critical" : null) +
+        tile("retries", lat.retries_total,
+             lat.retries_total ? "critical" : null);
+    }
+    document.getElementById("taskdetail").innerHTML =
+      taskDetailRows(taskEvents);
     document.getElementById("nodes").innerHTML = rows(nodes.map(n => ({
       node: (n.node_id || "").slice(0, 12), state: n.state || "ALIVE",
       kind: n.kind || "", resources: JSON.stringify(n.resources || {}),
@@ -292,6 +355,10 @@ class Dashboard:
 
         routes = {
             "/api/tasks": lambda: state.list_tasks(),
+            # live rows + the durable FINISHED/FAILED ring, with
+            # per-transition timestamps (the task-detail table source)
+            "/api/task_events": lambda: state.list_tasks(detail=True),
+            "/api/timeline": lambda: state.task_timeline(),
             "/api/actors": lambda: state.list_actors(),
             "/api/objects": lambda: state.list_objects(),
             "/api/nodes": lambda: state.list_nodes(),
@@ -305,6 +372,10 @@ class Dashboard:
             "/api/summary": lambda: {
                 "tasks": state.summarize_tasks(),
                 "scheduler": worker.scheduler.stats(),
+                "task_latency": (
+                    worker.task_events.latency_summary()
+                    if getattr(worker, "task_events", None) is not None
+                    else None),
                 "nodes": state.list_nodes(),
                 "actors_alive": sum(
                     1 for a in state.list_actors()

@@ -37,10 +37,31 @@ def _client_dispatch(fn):
 
 
 @_client_dispatch
-def list_tasks() -> List[Dict[str, Any]]:
-    """Live (queued/pending/running) tasks from the scheduler arrays."""
+def list_tasks(detail: bool = False,
+               state: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Live (queued/pending/running) tasks from the scheduler arrays.
+
+    ``detail=True`` widens the result in two ways: live rows gain
+    per-transition timestamps from the task event plane, and the bounded
+    ring of FINISHED/FAILED records is appended — tasks remain queryable
+    after they leave the scheduler (reference: ray list tasks
+    --detail). ``state=`` filters both sets ("FINISHED", "FAILED", or
+    any live scheduler state)."""
     w = worker_mod.get_worker()
-    return w.scheduler.task_table()
+    rows = w.scheduler.task_table()
+    if state is not None:
+        rows = [r for r in rows if r["state"] == state]
+    if not detail:
+        return rows
+    te = getattr(w, "task_events", None)
+    if te is None:
+        return rows
+    live = te.live_detail()
+    for r in rows:
+        d = live.get(r["task_id"])
+        if d:
+            r.update(d)
+    return rows + te.dead_rows(state)
 
 
 @_client_dispatch
@@ -182,11 +203,36 @@ def get_log(filename: str, node_id: Optional[str] = None,
 
 
 @_client_dispatch
+def task_timeline() -> List[Dict[str, Any]]:
+    """Chrome-trace events for the cluster-wide task event plane: one
+    scheduler lane (dep-wait + queue spans) and one lane per (node,
+    worker) with exec spans, all on the head's clock axis. Falls back to
+    the driver-local EventBuffer when task events are disabled
+    (``task_events_max=0``)."""
+    w = worker_mod.get_worker()
+    te = getattr(w, "task_events", None)
+    if te is not None:
+        return te.timeline()
+    return w.events.timeline()
+
+
+@_client_dispatch
 def summarize_tasks() -> Dict[str, int]:
-    """Counts by state (reference: ray summary tasks)."""
+    """Counts by state (reference: ray summary tasks). Includes
+    FAILED_TOTAL and per-error-type FAILED(<Type>) counts from the task
+    event plane (terminal + retried attempts both count)."""
     out: Dict[str, int] = {}
     for row in list_tasks():
         out[row["state"]] = out.get(row["state"], 0) + 1
-    stats = worker_mod.get_worker().scheduler.stats()
+    w = worker_mod.get_worker()
+    stats = w.scheduler.stats()
     out["FINISHED_TOTAL"] = stats.get("finished", 0)
+    te = getattr(w, "task_events", None)
+    if te is None:
+        out["FAILED_TOTAL"] = 0
+        return out
+    s = te.summary()
+    out["FAILED_TOTAL"] = s["failed_total"]
+    for etype, n in sorted(s["failed_by_type"].items()):
+        out[f"FAILED({etype})"] = n
     return out

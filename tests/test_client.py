@@ -322,3 +322,31 @@ class TestProtocolVersion:
 
         w = ClientWorker(host, port, authkey)
         assert w.alive
+
+
+class TestReconnectCycles:
+    def test_rapid_connect_disconnect_cycles(self, head):
+        """Regression: shutdown left the reader thread blocked in recv,
+        pinning the socket open (head serve threads leaked) while the
+        freed fd number was recycled to the next init()'s socket — the
+        stale reader then stole handshake bytes, failing later connects
+        with "bad message length" / wrong-digest auth errors and wedging
+        the head's accept loop for good."""
+        _proc, address = head
+        ray_tpu.shutdown()
+        try:
+            for i in range(8):
+                w = ray_tpu.init(address=address)
+
+                @ray_tpu.remote
+                def add(a, b):
+                    return a + b
+
+                assert ray_tpu.get(add.remote(i, 1)) == i + 1
+                ray_tpu.shutdown()
+                # the reader must be gone: a joined teardown is what
+                # makes the next cycle's fd reuse safe
+                r = getattr(w, "_reader_thread", None)
+                assert r is None or not r.is_alive()
+        finally:
+            ray_tpu.shutdown()
