@@ -141,6 +141,31 @@ def _e2e_subprocess(n: int, mode: str, batched: bool = False,
         f"e2e child produced no result: {out.stderr[-2000:]}")
 
 
+_LOCALITY_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from ray_tpu._private import perf
+r = perf.locality_ab(locality={locality}, n_consumers={n}, arg_mb={arg_mb})
+print("LOC_JSON:" + json.dumps(r))
+"""
+
+
+def _locality_subprocess(locality: bool, n: int, arg_mb: float) -> dict:
+    """One locality A/B arm in a fresh interpreter (the cluster spawns
+    node daemons; a clean process keeps the arms independent)."""
+    env = spawn_env.child_env()
+    code = _LOCALITY_CHILD.format(repo=REPO, locality=locality, n=n,
+                                  arg_mb=arg_mb)
+    timeout = max(60.0, min(300.0, _remaining() - 10.0))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    for line in out.stdout.splitlines():
+        if line.startswith("LOC_JSON:"):
+            return json.loads(line[len("LOC_JSON:"):])
+    raise RuntimeError(
+        f"locality child produced no result: {out.stderr[-2000:]}")
+
+
 def _chip_preflight() -> str:
     """Probe the accelerator in a KILLABLE subprocess: a degraded chip
     tunnel hangs jax backend init indefinitely, and an unbounded hang
@@ -396,6 +421,60 @@ def main() -> int:
             except Exception:
                 traceback.print_exc()
         OUT["task_event_overhead"] = teo or None
+        _emit()
+
+    # --- locality-aware scheduling: cross-node byte A/B ----------------
+    # 2-remote-node cluster, large objects produced on one node, a
+    # consumer fanout free to run on either. ON: the scheduler's
+    # resident-arg-bytes column keeps consumers (bounded by the
+    # spillback depth) on the data; OFF restores the pre-locality
+    # least-loaded placement, which ships a batch of args across. The
+    # claim under test: ON moves >= 50% fewer cross-node bytes with
+    # equal task results. A small-arg lane (the plain e2e no-op fanout
+    # with the knob off) checks the common path pays nothing.
+    if section("locality", 40):
+        loc = {}
+        n_cons, arg_mb = (4, 0.5) if smoke else (8, 1.0)
+        try:
+            on = _locality_subprocess(True, n_cons, arg_mb)
+            off = _locality_subprocess(False, n_cons, arg_mb)
+            loc["on"] = on
+            loc["off"] = off
+            loc["equal_results"] = on["sum"] == off["sum"]
+            moved_off = max(off["bytes_pulled"], 1)
+            loc["bytes_reduction_pct"] = round(
+                100.0 * (off["bytes_pulled"] - on["bytes_pulled"])
+                / moved_off, 1)
+            print(f"  locality: {on['bytes_pulled']} B pulled with "
+                  f"locality vs {off['bytes_pulled']} B without "
+                  f"({loc['bytes_reduction_pct']}% fewer; "
+                  f"{on['bytes_saved']} B saved, "
+                  f"{on['hits']} hits / {on['misses']} misses)",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+        try:
+            small_on = e2e.get("process")
+            if small_on is None:
+                small_on = round(_e2e_subprocess(
+                    n_proc, "process")["tasks_per_sec"], 1)
+            small_off = round(_e2e_subprocess(
+                n_proc, "process",
+                extra_env={"RAY_TPU_SCHEDULER_LOCALITY": "0"})
+                ["tasks_per_sec"], 1)
+            loc["small_arg"] = {
+                "locality_on_tasks_per_sec": small_on,
+                "locality_off_tasks_per_sec": small_off,
+                "overhead_pct": round(
+                    100.0 * (small_off - small_on) / small_off, 1),
+            }
+            print(f"  locality small-arg lane: {small_on:.0f} tasks/s "
+                  f"on vs {small_off:.0f} off "
+                  f"({loc['small_arg']['overhead_pct']}%)",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+        OUT["locality"] = loc or None
         _emit()
 
     # --- model perf: step time / tokens/s / MFU ------------------------

@@ -185,6 +185,18 @@ _d("sched_backend", str, "auto",
    "TensorScheduler tick backend: auto | jax | numpy (numpy for tiny graphs)")
 _d("sched_jax_min_batch", int, 512,
    "below this many pending tasks the numpy tick is used (auto mode)")
+_d("scheduler_locality", bool, True,
+   "score candidate nodes by resident-arg-bytes and prefer the node "
+   "holding the most input data when it is feasible (reference: "
+   "bottom-up locality-aware placement, Ray OSDI '18); SPREAD and "
+   "placement-group strategies override locality as before. Off = "
+   "pre-locality placement, byte-for-byte")
+_d("locality_spillback_queue_depth", int, 4,
+   "spillback bound for locality preference: a task waits for its "
+   "preferred (most-resident-bytes) node only while that node has "
+   "fewer than this many leases outstanding; beyond it the task "
+   "spills to the normal least-loaded choice so a hot node never "
+   "serializes the cluster")
 
 # -- fault tolerance -------------------------------------------------------
 _d("task_max_retries", int, 3, "default retries for tasks on worker failure")

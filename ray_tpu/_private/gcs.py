@@ -196,11 +196,13 @@ class GcsService:
         self._ops_since_compact = 0
         if journal is not None:
             self._replay(GcsJournal.replay(journal.path))
-        # object directory: primary-copy location of objects resident in
-        # REMOTE node arenas (reference: the object directory the object
-        # manager consults before a Pull —
+        # object directory: node rows holding a copy of each object
+        # resident in REMOTE node arenas, primary first; secondary
+        # copies are registered when a peer pull completes and dropped
+        # when their node dies (reference: the multi-location object
+        # directory the object manager consults before a Pull —
         # src/ray/object_manager/ownership_object_directory.cc)
-        self._object_locations: Dict[ObjectID, int] = {}
+        self._object_locations: Dict[ObjectID, List[int]] = {}
         self._subs: Dict[str, Dict[int, Callable[[dict], None]]] = {}
         self._sub_seq = 0
         self._health_thread: Optional[threading.Thread] = None
@@ -345,24 +347,80 @@ class GcsService:
                     and e.kind in ("process", "remote")]
 
     # ------------------------------------------------------------------
-    # object directory (objects primary-resident on remote nodes)
+    # object directory (objects resident on remote nodes; primary-first
+    # location lists, secondaries registered by completed peer pulls)
     # ------------------------------------------------------------------
     def object_location_add(self, object_id: ObjectID, index: int) -> None:
+        """Set/replace the PRIMARY location (inserts, or moves an
+        existing secondary to the front)."""
         with self._lock:
-            self._object_locations[object_id] = index
+            locs = self._object_locations.get(object_id)
+            if locs is None:
+                self._object_locations[object_id] = [index]
+            else:
+                if index in locs:
+                    locs.remove(index)
+                locs.insert(0, index)
+
+    def object_location_add_secondary(self, object_id: ObjectID,
+                                      index: int) -> None:
+        """Register an extra copy (a completed peer pull). Only objects
+        already tracked gain secondaries — an untracked oid means the
+        primary was freed/invalidated and the copy is moot."""
+        with self._lock:
+            locs = self._object_locations.get(object_id)
+            if locs is not None and index not in locs:
+                locs.append(index)
 
     def object_location_get(self, object_id: ObjectID) -> Optional[int]:
+        """The primary location, or None."""
         with self._lock:
-            return self._object_locations.get(object_id)
+            locs = self._object_locations.get(object_id)
+            return locs[0] if locs else None
+
+    def object_locations(self, object_id: ObjectID) -> List[int]:
+        """All known copies, primary first (empty when untracked)."""
+        with self._lock:
+            return list(self._object_locations.get(object_id) or ())
 
     def object_location_pop(self, object_id: ObjectID) -> Optional[int]:
+        """Forget the object entirely; returns the old primary."""
         with self._lock:
-            return self._object_locations.pop(object_id, None)
+            locs = self._object_locations.pop(object_id, None)
+            return locs[0] if locs else None
+
+    def object_locations_pop(self, object_id: ObjectID) -> List[int]:
+        """Forget the object entirely; returns EVERY copy's node row
+        (free-all-copies path)."""
+        with self._lock:
+            return self._object_locations.pop(object_id, None) or []
 
     def objects_on_node(self, index: int) -> List[ObjectID]:
+        """Objects whose PRIMARY copy lives on the node."""
         with self._lock:
-            return [oid for oid, i in self._object_locations.items()
-                    if i == index]
+            return [oid for oid, locs in self._object_locations.items()
+                    if locs and locs[0] == index]
+
+    def drop_node_locations(self, index: int):
+        """Node-death invalidation: remove ``index`` from every location
+        list. Returns (lost, promoted): oids whose LAST copy died (drop
+        from the directory, lineage must reconstruct) and
+        {oid: new_primary} for oids whose primary died but a secondary
+        survived and took over."""
+        lost: List[ObjectID] = []
+        promoted: Dict[ObjectID, int] = {}
+        with self._lock:
+            for oid, locs in list(self._object_locations.items()):
+                if index not in locs:
+                    continue
+                was_primary = locs[0] == index
+                locs.remove(index)
+                if not locs:
+                    del self._object_locations[oid]
+                    lost.append(oid)
+                elif was_primary:
+                    promoted[oid] = locs[0]
+        return lost, promoted
 
     # ------------------------------------------------------------------
     # actor table (reference: GcsActorManager — source of truth for

@@ -217,10 +217,23 @@ def _render_core(worker) -> List[str]:
     emit("ray_tpu_log_bytes_resident", "gauge",
          "bytes resident in this session's log capture files "
          "(shrinks under log rotation)", log_resident)
-    emit("ray_tpu_log_bytes_written_total", "counter",
-         "DEPRECATED: renamed to ray_tpu_log_bytes_resident (a gauge; "
-         "this value shrinks under rotation and was never a true "
-         "counter); will be removed next release", log_resident)
+
+    # locality scheduling + transfer accounting (worker.transfer_stats)
+    ts = getattr(worker, "transfer_stats", None) or {}
+    emit("ray_tpu_sched_locality_hit_total", "counter",
+         "remote dispatches whose located args were ALL resident on "
+         "the chosen node (no cross-node arg transfer needed)",
+         ts.get("locality_hits", 0))
+    emit("ray_tpu_sched_locality_miss_total", "counter",
+         "remote dispatches that needed at least one cross-node arg "
+         "transfer", ts.get("locality_misses", 0))
+    emit("ray_tpu_transfer_bytes_pulled_total", "counter",
+         "object bytes moved across nodes (peer pulls and "
+         "head-mediated fetches)", ts.get("bytes_pulled", 0))
+    emit("ray_tpu_transfer_bytes_saved_total", "counter",
+         "arg bytes already resident on the dispatch target "
+         "(transfers avoided by locality-aware placement)",
+         ts.get("bytes_saved", 0))
 
     # task event plane: latency-breakdown histograms + failure counters
     from ray_tpu._private import task_events

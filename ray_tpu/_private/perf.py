@@ -130,6 +130,76 @@ def e2e_task_throughput(n_tasks: int = 10_000, mode: str = "thread",
     }
 
 
+def locality_ab(locality: bool, n_consumers: int = 8,
+                arg_mb: float = 1.0,
+                spill_depth: int = 32) -> Dict[str, Any]:
+    """One arm of the locality-scheduling A/B: a 2-remote-node cluster,
+    large objects produced on the SOURCE node, a consumer fanout free to
+    run on either remote node.
+
+    With ``locality=True`` the scheduler scores candidates by
+    resident-arg-bytes and the consumers land (or wait, bounded by
+    ``spill_depth``) on the source node — cross-node arg bytes stay
+    near zero. With ``locality=False`` (the pre-PR placement) the
+    least-loaded fill sends a batch of consumers to the sink node,
+    each pulling its argument across. The SINK node is added first so
+    the load-tiebreak favors it: the off arm genuinely moves bytes.
+
+    Returns {sum, bytes_pulled, bytes_saved, seconds, hits, misses}.
+    ``sum`` must match between arms (equal task results)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.cluster_utils import Cluster
+
+    n = max(1, int(arg_mb * 1024 * 1024) // 8)
+    ray_tpu.shutdown()
+    c = Cluster(initialize_head=True,
+                head_node_args=dict(
+                    num_cpus=2, num_workers=2, scheduler="tensor",
+                    _system_config={
+                        "scheduler_locality": bool(locality),
+                        "locality_spillback_queue_depth": spill_depth}))
+    try:
+        c.add_node(num_cpus=4, remote=True, resources={"r": 100.0})
+        c.add_node(num_cpus=4, remote=True,
+                   resources={"r": 100.0, "src": 100.0})
+        c.wait_for_nodes()
+        w = worker_mod.get_worker()
+
+        @ray_tpu.remote(resources={"src": 1.0})
+        def produce(i):
+            return np.full(n, float(i))
+
+        @ray_tpu.remote(resources={"r": 1.0})
+        def consume(x):
+            return float(x[0]) * len(x)
+
+        refs = [produce.remote(i) for i in range(n_consumers)]
+        for r in refs:
+            ray_tpu.wait([r], timeout=120.0)
+        ts = w.transfer_stats
+        p0 = ts["bytes_pulled"]
+        t0 = time.perf_counter()
+        out = ray_tpu.get([consume.remote(r) for r in refs],
+                          timeout=300.0)
+        dt = time.perf_counter() - t0
+        return {
+            "locality": bool(locality),
+            "n_consumers": n_consumers,
+            "arg_mb": arg_mb,
+            "sum": float(sum(out)),
+            "bytes_pulled": int(ts["bytes_pulled"] - p0),
+            "bytes_saved": int(ts["bytes_saved"]),
+            "hits": int(ts["locality_hits"]),
+            "misses": int(ts["locality_misses"]),
+            "seconds": round(dt, 3),
+        }
+    finally:
+        c.shutdown()
+
+
 def rl_rollout_throughput(iters: int = 4) -> Dict[str, Any]:
     """IMPALA's async pipeline under load: env-steps/s streamed from
     runner actors through the object store into the V-trace learner

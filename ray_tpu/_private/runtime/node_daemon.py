@@ -37,6 +37,11 @@ Head -> daemon messages:
   ("to_ctrl", num, msg)       deliver msg on worker num's control pipe
   ("kill", num)               SIGKILL worker num (force-cancel path)
   ("fetch", fid, oid_bin)     -> ("fetched", fid, ok, bytes)
+  ("stage", [(oid_bin, peer_address, nbytes), ...])
+                              dispatch-time arg staging: start peer
+                              pulls of these objects NOW (task-arg
+                              priority) so the transfer overlaps the
+                              lease's queue wait
   ("free", [oid_bin, ...])    drop objects from the local store
   ("ping", pid_)              -> ("pong", pid_, {num: pid})
   ("log_list", rid)           -> ("log_listed", rid, rows)
@@ -54,6 +59,10 @@ Daemon -> head messages:
   ("log", fname, lines)       appended log lines from a capture file
                               (unsolicited; the head's LogMonitor
                               re-emits them on the driver)
+  ("pulled", oid_bin)         a peer pull (staged or exec-time) landed
+                              the object in this node's store; the
+                              head registers a SECONDARY copy in the
+                              object directory
   ("log_listed", rid, rows)   log_list reply
   ("log_data", rid, ok, text) log_read reply
 """
@@ -248,10 +257,14 @@ class PullManager:
 
     PRIO_GET, PRIO_WAIT, PRIO_ARG = 0, 1, 2
 
-    def __init__(self, transfer, num_threads: int = 2):
+    def __init__(self, transfer, num_threads: int = 2, on_pulled=None):
         import collections
 
         self._transfer = transfer      # (address, oid_bin) -> bool
+        # invoked with oid_bin after every SUCCESSFUL transfer (staged
+        # prefetches and blocking pulls alike) — the daemon reports the
+        # new local copy to the head's object directory through it
+        self._on_pulled = on_pulled
         self._heap: list = []
         self._cv = threading.Condition()
         self._seq = 0
@@ -291,6 +304,23 @@ class PullManager:
         done.wait()
         return slot[0]
 
+    def prefetch(self, address, oid_bin: bytes, priority: int) -> None:
+        """Fire-and-forget: enqueue a pull without waiting for it
+        (dispatch-time arg staging). A pull of the same object already
+        in flight coalesces to a no-op; a later blocking pull() of the
+        object joins this transfer's waiters as usual."""
+        import heapq
+
+        with self._cv:
+            if oid_bin in self._inflight:
+                return
+            self._inflight[oid_bin] = []
+            self._seq += 1
+            heapq.heappush(self._heap, (priority, self._seq,
+                                        tuple(address), oid_bin,
+                                        threading.Event(), [False]))
+            self._cv.notify()
+
     def _run(self) -> None:
         import heapq
 
@@ -314,6 +344,11 @@ class PullManager:
             for d, s in waiters:
                 s[0] = ok
                 d.set()
+            if ok and self._on_pulled is not None:
+                try:
+                    self._on_pulled(oid_bin)
+                except Exception:
+                    pass  # reporting must never kill a puller thread
 
     def stop(self) -> None:
         with self._cv:
@@ -402,7 +437,8 @@ class NodeDaemon:
         self.peer_address = (local_ip, self._peer_listener.address[1])
         self._peer_conns: Dict[tuple, Any] = {}
         self._peer_lock = threading.Lock()
-        self.pulls = PullManager(self.pull_from_peer)
+        self.pulls = PullManager(self.pull_from_peer,
+                                 on_pulled=self._report_pulled)
         threading.Thread(target=self._peer_accept_loop, daemon=True,
                          name="ray_tpu_node_peer_accept").start()
 
@@ -429,6 +465,12 @@ class NodeDaemon:
         self._head.send(("clock", time.time(), time.perf_counter()))
 
     # ------------------------------------------------------------------
+    def _report_pulled(self, oid_bin: bytes) -> None:
+        """A peer pull landed locally: tell the head so the object
+        directory gains this node as a SECONDARY location (runs on
+        puller threads; _send_head serializes under _head_lock)."""
+        self._send_head(("pulled", oid_bin))
+
     def _send_head(self, msg: tuple) -> None:
         try:
             with self._head_lock:
@@ -1014,6 +1056,14 @@ class NodeDaemon:
                     target=self._serve_log_read,
                     args=(msg[1], msg[2], msg[3]),
                     daemon=True, name="ray_tpu_node_log_read").start()
+            elif kind == "stage":
+                # dispatch-time arg staging: enqueue peer pulls NOW at
+                # task-arg priority so transfers overlap the lease's
+                # queue wait; completions report ("pulled", oid) and
+                # the exec-time localization finds the bytes resident
+                for oid_bin, address, _nbytes in msg[1]:
+                    self.pulls.prefetch(address, oid_bin,
+                                        PullManager.PRIO_ARG)
             elif kind == "free":
                 for b in msg[1]:
                     self.store.free_object(ObjectID(b))

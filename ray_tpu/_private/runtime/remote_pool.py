@@ -170,6 +170,17 @@ class RemoteNodePool(ProcessWorkerPool):
                 if slot is not None:
                     slot[1][:] = list(msg[2:])
                     slot[0].set()
+            elif kind == "pulled":
+                # a staged (or localization) peer pull completed: this
+                # node now holds a COPY — register it as a secondary
+                # location so later leases can score/stage against it,
+                # and count the cross-node bytes moved
+                oid = ObjectID(msg[1])
+                self._worker.gcs.object_location_add_secondary(
+                    oid, self.node_index)
+                e = self._worker.memory_store.get_entry(oid)
+                if e is not None and e.size:
+                    self._worker.transfer_stats["bytes_pulled"] += e.size
             elif kind == "clock":
                 # clock handshake sample sent right after the daemon's
                 # hello (and after every rejoin): maps daemon wall-clock
@@ -365,15 +376,33 @@ class RemoteNodePool(ProcessWorkerPool):
             self._fetches.pop(fid, None)
             return None
         data = slot[1]
+        # the chaos transfer fault mutates the RAW received bytes HERE,
+        # before any consumer sees them — the frame-completeness check
+        # (worker.fetch_object_bytes) must observe the injected
+        # truncation, never a pristine buffer with the fault applied
+        # downstream of the check
         fault = self._chaos.poll("transfer", node=self.node_index,
                                  object=oid.hex()[:16])
         if fault is not None and data:
             keep = max(1, int(len(data) * fault.get("keep_fraction", 0.5)))
             data = data[:keep]
+        if data:
+            # head-mediated fetches are cross-node traffic too: count
+            # them so bytes-saved accounting reconciles against the
+            # total arg bytes moved
+            self._worker.transfer_stats["bytes_pulled"] += len(data)
         return data
 
     def free_remote(self, oids: List[ObjectID]) -> None:
         self._send_daemon(("free", [o.binary() for o in oids]))
+
+    def stage_args(self, entries: List[tuple]) -> None:
+        """Dispatch-time staging: (oid_bin, peer_address, nbytes)
+        triples the daemon's pull manager starts fetching NOW, while
+        the lease waits in the worker queue. Fire-and-forget — a lost
+        or failed pull just means the exec-time localization path pays
+        the transfer as before."""
+        self._send_daemon(("stage", entries))
 
     # -- log plane queries ---------------------------------------------
     def _log_request(self, msg_tail: tuple,
@@ -417,13 +446,14 @@ class RemoteNodePool(ProcessWorkerPool):
         if not isinstance(v, ObjectRef):
             return v
         oid = v.object_id()
-        loc = self._worker.gcs.object_location_get(oid)
-        if loc == self.node_index:
-            # already resident in the target node's arena: the worker
-            # reads it zero-copy through its daemon (no wire bytes)
+        locs = self._worker.gcs.object_locations(oid)
+        if self.node_index in locs:
+            # already resident in the target node's arena (primary OR a
+            # staged secondary copy): the worker reads it zero-copy
+            # through its daemon (no wire bytes)
             return _PullValue(oid.binary())
-        if loc is not None and loc != self.node_index \
-                and self._worker.peer_address_of(loc) is not None:
+        if any(self._worker.peer_address_of(n) is not None
+               for n in locs):
             # resident on a THIRD node with a peer endpoint: ship the
             # pull marker — the worker's get flows daemon -> head,
             # whose reply directs a direct peer pull (bytes travel
@@ -447,8 +477,11 @@ class RemoteNodePool(ProcessWorkerPool):
                              entries: list) -> None:
         for oid, entry in zip(return_ids, entries):
             if entry[0] == "remote_shm":
+                # size recorded so locality scoring / staging know the
+                # arg bytes without a cross-node round trip
                 self._worker.memory_store.put(
-                    oid, RemotePlaceholder(self.node_index))
+                    oid, RemotePlaceholder(self.node_index),
+                    size=int(entry[1] or 0))
                 self._worker.gcs.object_location_add(oid, self.node_index)
             else:
                 from ray_tpu._private.serialization import (SerializedObject,
@@ -465,7 +498,8 @@ class RemoteNodePool(ProcessWorkerPool):
         self._worker.reference_counter.add_owned_object(oid)
         self._worker.reference_counter.add_borrower(oid, h.worker_id)
         self._task_borrows(h).add(oid)
-        self._worker.memory_store.put(oid, RemotePlaceholder(self.node_index))
+        self._worker.memory_store.put(oid, RemotePlaceholder(self.node_index),
+                                      size=int(loc[1] or 0))
         self._worker.gcs.object_location_add(oid, self.node_index)
         self._worker.scheduler.notify_object_ready(oid)
         return True
@@ -484,12 +518,18 @@ class RemoteNodePool(ProcessWorkerPool):
                 continue
             value = entry.value
             if isinstance(value, RemotePlaceholder):
-                if value.node_index == self.node_index:
-                    # resident on the REQUESTING node: daemon rewrites
-                    # this to a zero-copy arena location
+                locs = self._worker.gcs.object_locations(oid)
+                if value.node_index not in locs:
+                    locs.append(value.node_index)
+                if self.node_index in locs:
+                    # resident on the REQUESTING node (primary or a
+                    # staged secondary): daemon rewrites this to a
+                    # zero-copy arena location
                     out.append(("node_shm", oid.binary()))
                     continue
-                peer = self._worker.peer_address_of(value.node_index)
+                peer = next(
+                    (p for p in (self._worker.peer_address_of(n)
+                                 for n in locs) if p is not None), None)
                 if peer is not None:
                     # DIRECT node-to-node pull: reply with the
                     # producer's peer endpoint; the consuming daemon

@@ -29,6 +29,12 @@ class SchedulerBase:
     # hook entirely: READY defaults to SUBMITTED at read time).
     task_events = None
 
+    # Optional object-location provider the worker attaches after
+    # construction: locations_of(object_id) -> List[int] of node rows
+    # holding a copy (primary first). Drives the locality scoring
+    # column; None (or an empty list per oid) disables it.
+    locations_of = None
+
     def submit(self, task: PendingTask) -> None:
         raise NotImplementedError
 

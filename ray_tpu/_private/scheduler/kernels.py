@@ -52,7 +52,10 @@ def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
               avail: np.ndarray, cap: np.ndarray,
               threshold: float,
               class_mask: Optional[np.ndarray] = None,
-              class_spread: Optional[np.ndarray] = None
+              class_spread: Optional[np.ndarray] = None,
+              locality: Optional[np.ndarray] = None,
+              outstanding: Optional[np.ndarray] = None,
+              spill_depth: int = 0
               ) -> Tuple[np.ndarray, np.ndarray]:
     """Assign ready tasks (by arena index) to nodes.
 
@@ -61,6 +64,16 @@ def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
     normal classes exclude bundle rows; node-affinity pins to one row).
     class_spread [K] bool disables the hybrid local-node bias for
     SPREAD-strategy classes. None = no restriction / no spread.
+
+    locality [len(ready_idx),N] float scores each ready task's
+    candidate nodes by resident-arg-bytes (0 = no input data there).
+    A task with any nonzero row prefers its argmax node when feasible;
+    if that node is momentarily full it WAITS for it — but only while
+    the node has fewer than ``spill_depth`` leases outstanding
+    (``outstanding`` [N] int), beyond which the task spills back to
+    the normal least-loaded fill so a hot node never serializes the
+    cluster. SPREAD classes and placement masks override locality.
+    None = pre-locality behavior, byte-for-byte.
 
     Returns (node_of_ready [len(ready_idx)] int32 with -1 for
     not-assigned-this-tick, updated avail). Mutates nothing.
@@ -81,6 +94,40 @@ def assign_np(ready_idx: np.ndarray, cls: np.ndarray, demands: np.ndarray,
         elig = alive if class_mask is None else (alive & class_mask[c])
         spread = bool(class_spread[c]) if class_spread is not None else False
         active = d > 0
+
+        # locality pre-pass: tasks with resident input bytes go to (or
+        # wait for) the eligible node holding the most of them; the
+        # remainder flows through the normal hybrid fill below
+        if locality is not None and not spread:
+            loc_rows = np.where(elig[None, :], locality[members], 0.0)
+            cand = np.flatnonzero(loc_rows.max(axis=1) > 0.0)
+            if len(cand):
+                handled = np.zeros(len(members), dtype=bool)
+                if active.any():
+                    cap_ok_l = (cap[:, active] >= d[active]).all(axis=1)
+                else:
+                    cap_ok_l = np.ones(n_nodes, dtype=bool)
+                pend = (outstanding.astype(np.int64).copy()
+                        if outstanding is not None
+                        else np.zeros(n_nodes, dtype=np.int64))
+                for j in cand:
+                    pref = int(np.argmax(loc_rows[j]))
+                    if not cap_ok_l[pref]:
+                        continue  # never feasible there: spill now
+                    fits_now = (not active.any()) or bool(
+                        (avail[pref, active] >= d[active]).all())
+                    if fits_now:
+                        out[members[j]] = pref
+                        avail[pref] -= d
+                        pend[pref] += 1
+                        handled[j] = True
+                    elif pend[pref] < spill_depth:
+                        # bounded wait: stay unassigned this tick
+                        # rather than pay the transfer elsewhere
+                        handled[j] = True
+                members = members[~handled]
+                if len(members) == 0:
+                    continue
         if active.any():
             with np.errstate(divide="ignore", invalid="ignore"):
                 per_r = np.floor(avail[:, active] / d[active])

@@ -95,6 +95,10 @@ class TensorScheduler(SchedulerBase):
         self._tid_of: Dict[int, TaskID] = {}
         self._waiters: Dict[ObjectID, List[int]] = {}  # oid -> slots
         self._deps_of: Dict[int, List[ObjectID]] = {}  # slot -> pending oids
+        # slot -> ((ObjectID, nbytes), ...) stamped at submit: drives the
+        # locality column. A dict (not an array) because only tasks with
+        # ObjectRef args under remote clusters carry it — usually sparse.
+        self._argsz: Dict[int, Tuple] = {}
 
         self._class_index: Dict[Tuple, int] = {}
         self._demands = np.zeros((0, n_res), dtype=np.float32)
@@ -585,6 +589,9 @@ class TensorScheduler(SchedulerBase):
             self._indeg[slot] = len(pending_deps)
             if pending_deps:
                 self._deps_of[slot] = pending_deps
+            sizes = getattr(spec, "arg_sizes", None)
+            if sizes:
+                self._argsz[slot] = sizes
             self._state[slot] = WAITING
 
         # 2) object-ready wave (batched indegree scatter)
@@ -659,9 +666,39 @@ class TensorScheduler(SchedulerBase):
             return None
         if self._mask_dirty:
             self._rebuild_masks_locked()
+        locality = None
+        outstanding = None
+        if (self._argsz and GLOBAL_CONFIG.scheduler_locality
+                and self.locations_of is not None):
+            locality = self._locality_matrix_locked(ready_idx)
+            if locality is not None:
+                outstanding = self._outstanding.copy()
         return (ready_idx, self._cls[ready_idx].copy(), self._demands.copy(),
                 self._avail.copy(), self._cap.copy(),
-                self._class_mask.copy(), self._class_spread.copy())
+                self._class_mask.copy(), self._class_spread.copy(),
+                locality, outstanding)
+
+    def _locality_matrix_locked(self, ready_idx) -> Optional[np.ndarray]:
+        """[len(ready_idx), N] resident-arg-bytes per candidate node,
+        aligned to ready positions. A copy of unknown size weighs one
+        byte so it still attracts. None when no ready task has any arg
+        with a known remote location (the kernel's fast path)."""
+        argsz = self._argsz
+        locs_of = self.locations_of
+        N = len(self._node_states)
+        m = None
+        for pos, slot in enumerate(ready_idx):
+            sizes = argsz.get(int(slot))
+            if not sizes:
+                continue
+            for oid, nbytes in sizes:
+                for node in locs_of(oid):
+                    if 0 <= node < N:
+                        if m is None:
+                            m = np.zeros((len(ready_idx), N),
+                                         dtype=np.float64)
+                        m[pos, node] += max(int(nbytes), 1)
+        return m
 
     def _mask_row(self, place: Tuple,
                   custom: Dict[str, float] = {}) -> Tuple[np.ndarray, bool]:
@@ -744,7 +781,7 @@ class TensorScheduler(SchedulerBase):
         """Batched assignment OUTSIDE the lock (jit compilation of the jax
         path can take seconds and must not block submit()/notify_*)."""
         (ready_idx, ready_cls, demands, avail, cap, class_mask,
-         class_spread) = snapshot
+         class_spread, locality, outstanding) = snapshot
         backend = GLOBAL_CONFIG.sched_backend
         # class count no longer gates the device path: the kernel scans the
         # class axis (class as data), so many classes don't grow the program
@@ -759,6 +796,11 @@ class TensorScheduler(SchedulerBase):
         use_jax = (backend == "jax"
                    or (backend == "auto" and big
                        and self._calib_state == "jax"))
+        if locality is not None:
+            # the device kernel has no locality column; ticks with
+            # resident-arg scores run the numpy path (sparse in practice:
+            # only batches containing tasks with remotely-located args)
+            use_jax = False
         threshold = GLOBAL_CONFIG.sched_hybrid_threshold
         if use_jax:
             try:
@@ -779,7 +821,9 @@ class TensorScheduler(SchedulerBase):
             cls_full[ready_idx] = ready_cls
             node_of_ready, new_avail = kernels.assign_np(
                 ready_idx, cls_full, demands, avail, cap, threshold,
-                class_mask, class_spread)
+                class_mask, class_spread,
+                locality=locality, outstanding=outstanding,
+                spill_depth=GLOBAL_CONFIG.locality_spillback_queue_depth)
             dt = time.perf_counter() - t0
             self._np_cost = 0.8 * self._np_cost + 0.2 * dt if self._np_cost else dt
         return ready_idx, node_of_ready, new_avail
@@ -792,8 +836,10 @@ class TensorScheduler(SchedulerBase):
         with large ready batches the device kernel wins. Never stalls the
         tick loop: numpy serves until the verdict is in."""
         self._calib_state = "warming"
+        # calibration times the device kernel, which has no locality
+        # column — the trailing locality/outstanding entries are unused
         (ready_idx, ready_cls, demands, avail, cap, class_mask,
-         class_spread) = snapshot
+         class_spread) = snapshot[:7]
         threshold = GLOBAL_CONFIG.sched_hybrid_threshold
 
         def _calibrate() -> None:
@@ -936,6 +982,7 @@ class TensorScheduler(SchedulerBase):
 
     def _release_slot(self, slot: int) -> None:
         self._windowed[slot] = False
+        self._argsz.pop(slot, None)
         self._tasks.pop(slot, None)
         tid = self._tid_of.pop(slot, None)
         if tid is not None and self._slot_of.get(tid) == slot:

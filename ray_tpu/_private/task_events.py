@@ -51,6 +51,7 @@ START = 9       # execution window (worker-side, clock-aligned)
 END = 10
 STATE = 11      # "LIVE" | "FINISHED" | "FAILED"
 RETRIED = 12    # failed attempt that was retried (not terminal)
+STAGED = 13     # dispatch-time arg staging kicked off (None = no staging)
 
 _LIVE, _FINISHED, _FAILED = "LIVE", "FINISHED", "FAILED"
 
@@ -125,7 +126,7 @@ class TaskEventAggregator:
     def _new_rec(self, task_id: Any, name: str, attempt: int,
                  now: float) -> list:
         return [task_id, name, attempt, -1, None, None,
-                now, None, None, None, None, _LIVE, False]
+                now, None, None, None, None, _LIVE, False, None]
 
     def record_submitted_batch(self, specs: Iterable[Any]) -> None:
         now = time.time()
@@ -161,6 +162,18 @@ class TaskEventAggregator:
                 rec = live.get(tid)
                 if rec is not None:
                     rec[DISPATCHED] = now
+                    rec[NODE] = node
+
+    def record_staged(self, task_id: Any, node: int = -1) -> None:
+        """Dispatch-time arg staging began for this attempt: the head
+        shipped known peer locations with the lease so the target
+        daemon's pull manager overlaps transfers with queue wait."""
+        now = time.time()
+        with self._lock:
+            rec = self._live.get(task_id)
+            if rec is not None:
+                rec[STAGED] = now
+                if node >= 0:
                     rec[NODE] = node
 
     def record_exec(self, task_id: Any,
@@ -432,6 +445,7 @@ def _detail(rec: list) -> Dict[str, Any]:
         "submitted_at": rec[SUBMITTED],
         "ready_at": rec[READY],
         "dispatched_at": rec[DISPATCHED],
+        "staged_at": rec[STAGED] if len(rec) > STAGED else None,
         "start_at": rec[START],
         "end_at": rec[END],
         "queue_s": q,

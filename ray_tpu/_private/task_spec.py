@@ -90,6 +90,12 @@ class TaskSpec:
     # (task_id, ids) memo: return_ids() runs on both the submit and the
     # completion hot paths; keyed by the id because retries mutate task_id
     _rid_memo: Any = None
+    # per-arg (ObjectID, nbytes) summary stamped at submit for the
+    # scheduler's locality scoring and dispatch-time arg staging; None
+    # when the task has no ObjectRef args (the common fast path). NOT
+    # part of scheduling_class(): tasks differing only in arg objects
+    # must still share a class/lease.
+    arg_sizes: Any = None
 
     def return_ids(self) -> List[ObjectID]:
         memo = self._rid_memo

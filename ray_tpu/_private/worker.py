@@ -453,9 +453,16 @@ class Worker:
         self.client_server = None
 
         # cross-node transfer accounting (tests assert the head's relay
-        # stays flat when a direct peer path exists)
+        # stays flat when a direct peer path exists). locality_hit/miss
+        # count dispatches whose args were fully/partially resident on
+        # the chosen node; bytes_pulled is cross-node staging traffic,
+        # bytes_saved is arg bytes already resident where the task ran.
         self.transfer_stats: Dict[str, int] = {"head_relayed_bytes": 0,
-                                               "head_relayed_objects": 0}
+                                               "head_relayed_objects": 0,
+                                               "locality_hits": 0,
+                                               "locality_misses": 0,
+                                               "bytes_pulled": 0,
+                                               "bytes_saved": 0}
         # single-flight head-side peer pulls (oid -> completion event)
         self._head_pull_lock = threading.Lock()
         self._head_pulls: Dict[ObjectID, threading.Event] = {}
@@ -478,6 +485,9 @@ class Worker:
                             if GLOBAL_CONFIG.task_events_max != 0
                             else None)
         self.scheduler.task_events = self.task_events
+        # locality column input: the scheduler reads copy locations
+        # straight off the GCS object directory (primary first)
+        self.scheduler.locations_of = self.gcs.object_locations
         self.metrics_server = None
         if GLOBAL_CONFIG.metrics_export_port:
             from ray_tpu._private.metrics import MetricsServer
@@ -911,6 +921,7 @@ class Worker:
         spec._deps_memo = deps  # args never change; reused at completion
         if deps:
             self.reference_counter.add_submitted_task_references(deps)
+            self._stamp_arg_sizes(spec, deps)
         self.task_manager.add_pending(spec, deps)
         self.events.record(spec.task_id, spec.name, "submitted",
                            attempt=spec.attempt_number)
@@ -953,6 +964,8 @@ class Worker:
             deps = (_top_level_deps(spec.args, spec.kwargs)
                     if (spec.args or spec.kwargs) else [])
             spec._deps_memo = deps
+            if deps:
+                self._stamp_arg_sizes(spec, deps)
             all_deps.extend(deps)
         self.reference_counter.register_submit_batch(owned, all_deps)
         self.task_manager.add_pending_batch(specs)
@@ -982,6 +995,24 @@ class Worker:
             out.append(refs)
         self.scheduler.submit_many(pendings)
         return out
+
+    def _stamp_arg_sizes(self, spec: TaskSpec, deps: List[ObjectID]) -> None:
+        """Per-arg (ObjectID, nbytes) summary for locality scoring and
+        dispatch-time staging. Only stamped when remote arenas exist and
+        the knob is on: single-node runs (and locality-off runs) skip
+        the per-dep size lookups entirely, keeping submit byte-for-byte
+        pre-locality."""
+        if not self._has_remote_nodes \
+                or not GLOBAL_CONFIG.scheduler_locality:
+            return
+        get_entry = self.memory_store.get_entry
+        sizes = []
+        for d in deps:
+            e = get_entry(d)
+            # 0 = size unknown (dep not yet produced); the scheduler
+            # still counts the copy, weighted minimally
+            sizes.append((d, e.size if e is not None else 0))
+        spec.arg_sizes = tuple(sizes)
 
     def cancel_task(self, ref: ObjectRef, force: bool = False) -> None:
         task_id = ref.task_id()
@@ -1044,6 +1075,47 @@ class Worker:
         if fault is not None:
             time.sleep(fault.get("delay_s", 0.05))
 
+    def _stage_args(self, pool, pending: PendingTask) -> None:
+        """Dispatch-time arg staging: args NOT resident on the assigned
+        node but resident on a peer with a transfer endpoint ship their
+        known locations with the lease, so the daemon's pull manager
+        overlaps the peer pull with the task's queue wait (instead of
+        paying the transfer at exec start). Also keeps the locality
+        hit/miss and bytes-saved/pulled accounting."""
+        sizes = getattr(pending.spec, "arg_sizes", None)
+        if not sizes:
+            return
+        stage: List[tuple] = []
+        resident = 0
+        missing = 0
+        located = 0
+        for oid, nbytes in sizes:
+            locs = self.gcs.object_locations(oid)
+            if not locs:
+                continue  # head-resident: embedded in the lease payload
+            located += 1
+            if pool.node_index in locs:
+                resident += nbytes
+                continue
+            missing += 1
+            for src in locs:
+                peer = self.peer_address_of(src)
+                if peer is not None:
+                    stage.append((oid.binary(), tuple(peer), nbytes))
+                    break
+        if located:
+            ts = self.transfer_stats
+            if missing:
+                ts["locality_misses"] += 1
+            else:
+                ts["locality_hits"] += 1
+            ts["bytes_saved"] += resident
+        if stage:
+            pool.stage_args(stage)
+            if self.task_events is not None:
+                self.task_events.record_staged(pending.spec.task_id,
+                                               pending.node_index)
+
     def _dispatch(self, pending: PendingTask) -> None:
         self._chaos_tick()
         self.events.record(pending.spec.task_id, pending.spec.name,
@@ -1059,6 +1131,8 @@ class Worker:
             self._pool.submit(self._boot_actor, pending, boot)
         elif (pool is not None
               and pending.spec.task_type == TaskType.NORMAL_TASK):
+            if pool.is_remote:
+                self._stage_args(pool, pending)
             # lease grant: the decision becomes a payload shipped to a
             # worker process on the ASSIGNED node (payload build + pipe
             # send run OFF the tick thread: a full pipe buffer blocks
@@ -1573,18 +1647,28 @@ class Worker:
         # 1) no new assignments to the node (also invalidates in-flight
         #    snapshot decisions at apply time)
         self.scheduler.remove_node(entry.index)
-        # 1b) objects primary-resident in the dead node's arena are LOST
-        #     unless already fetched/memoized head-side; drop them so a
-        #     later get() reconstructs from lineage
+        # 1b) the dead node's copies leave the object directory. Objects
+        #     whose LAST copy died are LOST unless already
+        #     fetched/memoized head-side — drop them so a later get()
+        #     reconstructs from lineage. Objects with a surviving
+        #     secondary (a completed staging pull) promote it to primary
+        #     instead: the head's placeholder repoints and no
+        #     reconstruction is needed.
         from ray_tpu._private.runtime.process_pool import RemotePlaceholder
-        for oid in self.gcs.objects_on_node(entry.index):
-            self.gcs.object_location_pop(oid)
+        lost, promoted = self.gcs.drop_node_locations(entry.index)
+        for oid in lost:
             e = self.memory_store.get_entry(oid)
             if e is not None and not e.is_exception \
                     and isinstance(e.value, RemotePlaceholder) \
                     and e.value.node_index == entry.index:
                 self.object_recovery.note_freed(oid)
                 self.memory_store.delete([oid])
+        for oid, new_primary in promoted.items():
+            e = self.memory_store.get_entry(oid)
+            if e is not None and not e.is_exception \
+                    and isinstance(e.value, RemotePlaceholder) \
+                    and e.value.node_index == entry.index:
+                e.value.node_index = new_primary
         # 2) placement groups with bundles on the node reschedule
         self.placement_groups.on_node_dead(entry.index)
         # 3) fail queued + running work retriably; kill worker processes.
@@ -2104,12 +2188,11 @@ class Worker:
             self._free_remote_copy(oid)
 
     def _free_remote_copy(self, object_id: ObjectID) -> None:
-        node = self.gcs.object_location_pop(object_id)
-        if node is None:
-            return
-        pool = self._node_pools.get(node)
-        if pool is not None and getattr(pool, "is_remote", False):
-            pool.free_remote([object_id])
+        # EVERY copy frees — staged secondaries pin peer arenas too
+        for node in self.gcs.object_locations_pop(object_id):
+            pool = self._node_pools.get(node)
+            if pool is not None and getattr(pool, "is_remote", False):
+                pool.free_remote([object_id])
 
     def _on_object_out_of_scope(self, object_id: ObjectID) -> None:
         # Deferred batch free: __del__-driven releases arrive one at a

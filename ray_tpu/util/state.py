@@ -77,19 +77,27 @@ def list_actors() -> List[Dict[str, Any]]:
 
 
 @_client_dispatch
-def list_objects() -> List[Dict[str, Any]]:
-    """Objects in the owner's store (+ shm residency and pin counts)."""
+def list_objects(locations: bool = False) -> List[Dict[str, Any]]:
+    """Objects in the owner's store (+ shm residency and pin counts).
+
+    ``locations=True`` adds each object's node rows from the GCS object
+    directory, primary copy first — staged secondary copies (peer pulls
+    completed by the locality-aware dispatcher) show up here. An empty
+    list means the object lives only in the head's store."""
     w = worker_mod.get_worker()
     rows = []
     for oid, entry in w.memory_store.entries():
-        rows.append({
+        row = {
             "object_id": oid.hex(),
             "is_exception": entry.is_exception,
             "size": entry.size,
             "in_shm": (w.shm_store is not None
                        and w.shm_store.locate(oid) is not None),
             "local_refs": w.reference_counter.num_local_references(oid),
-        })
+        }
+        if locations:
+            row["locations"] = w.gcs.object_locations(oid)
+        rows.append(row)
     return rows
 
 

@@ -274,8 +274,10 @@ class TestProcessCapture:
         text = metrics.render_all(log_ray)
         assert "ray_tpu_log_lines_emitted_total" in text
         assert "ray_tpu_log_lines_dropped_total" in text
-        m = re.search(r"ray_tpu_log_bytes_written_total (\d+)", text)
+        m = re.search(r"ray_tpu_log_bytes_resident (\d+)", text)
         assert m and int(m.group(1)) > 0
+        # the deprecated alias's removal window has elapsed
+        assert "ray_tpu_log_bytes_written_total" not in text
 
 
 # ----------------------------------------------------------------------

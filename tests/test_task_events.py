@@ -265,11 +265,16 @@ class TestTaskEventPlane:
             m = re.search(rf"{family}_count (\d+)", text)
             assert m and int(m.group(1)) > 0, family
         assert "ray_tpu_tasks_failed_total" in text
-        # the log-bytes retype satellite: new gauge present, old name
-        # still emitted (deprecated) for one release
+        # the log-bytes retype: gauge present, deprecated alias gone
+        # (its one-release window has elapsed)
         assert "# TYPE ray_tpu_log_bytes_resident gauge" in text
-        assert "ray_tpu_log_bytes_written_total" in text
-        assert "DEPRECATED" in text
+        assert "ray_tpu_log_bytes_written_total" not in text
+        # locality/transfer accounting families are schema-stable
+        for fam in ("ray_tpu_sched_locality_hit_total",
+                    "ray_tpu_sched_locality_miss_total",
+                    "ray_tpu_transfer_bytes_pulled_total",
+                    "ray_tpu_transfer_bytes_saved_total"):
+            assert f"# TYPE {fam} counter" in text
 
     def test_retry_becomes_two_attempts(self, te_ray):
         from ray_tpu import chaos
