@@ -232,6 +232,26 @@ def main() -> int:
 
     from ray_tpu._private import benchmarks, perf
 
+    # --- static analysis gate (raylint) --------------------------------
+    # cheap and host-independent, so it always runs: the five AST passes
+    # must stay interactive (<10s wall) and find nothing new
+    if section("lint", 15):
+        from ray_tpu._private import analysis
+        t0 = time.perf_counter()
+        report = analysis.run_all()
+        lint_s = time.perf_counter() - t0
+        OUT["lint"] = {"seconds": round(lint_s, 3),
+                       "new": len(report.new),
+                       "baselined": len(report.baselined),
+                       "stale_suppressions": len(report.stale_suppressions),
+                       "durations_s": {k: round(v, 3)
+                                       for k, v in report.durations.items()}}
+        print(f"  lint: {len(report.new)} new, {len(report.baselined)} "
+              f"baselined in {lint_s:.2f}s", file=sys.stderr)
+        assert lint_s < 10.0, f"raylint took {lint_s:.1f}s (budget 10s)"
+        assert report.ok, "raylint found NEW findings:\n" + report.render_text()
+        _emit()
+
     if run_all and section("baseline_configs", 60):
         results = benchmarks.run_all("smoke" if smoke else "full")
         for name, r in results.items():

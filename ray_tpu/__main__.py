@@ -268,6 +268,26 @@ def _cmd_summary(args) -> int:
     return 0
 
 
+def _cmd_lint(args) -> int:
+    """Run raylint — the five framework-invariant static-analysis
+    passes (lock order, shared state, wire protocol, knobs, registries)
+    over the installed ray_tpu package. Exit 1 on findings not covered
+    by analysis/baseline.json."""
+    from ray_tpu._private import analysis
+
+    report = analysis.run_all()
+    if args.update_baseline:
+        analysis.save_baseline([f.key for f in report.findings])
+        print(f"baseline updated: {len(report.findings)} suppression(s)"
+              f" written to {analysis.BASELINE_PATH}")
+        return 0
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        print(report.render_text())
+    return 0 if report.ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ray_tpu",
@@ -346,6 +366,15 @@ def main(argv=None) -> int:
     p = sub.add_parser("summary", help="summarize a timeline trace")
     p.add_argument("trace", help="JSON from ray_tpu.timeline(file)")
     p.set_defaults(fn=_cmd_summary)
+
+    p = sub.add_parser("lint", help="run raylint static-analysis "
+                       "passes over the package")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite analysis/baseline.json to suppress "
+                   "every current finding")
+    p.set_defaults(fn=_cmd_lint)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,113 @@
+"""Pass 4 — knob consistency.
+
+Every knob registered in ``_private/config.py`` must be
+
+- **overridable from the environment** — satisfied by construction:
+  ``ConfigRegistry.define`` applies ``RAY_TPU_<NAME>`` itself, so a
+  knob cannot lack an override. The pass still verifies the knob name
+  is a valid env-suffix identifier (lowercase, no dashes) so the
+  override actually resolves.
+- **read somewhere** — at least one site in the package (outside
+  config.py itself) reads it, via attribute access
+  (``GLOBAL_CONFIG.task_events_max``), ``.get("name")`` /
+  ``.entry("name")`` / ``set(...)`` string use, or an
+  ``RAY_TPU_<NAME>`` env literal. A knob nobody reads is dead — the
+  ``log_dir`` class of bug (PR 3).
+- **documented** — mentioned in README.md (plain substring; the README
+  uses backticked knob names).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+from ray_tpu._private.analysis._astutil import (const_str,
+                                                iter_py_files,
+                                                parse_file)
+
+PASS = "knob"
+
+_DEFINE_CALLEES = {"_d", "define"}
+
+
+def collect_knobs(config_tree: ast.Module) -> Dict[str, int]:
+    """knob name -> definition line, from ``_d("name", ...)`` /
+    ``REG.define("name", ...)`` calls."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(config_tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name not in _DEFINE_CALLEES:
+            continue
+        knob = const_str(node.args[0])
+        if knob:
+            out[knob] = node.lineno
+    return out
+
+
+def collect_reads(root: str, config_relpath: str,
+                  knobs: Set[str]) -> Dict[str, int]:
+    """knob -> count of read sites across the package."""
+    env_names = {f"RAY_TPU_{k.upper()}": k for k in knobs}
+    reads: Dict[str, int] = {k: 0 for k in knobs}
+    for rel, ap in iter_py_files(root):
+        if rel == config_relpath:
+            continue
+        tree = parse_file(ap)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr in knobs:
+                reads[node.attr] += 1
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                if node.value in knobs:
+                    reads[node.value] += 1
+                elif node.value in env_names:
+                    reads[env_names[node.value]] += 1
+    return reads
+
+
+def analyze(root: str, make_finding,
+            config_relpath: str = "_private/config.py",
+            readme_path: Optional[str] = None) -> List:
+    findings: List = []
+    config_path = os.path.normpath(os.path.join(root, config_relpath))
+    tree = parse_file(config_path)
+    if tree is None:
+        return findings
+    knobs = collect_knobs(tree)
+    if readme_path is None:
+        readme_path = os.path.normpath(
+            os.path.join(root, "..", "README.md"))
+    try:
+        with open(readme_path, "r", encoding="utf-8") as f:
+            readme = f.read()
+    except OSError:
+        readme = ""
+
+    reads = collect_reads(root, config_relpath, set(knobs))
+    for name, line in sorted(knobs.items()):
+        if not re.fullmatch(r"[a-z][a-z0-9_]*", name):
+            findings.append(make_finding(
+                f"{PASS}:bad-name:{name}",
+                f"knob {name!r} is not a lowercase identifier, so its "
+                f"RAY_TPU_ env override cannot resolve",
+                config_relpath, line))
+        if reads.get(name, 0) == 0:
+            findings.append(make_finding(
+                f"{PASS}:dead:{name}",
+                f"knob {name!r} is defined but never read anywhere in "
+                f"the package", config_relpath, line))
+        if readme and name not in readme:
+            findings.append(make_finding(
+                f"{PASS}:undocumented:{name}",
+                f"knob {name!r} is not mentioned in README.md",
+                config_relpath, line))
+    return findings

@@ -162,16 +162,23 @@ _d("object_store_memory", int, 256 * 1024 * 1024,
    "shared-memory object store arena bytes per node")
 _d("object_spill_dir", str, "", "directory for spilled objects; empty = session dir")
 _d("object_spill_threshold", float, 0.8,
-   "fraction of object store usage that triggers spilling of primary copies")
-_d("max_direct_call_object_size", int, 100 * 1024, "alias of inline max")
+   "when a full arena forces a spill, evict down to this fraction of "
+   "capacity (hysteresis: the next create shouldn't immediately spill "
+   "again); >= 1.0 frees only what the triggering allocation needs")
+_d("max_direct_call_object_size", int, 100 * 1024,
+   "reference-API alias of inline_object_max_bytes: overriding it "
+   "flows into the real knob at init() unless inline_object_max_bytes "
+   "was itself overridden")
 _d("object_transfer_timeout_s", float, 120.0,
    "give up on a cross-node object fetch after this (guards a hung node "
    "daemon; sized for multi-GB transfers, not as a liveness probe)")
 
 # -- scheduler (device-resident kernel parameters) -------------------------
 _d("sched_tick_interval_s", float, 0.0005, "min seconds between scheduler ticks")
-_d("sched_arena_capacity", int, 1 << 20,
-   "task arena slots resident on device (ring buffer, compacted)")
+_d("sched_arena_capacity", int, 4096,
+   "TensorScheduler starting task-arena slot count (arrays double on "
+   "overflow; raise for sustained million-task graphs to avoid regrow "
+   "copies)")
 _d("sched_max_edges", int, 1 << 22, "dependency CSR edge capacity")
 _d("sched_num_resources", int, 4,
    "width R of the resource vectors (cpu, tpu, mem, custom)")
@@ -222,8 +229,12 @@ _d("data_split_queue_bytes", int, 64 * 1024 * 1024,
    "ray_tpu.data streaming_split: max buffered BYTES per consumer "
    "queue (sizes known for arena-resident blocks; inline blocks fall "
    "back to the block-count budget)")
-_d("health_check_period_s", float, 1.0, "control-plane health check period")
-_d("health_check_timeout_s", float, 5.0, "mark node dead after this")
+_d("health_check_period_s", float, 0.2,
+   "control-plane health probe period (GCS liveness loop)")
+_d("health_check_timeout_s", float, 0.6,
+   "wall-clock budget of consecutive failed liveness probes before a "
+   "node is declared dead (probe count = timeout / period; the "
+   "defaults keep the historical 3-probe grace)")
 _d("node_heartbeat_timeout_s", float, 5.0,
    "mark a node dead after this many seconds without a heartbeat, even "
    "if its daemon connection stays up (a hung-but-connected node must "

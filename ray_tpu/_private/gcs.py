@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu._private.analysis.runtime_checks import assert_holds
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID
 
 logger = logging.getLogger(__name__)
@@ -350,11 +351,17 @@ class GcsService:
     # object directory (objects resident on remote nodes; primary-first
     # location lists, secondaries registered by completed peer pulls)
     # ------------------------------------------------------------------
+    def _locs_locked(self, object_id: ObjectID):
+        """Location list of ``object_id`` (or None). Caller holds
+        self._lock — checked dynamically under RAY_TPU_DEBUG_LOCKS=1."""
+        assert_holds(self._lock, "GCS object directory")
+        return self._object_locations.get(object_id)
+
     def object_location_add(self, object_id: ObjectID, index: int) -> None:
         """Set/replace the PRIMARY location (inserts, or moves an
         existing secondary to the front)."""
         with self._lock:
-            locs = self._object_locations.get(object_id)
+            locs = self._locs_locked(object_id)
             if locs is None:
                 self._object_locations[object_id] = [index]
             else:
@@ -368,20 +375,20 @@ class GcsService:
         already tracked gain secondaries — an untracked oid means the
         primary was freed/invalidated and the copy is moot."""
         with self._lock:
-            locs = self._object_locations.get(object_id)
+            locs = self._locs_locked(object_id)
             if locs is not None and index not in locs:
                 locs.append(index)
 
     def object_location_get(self, object_id: ObjectID) -> Optional[int]:
         """The primary location, or None."""
         with self._lock:
-            locs = self._object_locations.get(object_id)
+            locs = self._locs_locked(object_id)
             return locs[0] if locs else None
 
     def object_locations(self, object_id: ObjectID) -> List[int]:
         """All known copies, primary first (empty when untracked)."""
         with self._lock:
-            return list(self._object_locations.get(object_id) or ())
+            return list(self._locs_locked(object_id) or ())
 
     def object_location_pop(self, object_id: ObjectID) -> Optional[int]:
         """Forget the object entirely; returns the old primary."""
@@ -557,9 +564,15 @@ class GcsService:
     # health checks (reference: GcsHealthCheckManager — periodic pings;
     # here: process liveness of each node's worker pool)
     # ------------------------------------------------------------------
-    def start_health_checks(self, interval: float = 0.2) -> None:
+    def start_health_checks(self,
+                            interval: Optional[float] = None) -> None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
         if self._health_thread is not None:
             return
+        if interval is None:
+            interval = GLOBAL_CONFIG.health_check_period_s
+        self.health_check_interval = interval
         self._health_thread = threading.Thread(
             target=self._health_loop, args=(interval,), daemon=True,
             name="ray_tpu_gcs_health")
@@ -572,9 +585,13 @@ class GcsService:
         chaos = get_controller()
         # consecutive-miss grace (reference: GcsHealthCheckManager's
         # failure_threshold): one missed probe must not kill a node
-        # whose daemon is merely busy (e.g. serving a large fetch)
+        # whose daemon is merely busy (e.g. serving a large fetch).
+        # health_check_timeout_s is the wall-clock failure budget; the
+        # probe count it buys depends on the period (0.6s / 0.2s = the
+        # historical 3 probes).
         misses: Dict[Any, int] = {}
-        threshold = 3
+        threshold = max(1, round(
+            GLOBAL_CONFIG.health_check_timeout_s / max(interval, 1e-6)))
         while not self._shutdown:
             time.sleep(interval)
             for e in self.alive_process_nodes():

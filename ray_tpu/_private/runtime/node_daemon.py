@@ -78,6 +78,7 @@ import time
 from multiprocessing.connection import Client, Listener
 from typing import Any, Dict, Optional
 
+from ray_tpu._private.analysis.runtime_checks import assert_holds
 from ray_tpu._private.ids import ObjectID
 
 
@@ -283,11 +284,23 @@ class PullManager:
         for t in self._threads:
             t.start()
 
+    def _enqueue_locked(self, priority: int, address, oid_bin: bytes,
+                        done, slot) -> None:
+        """Push a transfer onto the heap and wake a puller. Caller
+        holds self._cv (the heap, _seq, and _inflight move together) —
+        checked dynamically under RAY_TPU_DEBUG_LOCKS=1."""
+        import heapq
+
+        assert_holds(self._cv, "PullManager heap")
+        self._inflight[oid_bin] = []
+        self._seq += 1
+        heapq.heappush(self._heap, (priority, self._seq,
+                                    tuple(address), oid_bin, done, slot))
+        self._cv.notify()
+
     def pull(self, address, oid_bin: bytes, priority: int) -> bool:
         """Blocking: enqueue (or join the in-flight pull of the same
         object) and wait for the outcome."""
-        import heapq
-
         done = threading.Event()
         slot = [False]
         with self._cv:
@@ -295,12 +308,8 @@ class PullManager:
             if waiters is not None:
                 waiters.append((done, slot))
             else:
-                self._inflight[oid_bin] = []
-                self._seq += 1
-                heapq.heappush(self._heap, (priority, self._seq,
-                                            tuple(address), oid_bin,
-                                            done, slot))
-                self._cv.notify()
+                self._enqueue_locked(priority, address, oid_bin, done,
+                                     slot)
         done.wait()
         return slot[0]
 
@@ -309,17 +318,11 @@ class PullManager:
         (dispatch-time arg staging). A pull of the same object already
         in flight coalesces to a no-op; a later blocking pull() of the
         object joins this transfer's waiters as usual."""
-        import heapq
-
         with self._cv:
             if oid_bin in self._inflight:
                 return
-            self._inflight[oid_bin] = []
-            self._seq += 1
-            heapq.heappush(self._heap, (priority, self._seq,
-                                        tuple(address), oid_bin,
-                                        threading.Event(), [False]))
-            self._cv.notify()
+            self._enqueue_locked(priority, address, oid_bin,
+                                 threading.Event(), [False])
 
     def _run(self) -> None:
         import heapq
@@ -1074,6 +1077,15 @@ class NodeDaemon:
                 self._send_head(("pong", msg[1], pids))
             elif kind == "exit":
                 break
+            else:
+                # exhaustive dispatch: a tag this daemon doesn't know
+                # means head/daemon version (or protocol) drift — fail
+                # loudly instead of silently dropping control messages
+                import logging
+                logging.getLogger(__name__).error(
+                    "node daemon: unknown head message tag %r "
+                    "(protocol drift? head and node running different "
+                    "versions)", kind)
         self.shutdown()
 
     def _try_rejoin(self) -> bool:

@@ -781,14 +781,24 @@ class ProcessWorkerPool:
         if nxt is not None:
             self._assign(h, *nxt)
 
-    def _release(self, h: _Handle, task_id: TaskID) -> None:
+    def _take_inflight(self, h: _Handle, task_id: TaskID):
+        """Claim a completion/error: pop the inflight entry AND the
+        task index under the pool lock, so a concurrent
+        _on_worker_failure (monitor/tick threads) can never
+        double-handle the task as both completed and crashed. Returns
+        None when someone else (force-cancel, failure path) already
+        claimed it."""
         with self._lock:
             inf = h.inflight.pop(task_id, None)
             self._by_task.pop(task_id, None)
-        if inf is not None:
-            for oid in inf.borrows:
-                self._worker.reference_counter.remove_borrower(
-                    oid, h.worker_id)
+        return inf
+
+    def _release_taken(self, h: _Handle, inf) -> None:
+        """Post-claim half of _release for entries already popped by
+        _take_inflight."""
+        for oid in inf.borrows:
+            self._worker.reference_counter.remove_borrower(
+                oid, h.worker_id)
         self._mark_idle(h)
 
     def _store_entries(self, return_ids: List[ObjectID],
@@ -812,9 +822,9 @@ class ProcessWorkerPool:
 
     def _on_done(self, h: _Handle, task_id: TaskID, entries: list,
                  timing=None) -> None:
-        inf = h.inflight.get(task_id)
+        inf = self._take_inflight(h, task_id)
         if inf is None:
-            return  # force-cancel raced the completion
+            return  # force-cancel/worker-failure claimed the task first
         pending, spec = inf.pending, inf.pending.spec
         self.store_result_entries(inf.return_ids, entries)
         self._worker.task_manager.complete(spec.task_id)
@@ -824,7 +834,7 @@ class ProcessWorkerPool:
                 ((task_id, timing, h.worker_id.hex(), self.node_index),),
                 offset=self.clock_offset)
         self._finish_task(pending, task_id, None)
-        self._release(h, task_id)
+        self._release_taken(h, inf)
 
     def _on_done_batch(self, dones: List[tuple]) -> None:
         """N completions -> one store pass + ONE scheduler wakeup
@@ -879,9 +889,9 @@ class ProcessWorkerPool:
 
     def _on_err(self, h: _Handle, task_id: TaskID, exc_blob: bytes,
                 tb: str, timing=None) -> None:
-        inf = h.inflight.get(task_id)
+        inf = self._take_inflight(h, task_id)
         if inf is None:
-            return  # force-cancel raced the error
+            return  # force-cancel/worker-failure claimed the task first
         pending, spec = inf.pending, inf.pending.spec
         try:
             exc = cloudpickle.loads(exc_blob)
@@ -897,7 +907,7 @@ class ProcessWorkerPool:
                            offset=self.clock_offset)
         retry = self._worker._handle_task_failure(spec, inf.return_ids, exc)
         self._finish_task(pending, task_id, retry)
-        self._release(h, task_id)
+        self._release_taken(h, inf)
 
     def _finish_task(self, pending: PendingTask, exec_task_id: TaskID,
                      retry: Optional[PendingTask]) -> None:

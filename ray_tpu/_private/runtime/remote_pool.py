@@ -180,13 +180,20 @@ class RemoteNodePool(ProcessWorkerPool):
                     oid, self.node_index)
                 e = self._worker.memory_store.get_entry(oid)
                 if e is not None and e.size:
-                    self._worker.transfer_stats["bytes_pulled"] += e.size
+                    self._worker.note_transfer("bytes_pulled", e.size)
             elif kind == "clock":
                 # clock handshake sample sent right after the daemon's
                 # hello (and after every rejoin): maps daemon wall-clock
                 # timestamps onto the head's axis. Error ~ one-way link
                 # latency, far below task-span granularity.
                 self.clock_offset = time.time() - msg[1]
+            else:
+                # exhaustive dispatch: an unknown daemon tag means the
+                # wire protocol drifted (raylint pass 3 checks this
+                # statically; this guard catches version skew at runtime)
+                logger.error(
+                    "head: unknown daemon message tag %r from node %d "
+                    "(protocol drift?)", kind, self.node_index)
 
     def _on_daemon_lost(self) -> None:
         self._conn_dead = True
@@ -390,7 +397,7 @@ class RemoteNodePool(ProcessWorkerPool):
             # head-mediated fetches are cross-node traffic too: count
             # them so bytes-saved accounting reconciles against the
             # total arg bytes moved
-            self._worker.transfer_stats["bytes_pulled"] += len(data)
+            self._worker.note_transfer("bytes_pulled", len(data))
         return data
 
     def free_remote(self, oids: List[ObjectID]) -> None:

@@ -237,6 +237,14 @@ class ShmObjectStore:
         from ray_tpu._private.config import GLOBAL_CONFIG
 
         self.arena = ShmArena(capacity_bytes)
+        self._capacity = capacity_bytes
+        # spill hysteresis: once the arena is forced to spill, keep
+        # evicting until usage drops back under this fraction of
+        # capacity so the very next create doesn't spill again.
+        # >= 1.0 means purely reactive (free only what the allocation
+        # needs)
+        self._spill_threshold = float(
+            getattr(GLOBAL_CONFIG, "object_spill_threshold", 1.0))
         self._table: Dict[ObjectID, _Alloc] = {}
         self._spilled: Dict[ObjectID, Tuple[str, int]] = {}
         configured = getattr(GLOBAL_CONFIG, "object_spill_dir", "")
@@ -292,7 +300,14 @@ class ShmObjectStore:
         write commits, so concurrent readers never observe a window
         where the object is in neither table; the commit re-checks that
         the object wasn't freed or accessed while the write ran."""
-        while self.arena.free_bytes() < nbytes:
+        # object_spill_threshold adds hysteresis: a forced spill frees
+        # down to that fraction of capacity (not just the bytes this
+        # allocation needs), so a store hovering at the rim doesn't
+        # re-spill on every create; >= 1.0 is purely reactive
+        target = max(nbytes,
+                     nbytes + int(self._capacity
+                                  * (1.0 - self._spill_threshold)))
+        while self.arena.free_bytes() < target:
             with self._lock:
                 victim = next(
                     (oid for oid, a in self._table.items()

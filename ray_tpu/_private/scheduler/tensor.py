@@ -50,7 +50,7 @@ class TensorScheduler(SchedulerBase):
     def __init__(self, nodes: List[NodeState],
                  dispatcher: Callable[[PendingTask], None],
                  store_contains: Optional[Callable[[ObjectID], bool]] = None,
-                 initial_capacity: int = 4096):
+                 initial_capacity: Optional[int] = None):
         self._dispatch = dispatcher
         # batch lease-grant path: a dispatcher OBJECT may expose
         # dispatch_many(list) so one tick's grants ship per-worker in
@@ -77,7 +77,11 @@ class TensorScheduler(SchedulerBase):
         for n in nodes:
             self._append_node(n)
 
-        c = initial_capacity
+        # arena slots grow by doubling; the knob sets the starting size
+        # (bigger = fewer regrow copies on sustained load, more resident
+        # memory up front)
+        c = (initial_capacity if initial_capacity is not None
+             else GLOBAL_CONFIG.sched_arena_capacity)
         self._state = np.zeros(c, dtype=np.int8)
         self._indeg = np.zeros(c, dtype=np.int32)
         self._cls = np.zeros(c, dtype=np.int32)

@@ -36,6 +36,8 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from ray_tpu._private.analysis.runtime_checks import assert_holds
+
 # Record field indices.  Plain lists beat dataclasses ~3x on the
 # 100k-task submit path, and the aggregator is the only reader.
 TID = 0         # TaskID (hashable; .hex() for display)
@@ -268,6 +270,7 @@ class TaskEventAggregator:
     # internals (caller holds self._lock)
 
     def _finalize_locked(self, rec: list, state: str) -> None:
+        assert_holds(self._lock, "TaskEventAggregator ring")
         rec[STATE] = state
         if self._max == 0:
             return
@@ -290,6 +293,7 @@ class TaskEventAggregator:
             (self._finished or self._failed).popleft()
 
     def _trim_live_locked(self) -> None:
+        assert_holds(self._lock, "TaskEventAggregator live table")
         live = self._live
         while len(live) > self._live_cap:
             live.pop(next(iter(live)))

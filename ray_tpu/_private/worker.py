@@ -457,12 +457,17 @@ class Worker:
         # count dispatches whose args were fully/partially resident on
         # the chosen node; bytes_pulled is cross-node staging traffic,
         # bytes_saved is arg bytes already resident where the task ran.
+        # mutated from the scheduler tick, daemon demux threads, and
+        # head pull paths concurrently — all writers go through
+        # note_transfer() under _transfer_stats_lock (raylint
+        # shared_state pass: unguarded += across threads drops counts)
         self.transfer_stats: Dict[str, int] = {"head_relayed_bytes": 0,
                                                "head_relayed_objects": 0,
                                                "locality_hits": 0,
                                                "locality_misses": 0,
                                                "bytes_pulled": 0,
                                                "bytes_saved": 0}
+        self._transfer_stats_lock = threading.Lock()
         # single-flight head-side peer pulls (oid -> completion event)
         self._head_pull_lock = threading.Lock()
         self._head_pulls: Dict[ObjectID, threading.Event] = {}
@@ -724,8 +729,7 @@ class Worker:
         buf = peer_pull_bytes(peer, authkey, object_id, timeout)
         if buf is None:
             return None
-        self.transfer_stats["head_peer_pulled_objects"] = \
-            self.transfer_stats.get("head_peer_pulled_objects", 0) + 1
+        self.note_transfer("head_peer_pulled_objects")
         return deserialize(SerializedObject.from_bytes(memoryview(buf)))
 
     def _leader_pull(self, peer, authkey: bytes, object_id: ObjectID,
@@ -735,8 +739,7 @@ class Worker:
         if not peer_pull_once(peer, authkey, self.shm_store, object_id,
                               timeout):
             return None
-        self.transfer_stats["head_peer_pulled_objects"] = \
-            self.transfer_stats.get("head_peer_pulled_objects", 0) + 1
+        self.note_transfer("head_peer_pulled_objects")
         return self._read_pulled(object_id)
 
     def _read_pulled(self, object_id: ObjectID) -> Optional[Any]:
@@ -772,9 +775,18 @@ class Worker:
                                object_id.hex()[:16], node_index, len(data))
                 self._chaos.note_recovery("transfer")
                 return None
-            self.transfer_stats["head_relayed_bytes"] += len(data)
-            self.transfer_stats["head_relayed_objects"] += 1
+            self.note_transfer("head_relayed_bytes", len(data))
+            self.note_transfer("head_relayed_objects")
         return data
+
+    def note_transfer(self, key: str, delta: int = 1) -> None:
+        """Bump a transfer_stats counter. The dict is written from the
+        scheduler tick, daemon demux threads, and head pull paths at
+        once; a bare ``+=`` there is a read-modify-write race that
+        silently drops counts."""
+        with self._transfer_stats_lock:
+            self.transfer_stats[key] = \
+                self.transfer_stats.get(key, 0) + delta
 
     def peer_address_of(self, node_index: int) -> Optional[tuple]:
         """The direct-transfer endpoint of a remote node's daemon, or
@@ -1104,12 +1116,10 @@ class Worker:
                     stage.append((oid.binary(), tuple(peer), nbytes))
                     break
         if located:
-            ts = self.transfer_stats
-            if missing:
-                ts["locality_misses"] += 1
-            else:
-                ts["locality_hits"] += 1
-            ts["bytes_saved"] += resident
+            self.note_transfer(
+                "locality_misses" if missing else "locality_hits")
+            if resident:
+                self.note_transfer("bytes_saved", resident)
         if stage:
             pool.stage_args(stage)
             if self.task_events is not None:
@@ -2416,6 +2426,14 @@ def init(num_cpus: Optional[float] = None, num_workers: Optional[int] = None,
         if _system_config:
             GLOBAL_CONFIG.unfreeze()
             GLOBAL_CONFIG.apply_system_config(_system_config)
+        # max_direct_call_object_size is the reference API's name for
+        # inline_object_max_bytes: an override of the alias (env or
+        # _system_config) flows into the real knob, unless the real
+        # knob was itself overridden — then the specific name wins
+        alias = GLOBAL_CONFIG.entry("max_direct_call_object_size")
+        inline = GLOBAL_CONFIG.entry("inline_object_max_bytes")
+        if alias.value != alias.default and inline.value == inline.default:
+            inline.value = int(alias.value)
         # Two separate knobs (previously conflated): ``scheduler`` picks the
         # scheduler CLASS (tensor = device-array north star, the default;
         # event = per-event oracle); ``sched_backend`` picks the tensor

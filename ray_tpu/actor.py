@@ -39,9 +39,19 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.task_spec import TaskSpec, TaskType, resources_to_vector
 from ray_tpu.remote_function import _DEFAULT_OPTIONS, _build_resources
 
+def _effective_max_restarts(opts: dict) -> int:
+    """Per-actor option wins; unset (None) falls back to the
+    ``actor_max_restarts`` knob."""
+    mr = opts.get("max_restarts")
+    if mr is None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        mr = GLOBAL_CONFIG.actor_max_restarts
+    return int(mr)
+
+
 _ACTOR_OPTIONS = dict(_DEFAULT_OPTIONS)
 _ACTOR_OPTIONS.update(dict(
-    max_restarts=0,
+    max_restarts=None,  # None = GLOBAL_CONFIG.actor_max_restarts
     max_task_retries=0,
     max_concurrency=1,
     max_pending_calls=-1,
@@ -411,7 +421,7 @@ class _ActorRuntime:
     # -- death / restart ---------------------------------------------------
     def stop(self, no_restart: bool = True,
              cause: Optional[BaseException] = None):
-        max_restarts = int(self.opts.get("max_restarts", 0))
+        max_restarts = _effective_max_restarts(self.opts)
         can_restart = (not no_restart
                        and (max_restarts == -1
                             or self.num_restarts < max_restarts))
@@ -745,7 +755,7 @@ class _ProcessActorRuntime(_ActorRuntime):
         with self._restart_lock:
             if self.state == ActorState.DEAD:
                 return
-            max_restarts = int(self.opts.get("max_restarts", 0))
+            max_restarts = _effective_max_restarts(self.opts)
             can_restart = (not no_restart
                            and (max_restarts == -1
                                 or self.num_restarts < max_restarts))
