@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -268,14 +269,44 @@ def _cmd_summary(args) -> int:
     return 0
 
 
+def _changed_files() -> "set":
+    """Repo-relative paths changed vs ``git merge-base HEAD main``
+    (committed, staged and unstaged), for ``lint --changed-only``."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        base = subprocess.run(
+            ["git", "merge-base", "HEAD", "main"], cwd=repo,
+            capture_output=True, text=True, timeout=10,
+            check=True).stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base], cwd=repo,
+            capture_output=True, text=True, timeout=10, check=True)
+    except (OSError, subprocess.SubprocessError):
+        return set()
+    return {ln.strip() for ln in diff.stdout.splitlines() if ln.strip()}
+
+
 def _cmd_lint(args) -> int:
-    """Run raylint — the five framework-invariant static-analysis
-    passes (lock order, shared state, wire protocol, knobs, registries)
-    over the installed ray_tpu package. Exit 1 on findings not covered
-    by analysis/baseline.json."""
+    """Run the analysis plane — the eight framework-invariant static
+    passes (lock order, shared state, wire protocol, knobs, registries,
+    ref lifecycle, closure capture, blocking calls) over the installed
+    ray_tpu package. Exit 1 on findings not covered by
+    analysis/baseline.json."""
     from ray_tpu._private import analysis
 
     report = analysis.run_all()
+    if getattr(args, "changed_only", False):
+        changed = _changed_files()
+        # findings carry package-relative paths; the diff is
+        # repo-relative with the ray_tpu/ prefix
+        def touched(f):
+            return f.file and ("ray_tpu/" + f.file).replace(
+                os.sep, "/") in changed
+        report.new = [f for f in report.new if touched(f)]
+        report.baselined = [f for f in report.baselined if touched(f)]
+        report.stale_suppressions = []  # not decidable from a diff
     if args.update_baseline:
         analysis.save_baseline([f.key for f in report.findings])
         print(f"baseline updated: {len(report.findings)} suppression(s)"
@@ -374,6 +405,10 @@ def main(argv=None) -> int:
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite analysis/baseline.json to suppress "
                    "every current finding")
+    p.add_argument("--changed-only", action="store_true",
+                   help="report only findings in files changed vs "
+                   "`git merge-base HEAD main` (all passes still run "
+                   "— cross-file invariants need the whole repo)")
     p.set_defaults(fn=_cmd_lint)
 
     args = parser.parse_args(argv)

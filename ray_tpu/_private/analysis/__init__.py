@@ -1,9 +1,16 @@
-"""raylint — ray_tpu's framework-invariant static-analysis plane.
+"""raylint + raysan — ray_tpu's framework-invariant analysis plane.
 
-Five AST passes over the whole package, each encoding an invariant the
+Eight AST passes over the whole package, each encoding an invariant the
 repo's history shows drifts silently (see the per-pass module
 docstrings): lock ordering, unguarded shared state, wire-protocol
-conformance, knob consistency, and registry drift.
+conformance, knob consistency, registry drift, ObjectRef lifecycle,
+closure-capture hygiene, and blocking calls in no-block contexts.
+
+The static plane has a runtime mirror (``runtime_sanitizer``, armed by
+``RAY_TPU_SANITIZE=1``): a lock-witness recorder diffed against
+lock_order's static graph, a shm/ref leak ledger reported at shutdown,
+and wire-message schema assertions compiled from wire_protocol's
+channel table.
 
 Findings carry **stable, line-free keys** (``pass:category:subject``)
 so a checked-in ``baseline.json`` can suppress pre-existing violations
@@ -23,8 +30,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from ray_tpu._private.analysis import (knobs, lock_order, registry,
-                                       shared_state, wire_protocol)
+from ray_tpu._private.analysis import (blocking_calls, closure_capture,
+                                       knobs, lock_order, ref_lifecycle,
+                                       registry, shared_state,
+                                       wire_protocol)
 
 #: the package root the passes scan, resolved from this file
 PACKAGE_ROOT = os.path.normpath(
@@ -36,7 +45,10 @@ PASSES = (("lock_order", lock_order.analyze),
           ("shared_state", shared_state.analyze),
           ("wire_protocol", wire_protocol.analyze),
           ("knobs", knobs.analyze),
-          ("registry", registry.analyze))
+          ("registry", registry.analyze),
+          ("ref_lifecycle", ref_lifecycle.analyze),
+          ("closure_capture", closure_capture.analyze),
+          ("blocking_calls", blocking_calls.analyze))
 
 
 @dataclass

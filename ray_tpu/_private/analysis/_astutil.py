@@ -54,14 +54,21 @@ def module_name(relpath: str) -> str:
 
 
 def _is_lock_factory(call: ast.AST) -> Optional[str]:
-    """'Lock' / 'RLock' / 'Condition' if ``call`` constructs one."""
+    """'Lock' / 'RLock' / 'Condition' if ``call`` constructs one.
+
+    Sees through the runtime sanitizer's witness wrapper —
+    ``wrap_lock(threading.Lock(), "id")`` still DEFINES a Lock, and
+    losing that binding would silently drop the lock (and every edge
+    through it) from all static passes."""
     if not isinstance(call, ast.Call):
         return None
     f = call.func
-    if isinstance(f, ast.Attribute) and f.attr in LOCK_FACTORIES:
-        return LOCK_FACTORIES[f.attr]
-    if isinstance(f, ast.Name) and f.id in LOCK_FACTORIES:
-        return LOCK_FACTORIES[f.id]
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if name == "wrap_lock" and call.args:
+        return _is_lock_factory(call.args[0])
+    if name in LOCK_FACTORIES:
+        return LOCK_FACTORIES[name]
     return None
 
 

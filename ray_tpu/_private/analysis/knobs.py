@@ -13,13 +13,20 @@ Every knob registered in ``_private/config.py`` must be
   ``.entry("name")`` / ``set(...)`` string use, or an
   ``RAY_TPU_<NAME>`` env literal. A knob nobody reads is dead — the
   ``log_dir`` class of bug (PR 3).
-- **documented** — mentioned in README.md (plain substring; the README
-  uses backticked knob names).
+- **documented** — mentioned in README.md as an exact token. Plain
+  substring matching had a false-negative class: an undocumented knob
+  whose name is a SUBSTRING of a documented one (``tick_interval_s``
+  riding on ``sched_tick_interval_s``) passed silently. The README is
+  tokenized instead, with two conveniences: ``RAY_TPU_<NAME>`` env
+  spellings count as documenting ``<name>``, and brace-expanded
+  doc shorthand (``sched_max_{edges,nodes}``) counts for every
+  expansion — same grammar registry.expand_doc_token uses.
 """
 
 from __future__ import annotations
 
 import ast
+import itertools
 import os
 import re
 from typing import Dict, List, Optional, Set
@@ -74,6 +81,34 @@ def collect_reads(root: str, config_relpath: str,
     return reads
 
 
+def _expand_braces(tok: str) -> List[str]:
+    """``a_{b,c}_d`` -> [``a_b_d``, ``a_c_d``] (no nesting)."""
+    parts = re.split(r"(\{[^{}]*\})", tok)
+    if len(parts) == 1:
+        return [tok]
+    pools = [p[1:-1].split(",") if p.startswith("{") else [p]
+             for p in parts if p]
+    return ["".join(combo) for combo in itertools.product(*pools)]
+
+
+def readme_knob_tokens(readme: str) -> Set[str]:
+    """Every exact name the README documents: word-ish tokens (the
+    charset includes ``{},`` so brace shorthand survives markdown
+    splitting, and spans table-cell line wraps since the regex runs
+    over the whole text), brace-expanded, with ``RAY_TPU_X`` env
+    spellings lowered to the knob name ``x``."""
+    out: Set[str] = set()
+    for raw in re.findall(r"[A-Za-z0-9_{},]+", readme):
+        for tok in _expand_braces(raw):
+            tok = tok.strip(",")
+            if not tok:
+                continue
+            out.add(tok)
+            if tok.startswith("RAY_TPU_"):
+                out.add(tok[len("RAY_TPU_"):].lower())
+    return out
+
+
 def analyze(root: str, make_finding,
             config_relpath: str = "_private/config.py",
             readme_path: Optional[str] = None) -> List:
@@ -93,6 +128,7 @@ def analyze(root: str, make_finding,
         readme = ""
 
     reads = collect_reads(root, config_relpath, set(knobs))
+    documented = readme_knob_tokens(readme) if readme else set()
     for name, line in sorted(knobs.items()):
         if not re.fullmatch(r"[a-z][a-z0-9_]*", name):
             findings.append(make_finding(
@@ -105,7 +141,7 @@ def analyze(root: str, make_finding,
                 f"{PASS}:dead:{name}",
                 f"knob {name!r} is defined but never read anywhere in "
                 f"the package", config_relpath, line))
-        if readme and name not in readme:
+        if readme and name not in documented:
             findings.append(make_finding(
                 f"{PASS}:undocumented:{name}",
                 f"knob {name!r} is not mentioned in README.md",

@@ -98,6 +98,46 @@ def _scan_function(fn: ast.FunctionDef, index: LockIndex,
     return scan
 
 
+def collect_edges(root: str) -> Set[Tuple[str, str]]:
+    """The static acquisition-order edge set (outer_id, inner_id) —
+    the same graph ``analyze`` reports cycles on, exposed so the
+    runtime sanitizer's lock witness can diff observed orders against
+    it at shutdown."""
+    index = LockIndex()
+    parsed = []
+    for rel, ap in iter_py_files(root):
+        tree = parse_file(ap)
+        if tree is None:
+            continue
+        mod = module_name(rel)
+        cl = collect_classes(tree, mod)
+        parsed.append((rel, mod, tree, cl))
+        for c in cl:
+            index.add_class(c)
+        index.add_module_globals(mod, collect_module_locks(tree, mod))
+    edges: Set[Tuple[str, str]] = set()
+    for rel, mod, tree, cl in parsed:
+        for cls in cl:
+            scans = {m.name: _scan_function(m, index, cls, mod, rel)
+                     for m in cls.methods()}
+            for scan in scans.values():
+                edges.update(scan.edges)
+            for scan in scans.values():
+                for callee, held_ids, _line in scan.calls_held:
+                    target = scans.get(callee)
+                    if target is None:
+                        continue
+                    for inner_id in target.acquired:
+                        for outer_id in held_ids:
+                            if outer_id != inner_id:
+                                edges.add((outer_id, inner_id))
+        for fn in (n for n in tree.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))):
+            edges.update(_scan_function(fn, index, None, mod, rel).edges)
+    return edges
+
+
 def analyze(root: str, make_finding) -> List:
     """Run the pass over every .py under ``root``. ``make_finding`` is
     the orchestrator's Finding factory: (key, message, file, line)."""

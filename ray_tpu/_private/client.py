@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
 
@@ -149,6 +150,9 @@ class ClientServer:
         if oid not in s.pinned:
             self._worker.reference_counter.add_local_reference(oid)
             s.pinned.add(oid)
+            # a client-held pin has no local ObjectRef instance: tell
+            # the sanitizer's ref census the holder is external
+            runtime_sanitizer.note_external_ref(oid)
 
     # -- ops -----------------------------------------------------------
     def _op_put(self, s, blob: bytes) -> bytes:
@@ -252,6 +256,7 @@ class ClientServer:
             if oid in s.pinned:
                 s.pinned.discard(oid)
                 self._worker.reference_counter.remove_local_reference(oid)
+                runtime_sanitizer.drop_external_ref(oid)
         return True
 
     def _op_pin(self, s, oid_bins: list) -> bool:
@@ -399,6 +404,9 @@ class ClientWorker:
         self._reader_thread = threading.Thread(
             target=self._reader, daemon=True, name="ray_tpu_client_reader")
         self._reader_thread.start()
+        if not self.ping():
+            raise ConnectionError("head accepted the session but its "
+                                  "serve thread is not answering")
 
     # -- transport ----------------------------------------------------
     def _reader(self) -> None:
@@ -616,6 +624,13 @@ class ClientWorker:
         return self._rpc("kv", "keys", namespace, bytes(prefix), None)
 
     # -- lifecycle -------------------------------------------------------
+    def ping(self, timeout: Optional[float] = 10.0) -> bool:
+        """Round-trip liveness probe through the request/reply plane.
+
+        The hello/ready handshake only proves the accept thread ran;
+        this proves the per-session serve thread is dispatching ops."""
+        return self._rpc("ping", timeout=timeout) == "pong"
+
     def shutdown(self) -> None:
         self.alive = False
         # close() alone cannot interrupt a reader blocked in recv: the

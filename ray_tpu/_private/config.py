@@ -174,15 +174,15 @@ _d("object_transfer_timeout_s", float, 120.0,
    "daemon; sized for multi-GB transfers, not as a liveness probe)")
 
 # -- scheduler (device-resident kernel parameters) -------------------------
-_d("sched_tick_interval_s", float, 0.0005, "min seconds between scheduler ticks")
+_d("sched_tick_interval_s", float, 0.0,
+   "min seconds between scheduler ticks: an event burst arriving within "
+   "the interval coalesces into one tick (0 = tick immediately)")
 _d("sched_arena_capacity", int, 4096,
    "TensorScheduler starting task-arena slot count (arrays double on "
    "overflow; raise for sustained million-task graphs to avoid regrow "
    "copies)")
-_d("sched_max_edges", int, 1 << 22, "dependency CSR edge capacity")
 _d("sched_num_resources", int, 4,
    "width R of the resource vectors (cpu, tpu, mem, custom)")
-_d("sched_max_nodes", int, 64, "node capacity matrix rows held on device")
 _d("sched_hybrid_threshold", float, 0.5,
    "prefer-local until node load exceeds this fraction (hybrid policy analog)")
 _d("scheduler", str, "tensor",

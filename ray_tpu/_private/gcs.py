@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.analysis.runtime_checks import assert_holds
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID
 
@@ -81,7 +82,8 @@ class GcsJournal:
         if intact is not None and self._f.tell() > intact:
             self._f.truncate(intact)
             self._f.seek(intact)
-        self._wlock = threading.Lock()
+        self._wlock = runtime_sanitizer.wrap_lock(
+            threading.Lock(), "_private.gcs.GcsJournal._wlock")
 
     @staticmethod
     def _intact_size(path: str) -> Optional[int]:
@@ -182,7 +184,8 @@ class GcsService:
 
     def __init__(self, worker, journal: Optional[GcsJournal] = None):
         self._worker = worker
-        self._lock = threading.RLock()
+        self._lock = runtime_sanitizer.wrap_lock(
+            threading.RLock(), "_private.gcs.GcsService._lock")
         self._nodes: Dict[NodeID, NodeEntry] = {}
         self._node_by_index: Dict[int, NodeEntry] = {}
         self._actors: Dict[ActorID, ActorEntry] = {}

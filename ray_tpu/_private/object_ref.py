@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import TYPE_CHECKING, Optional
 
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
 
 if TYPE_CHECKING:
@@ -16,7 +17,9 @@ if TYPE_CHECKING:
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner_id", "_weak")
+    # __weakref__ lets the runtime sanitizer census live instances
+    # without extending their lifetime
+    __slots__ = ("_id", "_owner_id", "_weak", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_id: Optional[WorkerID] = None,
                  *, _register: bool = True):
@@ -27,6 +30,8 @@ class ObjectRef:
             _global_worker = _get_worker()
             if _global_worker is not None:
                 _global_worker.reference_counter.add_local_reference(object_id)
+                if runtime_sanitizer._ENABLED:
+                    runtime_sanitizer.track_ref(self)
 
     # -- identity ----------------------------------------------------------
     def object_id(self) -> ObjectID:

@@ -170,7 +170,8 @@ def locality_ab(locality: bool, n_consumers: int = 8,
 
         @ray_tpu.remote(resources={"src": 1.0})
         def produce(i):
-            return np.full(n, float(i))
+            import numpy as np  # task-side: don't close over the
+            return np.full(n, float(i))  # driver's local module binding
 
         @ray_tpu.remote(resources={"r": 1.0})
         def consume(x):

@@ -23,6 +23,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
 
 
@@ -46,7 +47,8 @@ class _Ref:
 class ReferenceCounter:
     def __init__(self, on_object_out_of_scope: Callable[[ObjectID], None]):
         self._refs: Dict[ObjectID, _Ref] = {}
-        self._lock = threading.RLock()
+        self._lock = runtime_sanitizer.wrap_lock(
+            threading.RLock(), "_private.ref_counting.ReferenceCounter._lock")
         self._on_out_of_scope = on_object_out_of_scope
 
     # -- local handles -----------------------------------------------------
@@ -134,6 +136,14 @@ class ReferenceCounter:
     def num_tracked(self) -> int:
         with self._lock:
             return len(self._refs)
+
+    def snapshot(self) -> Dict[ObjectID, tuple]:
+        """ObjectID -> (local, submitted, num_borrowers, pinned) for
+        every live row — the runtime sanitizer's shutdown census."""
+        with self._lock:
+            return {oid: (r.local, r.submitted, len(r.borrowers),
+                          r.pinned)
+                    for oid, r in self._refs.items()}
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

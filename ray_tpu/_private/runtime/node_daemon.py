@@ -78,6 +78,7 @@ import time
 from multiprocessing.connection import Client, Listener
 from typing import Any, Dict, Optional
 
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.analysis.runtime_checks import assert_holds
 from ray_tpu._private.ids import ObjectID
 
@@ -976,6 +977,7 @@ class NodeDaemon:
                         self._head_address)
                     continue
                 break  # no head came back: the node dies
+            runtime_sanitizer.check_wire("head_to_daemon", msg)
             kind = msg[0]
             if kind == "error":
                 # e.g. protocol-version rejection of our hello: the

@@ -31,6 +31,7 @@ import cloudpickle
 
 from ray_tpu import exceptions as rex
 from ray_tpu._private import log_plane, spawn_env
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
@@ -693,6 +694,7 @@ class ProcessWorkerPool:
                         self._demux_conns.pop(c, None)
                         self._on_worker_failure(h, None)
                         break
+                    runtime_sanitizer.check_wire("worker_to_owner", msg)
                     kind = msg[0]
                     if kind == "many":
                         # a worker's buffered batch completions

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.runtime.process_pool import (_DepError, _Handle,
@@ -132,6 +133,7 @@ class RemoteNodePool(ProcessWorkerPool):
                 # TypeError/ValueError: conn closed under a blocked recv
                 self._on_daemon_lost()
                 return
+            runtime_sanitizer.check_wire("daemon_to_head", msg)
             kind = msg[0]
             if kind == "w":
                 num, wmsg = msg[1], msg[2]

@@ -23,6 +23,7 @@ import threading
 from multiprocessing import shared_memory
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import ObjectStoreFullError
 from ray_tpu._private.serialization import SerializedObject
@@ -151,7 +152,8 @@ class ShmArena:
         self.size = self._shm.size
         self._owner = create
         self._alloc = make_free_list(self.size) if create else None
-        self._lock = threading.Lock()
+        self._lock = runtime_sanitizer.wrap_lock(
+            threading.Lock(), "_private.runtime.shm_store.ShmArena._lock")
 
     @classmethod
     def attach(cls, name: str) -> "ShmArena":
@@ -257,7 +259,9 @@ class ShmObjectStore:
         # pinned ranges wait in _deferred until their last unpin
         self._pins: Dict[ObjectID, int] = {}
         self._deferred: Dict[ObjectID, _Alloc] = {}
-        self._lock = threading.Lock()
+        self._lock = runtime_sanitizer.wrap_lock(
+            threading.Lock(),
+            "_private.runtime.shm_store.ShmObjectStore._lock")
 
     # -- create/seal lifecycle --------------------------------------------
     def create(self, object_id: ObjectID, nbytes: int) -> int:
@@ -271,6 +275,7 @@ class ShmObjectStore:
                 self.arena.free(offset, nbytes)
                 raise ValueError(f"object {object_id.hex()} already created")
             self._table[object_id] = _Alloc(offset, nbytes)
+        runtime_sanitizer.ledger_alloc("arena", object_id, nbytes)
         return offset
 
     def seal(self, object_id: ObjectID) -> None:
@@ -381,6 +386,7 @@ class ShmObjectStore:
             with self._lock:
                 self._spilled[object_id] = (path, nbytes)
                 self.num_spilled += 1
+            runtime_sanitizer.ledger_alloc("spill", object_id, nbytes)
             return (-1, nbytes)
         sobj.write_into(self.arena.view(offset, nbytes))
         self.seal(object_id)
@@ -433,6 +439,7 @@ class ShmObjectStore:
         with self._lock:
             self._spilled[object_id] = (path, nbytes)
             self.num_spilled += 1
+        runtime_sanitizer.ledger_alloc("spill", object_id, nbytes)
 
     def abort_adopt(self, object_id: ObjectID, kind: str, f=None) -> None:
         if kind == "arena":
@@ -440,6 +447,7 @@ class ShmObjectStore:
                 alloc = self._table.pop(object_id, None)
             if alloc is not None:
                 self.arena.free(alloc.offset, alloc.nbytes)
+            runtime_sanitizer.ledger_free(object_id)
             return
         try:
             f.close()
@@ -507,6 +515,7 @@ class ShmObjectStore:
             self.arena.free(deferred.offset, deferred.nbytes)
 
     def free_object(self, object_id: ObjectID) -> None:
+        runtime_sanitizer.ledger_free(object_id)
         with self._lock:
             alloc = self._table.pop(object_id, None)
             spilled = self._spilled.pop(object_id, None)

@@ -75,7 +75,7 @@ class TensorScheduler(SchedulerBase):
         self._outstanding = np.zeros(0, dtype=np.int64)
         self._win_cap = np.zeros(0, dtype=np.int64)
         for n in nodes:
-            self._append_node(n)
+            self._append_node_locked(n)
 
         # arena slots grow by doubling; the knob sets the starting size
         # (bigger = fewer regrow copies on sustained load, more resident
@@ -129,6 +129,7 @@ class TensorScheduler(SchedulerBase):
         self._num_dispatched = 0
         self._num_finished = 0
         self._num_ticks = 0
+        self._last_tick = 0.0  # monotonic stamp of the last coalesced tick
         # auto-backend calibration: the jitted device path only wins when
         # the device round trip is cheap (it is NOT under a tunneled chip,
         # where one dispatch costs ~50 ms). "cold" -> background warmup on
@@ -196,7 +197,7 @@ class TensorScheduler(SchedulerBase):
             task = self._tasks.get(slot)
             if task is not None:
                 task.cancelled = True
-            self._release_slot(slot)
+            self._release_slot_locked(slot)
             return True
 
     def stats(self) -> Dict[str, Any]:
@@ -353,7 +354,7 @@ class TensorScheduler(SchedulerBase):
         poke() afterwards — dispatching into a half-registered node
         races pool_for_node() to None."""
         with self._wake:
-            idx = self._append_node(node)
+            idx = self._append_node_locked(node)
             if wake:
                 self._dirty = True
                 if self._sleeping:
@@ -383,7 +384,7 @@ class TensorScheduler(SchedulerBase):
             if self._sleeping:
                 self._wake.notify()
 
-    def _append_node(self, node: NodeState) -> int:
+    def _append_node_locked(self, node: NodeState) -> int:
         vec = np.zeros((1, self._cap.shape[1] if self._cap.size else
                         GLOBAL_CONFIG.sched_num_resources), dtype=np.float32)
         for i, v in enumerate(node.capacity[:vec.shape[1]]):
@@ -436,7 +437,7 @@ class TensorScheduler(SchedulerBase):
                 self._avail[parent] -= v
                 self._node_states[parent].allocate(tuple(v.tolist()))
                 self._node_states[parent].allocate_custom(custom)
-                row = self._append_node(NodeState(
+                row = self._append_node_locked(NodeState(
                     tuple(v.tolist()),
                     node_id=self._node_states[parent].node_id,
                     pg_id=pg_id, bundle_index=bindex, parent=parent,
@@ -467,7 +468,7 @@ class TensorScheduler(SchedulerBase):
             for slot, task in list(self._tasks.items()):
                 if self._state[slot] == WAITING and match(task):
                     out.append(task)
-                    self._release_slot(slot)
+                    self._release_slot_locked(slot)
         return out
 
     def remove_pg(self, pg_id) -> None:
@@ -517,6 +518,18 @@ class TensorScheduler(SchedulerBase):
                     self._sleeping = False
                 if self._shutdown:
                     return
+                # tick coalescing floor: with sched_tick_interval_s > 0,
+                # an event burst arriving right after a tick waits out
+                # the remainder of the interval so the whole burst lands
+                # in ONE drain/assign cycle (0 = tick immediately)
+                interval = GLOBAL_CONFIG.sched_tick_interval_s
+                if interval > 0.0:
+                    remaining = self._last_tick + interval - time.monotonic()
+                    if remaining > 0:
+                        self._wake.wait(timeout=remaining)
+                    if self._shutdown:
+                        return
+                    self._last_tick = time.monotonic()
                 self._dirty = False
                 try:
                     snapshot = self._drain_events_locked()
@@ -558,7 +571,7 @@ class TensorScheduler(SchedulerBase):
         # 1) admissions
         while self._submit_q:
             task = self._submit_q.popleft()
-            slot = self._alloc_slot()
+            slot = self._alloc_slot_locked()
             spec = task.spec
             self._tasks[slot] = task
             self._slot_of[spec.task_id] = slot
@@ -633,7 +646,7 @@ class TensorScheduler(SchedulerBase):
                 if 0 <= node_index < len(self._node_states):
                     self._outstanding[node_index] = max(
                         self._outstanding[node_index] - 1, 0)
-                self._release_slot(slot)
+                self._release_slot_locked(slot)
             if was_windowed:
                 continue  # a window lease held no node resources
             if 0 <= node_index < len(self._node_states):
@@ -894,7 +907,7 @@ class TensorScheduler(SchedulerBase):
                 continue  # node shrunk since snapshot; next tick
             task = self._tasks.get(slot)
             if task is None or task.cancelled:
-                self._release_slot(slot)
+                self._release_slot_locked(slot)
                 continue
             # per-NAME custom quantities are finer than the kernel's
             # aggregate CUSTOM dimension: re-validate + debit here (the
@@ -915,11 +928,11 @@ class TensorScheduler(SchedulerBase):
             ns.allocate_custom(custom)
             self._num_dispatched += 1
             out.append(task)
-        self._window_pass(ready_idx, node_of_ready, out)
+        self._window_pass_locked(ready_idx, node_of_ready, out)
         return out
 
-    def _window_pass(self, ready_idx, node_of_ready,
-                     out: List[PendingTask]) -> None:
+    def _window_pass_locked(self, ready_idx, node_of_ready,
+                            out: List[PendingTask]) -> None:
         """Dispatch-window leases (reference: the raylet's dispatch
         queue + worker backlog): ready tasks of simple CPU classes that
         found no free capacity may still lease onto a node whose
@@ -954,7 +967,7 @@ class TensorScheduler(SchedulerBase):
                 continue
             task = self._tasks.get(slot)
             if task is None or task.cancelled:
-                self._release_slot(slot)
+                self._release_slot_locked(slot)
                 continue
             node = int(nodes_seq[taken])
             taken += 1
@@ -967,7 +980,7 @@ class TensorScheduler(SchedulerBase):
             out.append(task)
 
     # -- slot lifecycle ----------------------------------------------------
-    def _alloc_slot(self) -> int:
+    def _alloc_slot_locked(self) -> int:
         if not self._free:
             old = len(self._state)
             new = old * 2
@@ -984,7 +997,7 @@ class TensorScheduler(SchedulerBase):
             self._free.extend(range(old, new))
         return self._free.popleft()
 
-    def _release_slot(self, slot: int) -> None:
+    def _release_slot_locked(self, slot: int) -> None:
         self._windowed[slot] = False
         self._argsz.pop(slot, None)
         self._tasks.pop(slot, None)

@@ -36,6 +36,7 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.analysis.runtime_checks import assert_holds
 
 # Record field indices.  Plain lists beat dataclasses ~3x on the
@@ -105,7 +106,8 @@ class TaskEventAggregator:
             from ray_tpu._private.config import GLOBAL_CONFIG
             max_records = GLOBAL_CONFIG.task_events_max
         self._max = int(max_records)
-        self._lock = threading.Lock()
+        self._lock = runtime_sanitizer.wrap_lock(
+            threading.Lock(), "_private.task_events.TaskEventAggregator._lock")
         self._live: Dict[Any, list] = {}
         self._finished: deque = deque()
         self._failed: deque = deque()

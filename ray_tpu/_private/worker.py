@@ -24,6 +24,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.chaos import get_controller as _chaos_controller
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
@@ -467,9 +468,11 @@ class Worker:
                                                "locality_misses": 0,
                                                "bytes_pulled": 0,
                                                "bytes_saved": 0}
-        self._transfer_stats_lock = threading.Lock()
+        self._transfer_stats_lock = runtime_sanitizer.wrap_lock(
+            threading.Lock(), "_private.worker.Worker._transfer_stats_lock")
         # single-flight head-side peer pulls (oid -> completion event)
-        self._head_pull_lock = threading.Lock()
+        self._head_pull_lock = runtime_sanitizer.wrap_lock(
+            threading.Lock(), "_private.worker.Worker._head_pull_lock")
         self._head_pulls: Dict[ObjectID, threading.Event] = {}
 
         # placement groups (bundle reservation over the scheduler)
@@ -535,7 +538,8 @@ class Worker:
         # actors: ActorID -> _ActorRuntime (see actor.py)
         self.actors: Dict[ActorID, Any] = {}
         self.dead_actors: set = set()
-        self._actors_lock = threading.Lock()
+        self._actors_lock = runtime_sanitizer.wrap_lock(
+            threading.Lock(), "_private.worker.Worker._actors_lock")
 
         # id -> False (running) | True (cancelled) | "timeout" (the
         # deadline watcher failed this attempt; its results are zombie)
@@ -545,7 +549,13 @@ class Worker:
         self._precancelled: set = set()
         # deadline expired while executor-queued: timed out at exec start
         self._pretimeout: set = set()
-        self._running_lock = threading.Lock()
+        self._running_lock = runtime_sanitizer.wrap_lock(
+            threading.Lock(), "_private.worker.Worker._running_lock")
+        if runtime_sanitizer._ENABLED:
+            # leak-ledger attribution: the task context current at each
+            # shm allocation (the id the task-event plane records under)
+            runtime_sanitizer.set_owner_provider(
+                lambda: f"task {self.current_task_id.hex()[:16]}")
 
         # chaos plane: every injection decision flows through the
         # process-wide seeded controller (see _private/chaos.py)
@@ -1003,6 +1013,8 @@ class Worker:
             for oid in spec.return_ids():
                 ref = ObjectRef(oid, self.worker_id, _register=False)
                 ref._weak = False  # counted in register_submit_batch
+                if runtime_sanitizer._ENABLED:
+                    runtime_sanitizer.track_ref(ref)
                 refs.append(ref)
             out.append(refs)
         self.scheduler.submit_many(pendings)
@@ -2292,6 +2304,11 @@ class Worker:
             self.client_server.shutdown()
         if self._head_server is not None:
             self._head_server.close()
+        if runtime_sanitizer._ENABLED:
+            # lock-witness diff + leak ledgers, while the refcount table
+            # still distinguishes live objects from leaked segments
+            runtime_sanitizer.report_at_shutdown(
+                self.reference_counter.snapshot())
         if self.shm_store is not None:
             self.shm_store.shutdown()
 

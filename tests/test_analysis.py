@@ -17,8 +17,10 @@ from types import SimpleNamespace
 import pytest
 
 from ray_tpu._private import analysis
-from ray_tpu._private.analysis import (knobs, lock_order, registry,
-                                       runtime_checks, shared_state,
+from ray_tpu._private.analysis import (blocking_calls, closure_capture,
+                                       knobs, lock_order, ref_lifecycle,
+                                       registry, runtime_checks,
+                                       runtime_sanitizer, shared_state,
                                        wire_protocol)
 from ray_tpu._private.analysis.wire_protocol import (ChannelSpec,
                                                      OpChannelSpec,
@@ -656,3 +658,515 @@ class TestFixedViolations:
             assert GLOBAL_CONFIG.inline_object_max_bytes == 55555
         finally:
             ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ref_lifecycle
+# ---------------------------------------------------------------------------
+
+class TestRefLifecycle:
+    def test_weak_escape_via_return(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def leak(oid):
+                ref = ObjectRef(oid, None, _register=False)
+                return ref
+            """)
+        keys = _keys(ref_lifecycle.analyze(str(tmp_path), _mk))
+        assert "ref_lifecycle:weak-escape:mod.leak:ref" in keys, keys
+
+    def test_weak_escape_via_self_store(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            class C:
+                def stash(self, oid):
+                    ref = ObjectRef(oid, None, _register=False)
+                    self._kept = ref
+            """)
+        keys = _keys(ref_lifecycle.analyze(str(tmp_path), _mk))
+        assert "ref_lifecycle:weak-escape:mod.C.stash:ref" in keys, keys
+
+    def test_weak_escape_via_container(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def leak_all(oids):
+                out = []
+                for o in oids:
+                    r = ObjectRef(o, None, _register=False)
+                    out.append(r)
+                return out
+            """)
+        keys = _keys(ref_lifecycle.analyze(str(tmp_path), _mk))
+        assert any(k.startswith("ref_lifecycle:weak-escape:mod.leak_all")
+                   for k in keys), keys
+
+    def test_reregistration_exempts(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def submit(oid):
+                ref = ObjectRef(oid, None, _register=False)
+                ref._weak = False
+                return ref
+            """)
+        assert ref_lifecycle.analyze(str(tmp_path), _mk) == []
+
+    def test_ephemeral_weak_ref_passes(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def probe(worker, oids):
+                refs = [ObjectRef(o, None, _register=False)
+                        for o in oids]
+                return worker.wait(refs, 1, 2.0)[0] is not None
+            """)
+        assert ref_lifecycle.analyze(str(tmp_path), _mk) == []
+
+    def test_double_release_caught(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def f(worker, oid):
+                worker.reference_counter.remove_local_reference(oid)
+                worker.reference_counter.remove_local_reference(oid)
+            """)
+        keys = _keys(ref_lifecycle.analyze(str(tmp_path), _mk))
+        assert "ref_lifecycle:double-release:mod.f:oid" in keys, keys
+
+    def test_release_on_separate_branches_passes(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def f(worker, oid, fast):
+                if fast:
+                    worker.defer_unref(oid)
+                else:
+                    worker.defer_unref(oid)
+            """)
+        assert ref_lifecycle.analyze(str(tmp_path), _mk) == []
+
+    def test_get_after_free_caught(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def f(worker, oid):
+                worker.defer_unref(oid)
+                return worker.get([oid])
+            """)
+        # the release is an Expr stmt; the get is in a Return — walk
+        # both shapes
+        _write(tmp_path, "mod2.py", """
+            def g(worker, oid):
+                worker.defer_unref(oid)
+                val = worker.get([oid], None)
+                return val
+            """)
+        keys = _keys(ref_lifecycle.analyze(str(tmp_path), _mk))
+        assert "ref_lifecycle:get-after-free:mod2.g:oid" in keys, keys
+
+    def test_rebinding_resets_release_state(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def f(worker, oid, fresh):
+                worker.defer_unref(oid)
+                oid = fresh
+                worker.defer_unref(oid)
+            """)
+        assert ref_lifecycle.analyze(str(tmp_path), _mk) == []
+
+    def test_repo_worker_batch_path_is_clean(self):
+        # the real submit path re-registers via ``ref._weak = False``;
+        # the pass must understand that idiom or every submit leaks
+        findings = ref_lifecycle.analyze(analysis.PACKAGE_ROOT, _mk)
+        assert [f.key for f in findings
+                if "submit_task_batch" in f.key] == []
+
+
+# ---------------------------------------------------------------------------
+# closure_capture
+# ---------------------------------------------------------------------------
+
+class TestClosureCapture:
+    def test_self_capture_caught(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            class A:
+                def kick(self):
+                    @remote
+                    def probe():
+                        return self.state
+                    return probe.remote()
+            """)
+        keys = _keys(closure_capture.analyze(str(tmp_path), _mk))
+        assert "closure_capture:self-capture:mod.A.kick.probe" in keys, keys
+
+    def test_resource_capture_caught(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import threading
+
+            def run():
+                lk = threading.Lock()
+
+                @remote
+                def guarded():
+                    with lk:
+                        return 1
+                return guarded.remote()
+            """)
+        keys = _keys(closure_capture.analyze(str(tmp_path), _mk))
+        assert ("closure_capture:resource-capture:mod.run.guarded:lk"
+                in keys), keys
+
+    def test_array_capture_caught(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def run(np):
+                big = np.zeros(1 << 20)
+
+                @remote
+                def add(i):
+                    return big + i
+                return [add.remote(i) for i in range(8)]
+            """)
+        keys = _keys(closure_capture.analyze(str(tmp_path), _mk))
+        assert ("closure_capture:array-capture:mod.run.add:big"
+                in keys), keys
+
+    def test_module_capture_caught(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def run():
+                import numpy as np
+
+                @remote
+                def make():
+                    return np.zeros(3)
+                return make.remote()
+            """)
+        keys = _keys(closure_capture.analyze(str(tmp_path), _mk))
+        assert ("closure_capture:module-capture:mod.run.make:np"
+                in keys), keys
+
+    def test_decorator_is_not_a_capture(self, tmp_path):
+        # @ray_tpu.remote evaluates in the ENCLOSING scope at def time;
+        # it must not count as the task closing over the module
+        _write(tmp_path, "mod.py", """
+            def run():
+                import ray_tpu
+
+                @ray_tpu.remote
+                def double(x):
+                    return x * 2
+                return double.remote(2)
+            """)
+        assert closure_capture.analyze(str(tmp_path), _mk) == []
+
+    def test_wrapped_nested_def_caught(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import threading
+
+            def run(remote):
+                lk = threading.Lock()
+
+                def task():
+                    with lk:
+                        return 1
+                return remote(task).remote()
+            """)
+        keys = _keys(closure_capture.analyze(str(tmp_path), _mk))
+        assert ("closure_capture:resource-capture:mod.run.task:lk"
+                in keys), keys
+
+    def test_param_passing_is_clean(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            def run(np):
+                big = np.zeros(1 << 20)
+
+                @remote
+                def add(arr, i):
+                    return arr + i
+                return [add.remote(big, i) for i in range(8)]
+            """)
+        assert closure_capture.analyze(str(tmp_path), _mk) == []
+
+
+# ---------------------------------------------------------------------------
+# blocking_calls
+# ---------------------------------------------------------------------------
+
+class TestBlockingCalls:
+    def test_blocking_get_in_actor_method(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import ray_tpu
+
+            @ray_tpu.remote
+            class Agg:
+                def combine(self, ref):
+                    return ray_tpu.get(ref) + 1
+            """)
+        keys = _keys(blocking_calls.analyze(str(tmp_path), _mk))
+        assert "blocking_calls:blocking-get:mod.Agg.combine" in keys, keys
+
+    def test_get_with_timeout_passes(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import ray_tpu
+
+            @ray_tpu.remote
+            class Agg:
+                def combine(self, ref):
+                    return ray_tpu.get(ref, timeout=5.0) + 1
+            """)
+        assert blocking_calls.analyze(str(tmp_path), _mk) == []
+
+    def test_bare_acquire_in_zone(self, tmp_path):
+        _write(tmp_path, "_private/runtime/node_daemon.py", """
+            class NodeDaemon:
+                def run(self):
+                    while True:
+                        self._lock.acquire()
+            """)
+        keys = _keys(blocking_calls.analyze(str(tmp_path), _mk))
+        assert ("blocking_calls:bare-acquire:"
+                "_private.runtime.node_daemon.NodeDaemon.run:_lock"
+                in keys), keys
+
+    def test_acquire_with_timeout_in_zone_passes(self, tmp_path):
+        _write(tmp_path, "_private/runtime/node_daemon.py", """
+            class NodeDaemon:
+                def run(self):
+                    while True:
+                        if not self._lock.acquire(timeout=1.0):
+                            continue
+            """)
+        assert blocking_calls.analyze(str(tmp_path), _mk) == []
+
+    def test_blocking_result_in_zone(self, tmp_path):
+        _write(tmp_path, "_private/runtime/node_daemon.py", """
+            class NodeDaemon:
+                def run(self):
+                    while True:
+                        self._pending_fut.result()
+            """)
+        keys = _keys(blocking_calls.analyze(str(tmp_path), _mk))
+        assert ("blocking_calls:blocking-result:"
+                "_private.runtime.node_daemon.NodeDaemon.run"
+                in keys), keys
+
+    def test_allowlist_suppresses(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import ray_tpu
+
+            @ray_tpu.remote
+            class Agg:
+                def combine(self, ref):
+                    return ray_tpu.get(ref) + 1
+            """)
+        allow = frozenset({"blocking_calls:blocking-get:mod.Agg.combine"})
+        assert blocking_calls.analyze(str(tmp_path), _mk,
+                                      allow=allow) == []
+
+    def test_non_zone_non_actor_code_exempt(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import ray_tpu
+
+            def driver_main(refs):
+                return ray_tpu.get(refs)
+            """)
+        assert blocking_calls.analyze(str(tmp_path), _mk) == []
+
+
+# ---------------------------------------------------------------------------
+# knobs doc tokenizer (regression: substring false negative)
+# ---------------------------------------------------------------------------
+
+class TestKnobsDocTokenizer:
+    def _run(self, tmp_path, readme_text, knob_names):
+        lines = "".join(
+            f'GLOBAL_CONFIG.define("{n}", int, 1, "d")\n'
+            for n in knob_names)
+        _write(tmp_path, "pkg/_private/config.py", lines)
+        reads = " + ".join(f"GLOBAL_CONFIG.{n}" for n in knob_names)
+        _write(tmp_path, "pkg/app.py",
+               f"def f(GLOBAL_CONFIG):\n    return {reads}\n")
+        readme = tmp_path / "README.md"
+        readme.write_text(readme_text)
+        return _keys(knobs.analyze(str(tmp_path / "pkg"), _mk,
+                                   readme_path=str(readme)))
+
+    def test_substring_ride_along_now_caught(self, tmp_path):
+        # `tick_interval_s` is a substring of the documented
+        # `sched_tick_interval_s` — the old plain `in` check missed it
+        keys = self._run(tmp_path,
+                         "Knobs: `sched_tick_interval_s`.\n",
+                         ["sched_tick_interval_s", "tick_interval_s"])
+        assert "knob:undocumented:tick_interval_s" in keys, keys
+        assert "knob:undocumented:sched_tick_interval_s" not in keys
+
+    def test_brace_expanded_doc_counts(self, tmp_path):
+        keys = self._run(tmp_path,
+                         "Limits: `sched_max_{edges,nodes}`.\n",
+                         ["sched_max_edges", "sched_max_nodes"])
+        assert not any(k.startswith("knob:undocumented") for k in keys), keys
+
+    def test_env_spelling_counts(self, tmp_path):
+        keys = self._run(tmp_path,
+                         "Set RAY_TPU_SPILL_DIR to relocate spills.\n",
+                         ["spill_dir"])
+        assert not any(k.startswith("knob:undocumented") for k in keys), keys
+
+    def test_multiline_table_cell_counts(self, tmp_path):
+        keys = self._run(tmp_path,
+                         "| `spill_dir`\n|  where spills go |\n",
+                         ["spill_dir"])
+        assert not any(k.startswith("knob:undocumented") for k in keys), keys
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer (raysan's dynamic half)
+# ---------------------------------------------------------------------------
+
+class _Armed:
+    """Arm the sanitizer for one test, always disarming after."""
+
+    def __enter__(self):
+        runtime_sanitizer.arm()
+        return runtime_sanitizer
+
+    def __exit__(self, *exc):
+        runtime_sanitizer.disarm()
+        return False
+
+
+class TestRuntimeSanitizer:
+    def test_wrap_lock_is_identity_when_off(self):
+        runtime_sanitizer.disarm()
+        lk = threading.Lock()
+        assert runtime_sanitizer.wrap_lock(lk, "m.C.x") is lk
+
+    def test_lock_witness_records_edges(self):
+        with _Armed() as san:
+            a = san.wrap_lock(threading.Lock(), "m.A.a")
+            b = san.wrap_lock(threading.Lock(), "m.B.b")
+            with a:
+                with b:
+                    pass
+            assert ("m.A.a", "m.B.b") in san.observed_edges()
+
+    def test_inversion_against_static_graph(self):
+        # plant the bug: runtime takes b-then-a where the static graph
+        # says a-then-b
+        with _Armed() as san:
+            a = san.wrap_lock(threading.Lock(), "m.A.a")
+            b = san.wrap_lock(threading.Lock(), "m.B.b")
+            with b:
+                with a:
+                    pass
+            inversions, _ = san.lock_witness_violations(
+                static_edges={("m.A.a", "m.B.b")})
+            assert len(inversions) == 1 and "inverts" in inversions[0]
+
+    def test_dynamic_only_inversion(self):
+        with _Armed() as san:
+            a = san.wrap_lock(threading.Lock(), "m.A.a")
+            b = san.wrap_lock(threading.Lock(), "m.B.b")
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+            inversions, _ = san.lock_witness_violations(static_edges=set())
+            assert len(inversions) == 1 and "both observed" in inversions[0]
+
+    def test_uncharted_is_informational_not_violation(self):
+        with _Armed() as san:
+            a = san.wrap_lock(threading.Lock(), "m.A.a")
+            b = san.wrap_lock(threading.Lock(), "m.B.b")
+            with a:
+                with b:
+                    pass
+            report = san.report_at_shutdown({}, static_edges=set())
+            assert report["lock_uncharted"] == ["m.A.a -> m.B.b"]
+            assert san.clean(report)
+
+    def test_rlock_reentrancy_keeps_stack_straight(self):
+        with _Armed() as san:
+            r = san.wrap_lock(threading.RLock(), "m.A.r")
+            b = san.wrap_lock(threading.Lock(), "m.B.b")
+            with r:
+                with r:  # reentrant: must not duplicate the edge base
+                    pass
+                with b:
+                    pass
+            assert ("m.A.r", "m.B.b") in san.observed_edges()
+            assert ("m.A.r", "m.A.r") not in san.observed_edges()
+
+    def test_witness_forwards_lock_introspection(self):
+        with _Armed() as san:
+            r = san.wrap_lock(threading.RLock(), "m.A.r")
+            with r:
+                assert r._is_owned()
+
+    def test_shm_leak_ledger_catches_planted_leak(self):
+        from ray_tpu._private.ids import ObjectID
+        with _Armed() as san:
+            leaked = ObjectID.from_random()
+            freed = ObjectID.from_random()
+            san.ledger_alloc("arena", leaked, 4096)
+            san.ledger_alloc("spill", freed, 128)
+            san.ledger_free(freed)
+            assert san.ledger_size() == 1
+            leaks = san.shm_leaks(set())  # nothing has a refcount row
+            assert len(leaks) == 1 and leaked.hex()[:16] in leaks[0]
+            # a live refcount row means "not leaked, just still in use"
+            assert san.shm_leaks({leaked.hex()}) == []
+
+    def test_shm_ledger_keeps_first_record_across_spill(self):
+        from ray_tpu._private.ids import ObjectID
+        with _Armed() as san:
+            oid = ObjectID.from_random()
+            san.ledger_alloc("arena", oid, 4096)
+            san.ledger_alloc("spill", oid, 4096)  # migration, same object
+            assert san.ledger_size() == 1
+            san.ledger_free(oid)
+            assert san.ledger_size() == 0
+
+    def test_ref_leak_census(self):
+        from ray_tpu._private.ids import ObjectID
+
+        class _Holder:  # weakref-able stand-in for a registered ref
+            def __init__(self, oid):
+                self._oid = oid
+
+            def object_id(self):
+                return self._oid
+
+        with _Armed() as san:
+            lost = ObjectID.from_random()
+            held = ObjectID.from_random()
+            holder = _Holder(held)
+            san.track_ref(holder)
+            snapshot = {lost: (1, 0, 0, False), held: (1, 0, 0, False)}
+            leaks = san.ref_leaks(snapshot)
+            assert len(leaks) == 1 and lost.hex()[:16] in leaks[0]
+            # the census is weak: dropping the holder exposes the row
+            del holder
+            import gc
+            gc.collect()
+            assert len(san.ref_leaks(snapshot)) == 2
+
+    def test_external_pin_suppresses_ref_leak(self):
+        from ray_tpu._private.ids import ObjectID
+        with _Armed() as san:
+            oid = ObjectID.from_random()
+            san.note_external_ref(oid)
+            assert san.ref_leaks({oid: (1, 0, 0, False)}) == []
+            san.drop_external_ref(oid)
+            assert len(san.ref_leaks({oid: (1, 0, 0, False)})) == 1
+
+    def test_wire_schema_flags_unknown_tag_and_bad_frame(self):
+        with _Armed() as san:
+            san.check_wire("head_to_daemon", ("no_such_tag", 1))
+            san.check_wire("head_to_daemon", ["not", "a", "tuple"])
+            v = san.wire_violations()
+            assert any("no_such_tag" in x for x in v), v
+            assert any("non-tagged frame" in x for x in v), v
+
+    def test_wire_schema_allows_synthetic_and_assumed_tags(self):
+        with _Armed() as san:
+            san.check_wire("daemon_to_head", ("__died__", "cause"))
+            san.check_wire("head_to_daemon", ("to_w", 1, 2, 3))
+            assert san.wire_violations() == []
+
+    def test_check_wire_is_noop_when_off(self):
+        runtime_sanitizer.disarm()
+        runtime_sanitizer.check_wire("head_to_daemon", ("garbage",))
+        assert runtime_sanitizer.wire_violations() == []
+
+    def test_clean_report_roundtrip(self):
+        with _Armed() as san:
+            report = san.report_at_shutdown({}, static_edges=set())
+            assert san.clean(report) and san.last_report() is report

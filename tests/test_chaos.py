@@ -482,3 +482,60 @@ def test_chaos_soak_seeded_and_reproducible():
     for site in {s for s, _, _ in log1}:
         assert [e for e in log1 if e[0] == site] == \
             [e for e in log2 if e[0] == site]
+
+
+@pytest.mark.chaos
+def test_chaos_soak_sanitizer_armed(monkeypatch):
+    """The PR-6/PR-7 acceptance soak with BOTH runtime mirrors armed:
+    RAY_TPU_DEBUG_LOCKS assert_holds checks and the RAY_TPU_SANITIZE
+    plane (lock witness, shm/ref leak ledger, wire schema). The run
+    must stay bit-correct AND shut down with an empty violation report
+    — the leak ledger drained, no lock inversions, no off-schema wire
+    traffic. Child worker processes inherit neither flag; this is
+    deliberate head-side coverage (the head owns every subsystem the
+    sanitizer instruments)."""
+    from ray_tpu._private.analysis import runtime_checks, runtime_sanitizer
+
+    monkeypatch.setattr(runtime_checks, "_ENABLED", True)
+    runtime_sanitizer.arm()  # BEFORE init: wrap_lock sites fire at setup
+    try:
+        expected = [float((np.arange(64, dtype=np.float64) * i).sum())
+                    for i in range(24)]
+        out, log, _ = _soak_run(4321)
+        assert out == expected
+        assert {k for _, _, k in log} >= {"kill", "exception"}
+
+        report = runtime_sanitizer.last_report()
+        assert report is not None, "Worker.shutdown never filed a report"
+        assert report["lock_inversions"] == []
+        assert report["shm_leaks"] == []
+        assert report["ref_leaks"] == []
+        assert report["wire_violations"] == []
+        assert runtime_sanitizer.clean(report)
+
+        # the soak's 512-byte payloads are inlined and never touch the
+        # arena, which would leave the shm ledger untested — run one
+        # arena-sized round and require the ledger to fill AND drain
+        runtime_sanitizer.arm()
+        ray_tpu.init(num_cpus=4, num_workers=2,
+                     _system_config={
+                         "worker_mode": "process",
+                         "object_store_memory": 32 * 1024 * 1024})
+
+        @ray_tpu.remote
+        def big(i):
+            return np.arange(200_000, dtype=np.float64) * i
+
+        refs = [big.remote(i) for i in range(6)]
+        assert len(ray_tpu.get(refs, timeout=60)) == 6
+        assert runtime_sanitizer.ledger_size() >= 6
+        del refs
+        import gc
+        gc.collect()
+        assert wait_for(lambda: runtime_sanitizer.ledger_size() == 0,
+                        timeout=10), "leak ledger never drained"
+        ray_tpu.shutdown()
+        assert runtime_sanitizer.clean(runtime_sanitizer.last_report())
+    finally:
+        runtime_sanitizer.disarm()
+        ray_tpu.shutdown()
