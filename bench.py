@@ -166,6 +166,139 @@ def _locality_subprocess(locality: bool, n: int, arg_mb: float) -> dict:
         f"locality child produced no result: {out.stderr[-2000:]}")
 
 
+_FAILOVER_CHILD = """
+import json, os, re, signal, subprocess, sys, time
+sys.path.insert(0, {repo!r})
+import ray_tpu
+from ray_tpu._private import spawn_env
+from ray_tpu.util import state as util_state
+
+TMP = {tmp!r}
+journal = os.path.join(TMP, "gcs.journal")
+log_path = os.path.join(TMP, "head.log")
+
+
+def start_head():
+    env = spawn_env.child_env(repo_path={repo!r})
+    offset = os.path.getsize(log_path) if os.path.exists(log_path) else 0
+    log = open(log_path, "a")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "2", "--num-workers", "2",
+         "--gcs-journal", journal],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        with open(log_path) as f:
+            f.seek(offset)
+            tail = f.read()
+        m = re.search(r"address='(ray://[^']+)'", tail)
+        if m:
+            return proc, m.group(1)
+        if proc.poll() is not None:
+            raise RuntimeError("head died during startup: " + tail[-1500:])
+        time.sleep(0.1)
+    raise RuntimeError("head printed no connect string")
+
+
+head1, address = start_head()
+node_env = spawn_env.child_env(
+    repo_path={repo!r},
+    extra={{"RAY_TPU_DAEMON_REJOIN_TIMEOUT_S": "60"}})
+node_log = open(os.path.join(TMP, "node.log"), "a")
+node = subprocess.Popen(
+    [sys.executable, "-m", "ray_tpu", "start", "--address", address,
+     "--num-cpus", "2", "--resources", '{{"bench": 2}}'],
+    env=node_env, stdout=node_log, stderr=subprocess.STDOUT)
+ray_tpu.init(address=address)
+
+# exec-loaded so cloudpickle ships the functions by value
+ns = {{}}
+exec("def tick(i):\\n    return i * i\\n"
+     "def nap(i):\\n    import time\\n    time.sleep(6.0)\\n    return i\\n",
+     ns)
+tick = ray_tpu.remote(ns["tick"]).options(resources={{"bench": 1}})
+nap = ray_tpu.remote(ns["nap"]).options(resources={{"bench": 1}})
+
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    try:
+        assert ray_tpu.get(tick.remote(3), timeout=5) == 9
+        break
+    except Exception:
+        time.sleep(0.3)
+else:
+    raise RuntimeError("warmup task never completed")
+
+# in-flight work across the blackout: finishes while the head is dead,
+# lands in the daemon outbox, replays into the restarted head
+pending = [nap.remote(i) for i in range(2)]
+time.sleep(0.5)
+
+t0 = time.monotonic()
+head1.send_signal(signal.SIGKILL)
+head1.wait(timeout=30)
+head2, _ = start_head()
+first = None
+deadline = time.monotonic() + 90
+while time.monotonic() < deadline:
+    try:
+        if ray_tpu.get(tick.remote(5), timeout=5) == 25:
+            first = time.monotonic()
+            break
+    except Exception:
+        time.sleep(0.2)
+if first is None:
+    raise RuntimeError("no post-failover dispatch within 90s")
+vals = ray_tpu.get(pending, timeout=60)
+
+# phase 2 — replay volume: this time keep the head DOWN until the
+# in-flight tasks have finished into the daemon outbox, so the rejoin
+# actually replays buffered completions (phase 1 restarts too fast for
+# a 6s task to beat it)
+pending2 = [nap.remote(10 + i) for i in range(2)]
+time.sleep(0.5)
+head2.send_signal(signal.SIGKILL)
+head2.wait(timeout=30)
+time.sleep(6.5)
+head3, _ = start_head()
+vals2 = ray_tpu.get(pending2, timeout=90)
+replayed = depth = 0
+for row in util_state.list_nodes():
+    replayed += row.get("outbox_replayed", 0)
+    depth += row.get("outbox_depth", 0)
+r = {{"blackout_s": round(first - t0, 3),
+     "outbox_replayed": replayed,
+     "outbox_depth_after": depth,
+     "inflight_results_correct": vals == [0, 1] and vals2 == [10, 11]}}
+ray_tpu.shutdown()
+for p in (head3, node):
+    if p.poll() is None:
+        p.terminate()
+print("FAILOVER_JSON:" + json.dumps(r))
+"""
+
+
+def _failover_subprocess() -> dict:
+    """Head-kill blackout drill in a fresh interpreter: subprocess head
+    on a journal + one remote node, SIGKILL the head mid-run, restart
+    it on the same journal, measure kill -> first post-rejoin dispatch
+    and how much the daemon outbox replayed."""
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="ray_tpu_bench_failover_")
+    env = spawn_env.child_env()
+    code = _FAILOVER_CHILD.format(repo=REPO, tmp=tmp)
+    timeout = max(120.0, min(300.0, _remaining() - 10.0))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    for line in out.stdout.splitlines():
+        if line.startswith("FAILOVER_JSON:"):
+            return json.loads(line[len("FAILOVER_JSON:"):])
+    raise RuntimeError(
+        f"failover child produced no result: {out.stderr[-2000:]}")
+
+
 def _chip_preflight() -> str:
     """Probe the accelerator in a KILLABLE subprocess: a degraded chip
     tunnel hangs jax backend init indefinitely, and an unbounded hang
@@ -646,6 +779,22 @@ def main() -> int:
         _emit()
 
     # --- RLlib: IMPALA async rollout throughput ------------------------
+    # --- failover: head-kill blackout + outbox replay volume -----------
+    if section("failover", 45):
+        try:
+            r = _failover_subprocess()
+            OUT["failover"] = r
+            print(f"  failover: {r['blackout_s']:.2f}s blackout "
+                  f"(SIGKILL head -> first post-rejoin dispatch); "
+                  f"{r['outbox_replayed']} outbox envelopes replayed, "
+                  f"in-flight results "
+                  f"{'intact' if r['inflight_results_correct'] else 'LOST'}",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+            OUT["failover"] = None
+        _emit()
+
     if section("rl_rollout", 45):
         try:
             code = (

@@ -85,6 +85,18 @@ def _cmd_start(args) -> int:
         if sys_cfg:
             kw["_system_config"] = sys_cfg
         ray_tpu.init(**kw)
+        plan_json = os.environ.get("RAY_TPU_CHAOS_PLAN", "")
+        if plan_json:
+            # seeded failure drills against a subprocess head: the
+            # failover soak arms a head-site kill this way, so the head
+            # SIGKILLs ITSELF at a deterministic health-loop arrival
+            # (same seed + plan -> same blackout point)
+            from ray_tpu import chaos as _chaos
+            plan = _json.loads(plan_json)
+            _chaos.arm(_chaos.FaultPlan(
+                plan["seed"], faults=plan.get("faults", ())))
+            print(f"ray_tpu head: chaos plan armed (seed={plan['seed']},"
+                  f" {len(plan.get('faults', []))} fault(s))", flush=True)
         w = worker_mod.get_worker()
         hs = w.enable_head_endpoint(host=args.host, port=args.port)
         host, port = hs.address

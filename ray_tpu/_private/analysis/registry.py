@@ -14,6 +14,13 @@ lockstep:
   README.md, and every documented name must still be emitted (stale
   docs were how the retired ``ray_tpu_log_bytes_written_total`` alias
   lingered). README tokens support ``{a,b}`` brace alternation.
+- **chaos fault sites**: ``chaos.py``'s ``_SITE_KINDS`` dict is the
+  injection-site registry; README's chaos section documents each site
+  as a backticked name followed by a parenthesized kinds note. A site
+  added to the code but not the docs is invisible to users writing
+  fault plans; a documented site the controller rejects fails their
+  plan at arm() (this is how the ``sched`` vs ``sched_tick`` naming
+  drift and the missing ``head`` site were caught).
 
 Emitted names are collected from ``emit("name", ...)`` first args,
 ``ray_tpu_*`` strings inside tuple/list literals (the counter tables),
@@ -119,6 +126,48 @@ def collect_documented_metrics(readme: str) -> Dict[str, str]:
 
 
 # ---------------------------------------------------------------------------
+# chaos fault sites
+# ---------------------------------------------------------------------------
+
+# a documented site row reads like:  `worker` (SIGKILL),  — a backticked
+# bare name immediately followed by a parenthesized kinds note. Tokens
+# with dots (`ray_tpu.chaos`) or without the "(" never match.
+_CHAOS_SITE_DOC_RE = re.compile(r"`([a-z][a-z0-9_]*)`\s*\(")
+_CHAOS_HEADING_RE = re.compile(r"^#+\s.*chaos", re.IGNORECASE | re.MULTILINE)
+
+
+def collect_chaos_sites(tree: ast.Module,
+                        var: str = "_SITE_KINDS") -> Dict[str, int]:
+    """site name -> lineno, from the ``_SITE_KINDS`` dict literal."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == var
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                s = const_str(k)
+                if s:
+                    out[s] = k.lineno
+    return out
+
+
+def collect_documented_sites(readme: str) -> Set[str]:
+    """Backticked site names from README's chaos section (heading
+    containing 'chaos' up to the next heading)."""
+    m = _CHAOS_HEADING_RE.search(readme)
+    if m is None:
+        return set()
+    section = readme[m.end():]
+    nxt = re.search(r"^#+\s", section, re.MULTILINE)
+    if nxt is not None:
+        section = section[:nxt.start()]
+    return set(_CHAOS_SITE_DOC_RE.findall(section))
+
+
+# ---------------------------------------------------------------------------
 # pass entry point
 # ---------------------------------------------------------------------------
 
@@ -128,7 +177,8 @@ def analyze(root: str, make_finding,
             metrics_relpaths: Sequence[str] = ("_private/metrics.py",
                                                "_private/task_events.py"),
             readme_path: Optional[str] = None,
-            dispatch_exempt: Sequence[str] = ()) -> List:
+            dispatch_exempt: Sequence[str] = (),
+            chaos_relpath: str = "_private/chaos.py") -> List:
     findings: List = []
 
     client_tree = parse_file(os.path.normpath(
@@ -188,4 +238,25 @@ def analyze(root: str, make_finding,
                 f"{PASS}:metric-phantom:{tok}",
                 f"README documents metric {tok!r} but nothing emits "
                 f"it", "README.md", 0))
+
+    chaos_tree = parse_file(os.path.normpath(
+        os.path.join(root, chaos_relpath)))
+    if chaos_tree is not None and readme:
+        sites = collect_chaos_sites(chaos_tree)
+        documented_sites = collect_documented_sites(readme)
+        if sites and documented_sites:
+            for site in sorted(set(sites) - documented_sites):
+                findings.append(make_finding(
+                    f"{PASS}:chaos-site-undocumented:{site}",
+                    f"chaos fault site {site!r} is registered in "
+                    f"{chaos_relpath} (_SITE_KINDS) but README's chaos "
+                    f"section does not document it",
+                    chaos_relpath, sites[site]))
+            for site in sorted(documented_sites - set(sites)):
+                findings.append(make_finding(
+                    f"{PASS}:chaos-site-phantom:{site}",
+                    f"README's chaos section documents fault site "
+                    f"{site!r} but {chaos_relpath} (_SITE_KINDS) does "
+                    f"not register it — a fault plan naming it fails "
+                    f"at arm()", "README.md", 0))
     return findings

@@ -85,10 +85,18 @@ DEFAULT_CHANNELS: List[ChannelSpec] = [
     ),
     ChannelSpec(
         name="daemon_to_head",
+        # _send_head buffers report-class tags through the outbox and
+        # wraps them in ("seq", n, depth, is_replay, inner) envelopes;
+        # _send_head_raw is the direct socket write (the envelope
+        # itself, replays, and the clock handshake go through it)
         sends=[SendSpec("_private/runtime/node_daemon.py",
-                        "_send_head")],
+                        "_send_head"),
+               SendSpec("_private/runtime/node_daemon.py",
+                        "_send_head_raw")],
         recvs=[RecvSpec("_private/runtime/remote_pool.py",
-                        "RemoteNodePool._demux_loop")],
+                        "RemoteNodePool._demux_loop"),
+               RecvSpec("_private/runtime/remote_pool.py",
+                        "RemoteNodePool._dispatch_daemon_msg")],
     ),
     ChannelSpec(
         name="owner_to_worker",

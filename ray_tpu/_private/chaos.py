@@ -27,6 +27,15 @@ transfer   truncate             RemoteNodePool.fetch_object (wire
 sched_tick slow                 Worker dispatch path (slow node)
 heartbeat  drop                 GcsService health loop (node stays
                                 connected but its heartbeat is lost)
+head       kill, restart, flap  GcsService health loop: ``flap``
+                                severs every remote daemon link
+                                (exercising outbox replay + rejoin
+                                re-attach without killing anyone);
+                                ``kill`` SIGKILLs the head process
+                                itself; ``restart`` is a marker for
+                                external harnesses (bench/soak
+                                drivers kill + relaunch the head
+                                subprocess at the seeded arrival)
 ========== ==================== =====================================
 
 The public surface is :mod:`ray_tpu.chaos`; ``state.list_faults()``
@@ -41,7 +50,8 @@ import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 SITES: Tuple[str, ...] = (
-    "task", "worker", "link", "transfer", "sched_tick", "heartbeat")
+    "task", "worker", "link", "transfer", "sched_tick", "heartbeat",
+    "head")
 
 _SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "task": ("exception", "hang"),
@@ -50,6 +60,7 @@ _SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "transfer": ("truncate",),
     "sched_tick": ("slow",),
     "heartbeat": ("drop",),
+    "head": ("kill", "restart", "flap"),
 }
 
 # default parameters for kinds that need one; overridable per plan entry

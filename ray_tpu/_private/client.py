@@ -27,6 +27,7 @@ from __future__ import annotations
 import collections
 import logging
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -34,6 +35,7 @@ import cloudpickle
 
 from ray_tpu import exceptions as rex
 from ray_tpu._private.analysis import runtime_sanitizer
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
 
@@ -75,6 +77,15 @@ class ClientServer:
         client_id = hello[2] if len(hello) > 2 else uuid.uuid4().hex
         session = ClientSession(client_id, conn)
         with self._lock:
+            old = self._sessions.get(client_id)
+            if old is not None:
+                # the same client_id reconnecting is a RESUMED session
+                # (link flap, or a rebind that beat the old serve
+                # thread's EOF): the new link inherits the pins so the
+                # old thread's _drop cannot free objects the client
+                # still points at
+                session.pinned = old.pinned
+                old.pinned = set()
             self._sessions[client_id] = session
         threading.Thread(target=self._serve, args=(session,), daemon=True,
                          name=f"ray_tpu_client_{client_id[:8]}").start()
@@ -108,7 +119,11 @@ class ClientServer:
 
     def _drop(self, s: ClientSession) -> None:
         with self._lock:
-            self._sessions.pop(s.client_id, None)
+            # identity check: if the client already re-attached under
+            # the same client_id, the registry row belongs to the NEW
+            # session — popping it would orphan the resumed link
+            if self._sessions.get(s.client_id) is s:
+                self._sessions.pop(s.client_id, None)
         # the session's pins die with it
         for oid in list(s.pinned):
             try:
@@ -364,6 +379,12 @@ class _ClientRC:
     def pin(self, oid) -> None:
         pass
 
+    def live_oids(self) -> List[ObjectID]:
+        """Every oid the client still holds refs to — what a resumed
+        session must re-pin on the (possibly restarted) head."""
+        with self._lock:
+            return list(self._counts)
+
 
 class ClientWorker:
     """Installed as the global worker when init(address='ray://...')."""
@@ -371,19 +392,27 @@ class ClientWorker:
     is_client = True
     needs_serialized_funcs = True  # funcs ship to the server by value
 
-    def __init__(self, host: str, port: int, authkey: bytes):
-        from multiprocessing.connection import Client as _Connect
+    # ops safe to transparently re-issue on a resumed session: reads
+    # and at-least-once-safe mutations. Anything that CREATES (put,
+    # submit, create_actor, actor_call) must instead fail its caller —
+    # re-sending could execute the side effect twice.
+    _RESUMABLE_OPS = frozenset({
+        "get", "wait", "state", "kv", "ping", "release", "pin",
+        "cancel", "get_actor", "kill_actor",
+    })
 
+    def __init__(self, host: str, port: int, authkey: bytes):
         self.worker_id = WorkerID.from_random()
         self.job_id = JobID.from_random()  # provisional ids only
         self.alive = True
         self.client_id = uuid.uuid4().hex
-        from ray_tpu._private.protocol import make_wire_hello
-
-        self._conn = _Connect((host, port), authkey=authkey)
-        self._conn.send(make_wire_hello("client", self.client_id))
+        # the client_id doubles as the SESSION TOKEN: reconnecting with
+        # the same id resumes the server-side session (pin inheritance)
+        # instead of opening a fresh one
+        self._endpoint = (host, port, authkey)
+        self._closing = False
         self._send_lock = threading.Lock()
-        self._replies: Dict[int, Tuple[threading.Event, list]] = {}
+        self._replies: Dict[int, list] = {}  # req_id -> [ev, slot, op, payload, sent]
         self._req_seq = 0
         self._seq_lock = threading.Lock()
         self.reference_counter = _ClientRC(self)
@@ -394,13 +423,7 @@ class ClientWorker:
         self._waiter_lock = threading.Lock()
         self._waiter_wake = threading.Event()
         self._waiter_thread: Optional[threading.Thread] = None
-        ready = self._conn.recv()
-        if isinstance(ready, tuple) and ready[:1] == ("error",):
-            # e.g. protocol-version rejection: surface the head's reason
-            raise ConnectionError(str(ready[1]))
-        if ready != ("ready",):
-            raise ConnectionError("head did not acknowledge the client "
-                                  f"session (got {ready!r})")
+        self._conn = self._dial()
         self._reader_thread = threading.Thread(
             target=self._reader, daemon=True, name="ray_tpu_client_reader")
         self._reader_thread.start()
@@ -409,6 +432,32 @@ class ClientWorker:
                                   "serve thread is not answering")
 
     # -- transport ----------------------------------------------------
+    def _dial(self):
+        """Connect + hello + ready handshake; returns the live conn."""
+        from multiprocessing.connection import Client as _Connect
+        from ray_tpu._private.protocol import make_wire_hello
+
+        host, port, authkey = self._endpoint
+        conn = _Connect((host, port), authkey=authkey)
+        try:
+            conn.send(make_wire_hello("client", self.client_id))
+            ready = conn.recv()
+        except BaseException:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            raise
+        if isinstance(ready, tuple) and ready[:1] == ("error",):
+            # e.g. protocol-version rejection: surface the head's reason
+            conn.close()
+            raise ConnectionError(str(ready[1]))
+        if ready != ("ready",):
+            conn.close()
+            raise ConnectionError("head did not acknowledge the client "
+                                  f"session (got {ready!r})")
+        return conn
+
     def _reader(self) -> None:
         while True:
             try:
@@ -418,14 +467,86 @@ class ClientWorker:
                 # otherwise every pending and future _rpc hangs forever
                 req_id, ok, data = msg
             except (EOFError, OSError, TypeError, ValueError):
+                if not self._closing and self._try_reconnect():
+                    continue
                 self.alive = False
-                for ev, _slot in list(self._replies.values()):
-                    ev.set()
+                for ent in list(self._replies.values()):
+                    ent[0].set()
                 return
-            slot = self._replies.pop(req_id, None)
-            if slot is not None:
-                slot[1][:] = [ok, data]
-                slot[0].set()
+            ent = self._replies.pop(req_id, None)
+            if ent is not None:
+                ent[1][:] = [ok, data]
+                ent[0].set()
+
+    def _try_reconnect(self) -> bool:
+        """The link to the head died mid-session: keep re-dialing with
+        the SAME client_id until `client_reconnect_timeout_s` runs out.
+        On rebind the server resumes the session (or, after a head
+        restart, opens a new one under the old token); live refs are
+        re-pinned and in-flight idempotent ops are re-issued so a
+        driver blocked in get() resolves once failover reconciliation
+        re-completes its objects. In-flight CREATING ops (put/submit/
+        actor calls) are failed with ConnectionError instead — replay
+        could run their side effects twice."""
+        timeout = GLOBAL_CONFIG.client_reconnect_timeout_s
+        if timeout <= 0:
+            return False
+        deadline = time.monotonic() + timeout
+        delay = 0.1
+        logger.warning("client session %s lost its head connection; "
+                       "reconnecting for up to %.0fs",
+                       self.client_id[:8], timeout)
+        while not self._closing and time.monotonic() < deadline:
+            try:
+                conn = self._dial()
+            except Exception:
+                time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+                delay = min(delay * 2, 2.0)
+                continue
+            unsafe: list = []
+            with self._send_lock:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = conn
+                try:
+                    live = self.reference_counter.live_oids()
+                    if live:
+                        with self._seq_lock:
+                            self._req_seq += 1
+                            rid = self._req_seq
+                        conn.send(("pin", rid,
+                                   ([o.binary() for o in live],)))
+                    replayed = 0
+                    for req_id, ent in list(self._replies.items()):
+                        _ev, _slot, op, payload, sent = ent
+                        if not sent:
+                            # its _rpc has not sent yet; it will go out
+                            # on the new conn by itself
+                            continue
+                        if op in self._RESUMABLE_OPS:
+                            conn.send((op, req_id, payload))
+                            replayed += 1
+                        else:
+                            unsafe.append((req_id, ent))
+                except (OSError, ValueError):
+                    continue  # new link died during replay: dial again
+            # fail the non-replayable ops outside the send lock (no
+            # reply can race in for them: they were never re-sent)
+            for req_id, ent in unsafe:
+                self._replies.pop(req_id, None)
+                ent[1][:] = [False, cloudpickle.dumps(
+                    ConnectionError(
+                        f"client op {ent[2]!r} was in flight when the "
+                        "head connection dropped; it cannot be "
+                        "replayed safely"))]
+                ent[0].set()
+            logger.warning("client session %s rebound to the head "
+                           "(%d in-flight ops replayed)",
+                           self.client_id[:8], replayed)
+            return True
+        return False
 
     def _rpc(self, op: str, *payload, timeout: Optional[float] = None):
         if not self.alive:
@@ -435,14 +556,27 @@ class ClientWorker:
             req_id = self._req_seq
         ev: threading.Event = threading.Event()
         slot: list = []
-        self._replies[req_id] = (ev, slot)
+        ent = [ev, slot, op, payload, False]
+        self._replies[req_id] = ent
         if not self.alive:
             # registered after the reader's disconnect sweep: bail now
             # instead of waiting forever on a reply that cannot come
             self._replies.pop(req_id, None)
             raise ConnectionError("client session disconnected")
-        with self._send_lock:
-            self._conn.send((op, req_id, payload))
+        try:
+            with self._send_lock:
+                self._conn.send((op, req_id, payload))
+                ent[4] = True  # sent: a reconnect must replay or fail it
+        except (OSError, ValueError):
+            # link down mid-send. The reader is (or will be) in its
+            # reconnect loop; a rebind replays sent ops only, so mark
+            # this one sent too — the frame may have partially left —
+            # and fall through to the wait. If reconnection fails the
+            # reader's sweep wakes us below.
+            ent[4] = True
+            if self._closing or GLOBAL_CONFIG.client_reconnect_timeout_s <= 0:
+                self._replies.pop(req_id, None)
+                raise ConnectionError("client session disconnected")
         if not ev.wait(timeout) or not slot:
             self._replies.pop(req_id, None)
             if not self.alive:
@@ -632,6 +766,7 @@ class ClientWorker:
         return self._rpc("ping", timeout=timeout) == "pong"
 
     def shutdown(self) -> None:
+        self._closing = True  # a deliberate close must not reconnect
         self.alive = False
         # close() alone cannot interrupt a reader blocked in recv: the
         # blocked syscall pins the open file description, so the socket

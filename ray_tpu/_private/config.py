@@ -140,14 +140,33 @@ _d("gcs_journal_compact_every", int, 1000,
    "rewritten as one snapshot record, so a long-lived head's journal "
    "stays bounded by table size, not mutation count); 0 disables")
 _d("gcs_journal_fsync", bool, False,
-   "fsync the journal after every append: survives MACHINE crash, not "
+   "fsync the journal after EVERY append: survives MACHINE crash, not "
    "just process crash, at per-mutation disk-latency cost (the "
    "reference's Redis tier makes the same durability trade via its "
-   "appendfsync policy)")
+   "appendfsync policy). Independently of this knob, critical ops — "
+   "node/actor registration and actor state transitions — always "
+   "fsync, so the failover contract never depends on the page cache")
+_d("gcs_journal_compact_bytes", int, 16 * 1024 * 1024,
+   "journal size threshold that auto-triggers snapshot compaction in "
+   "addition to the op-count path (gcs_journal_compact_every): a "
+   "lease-heavy workload with large specs stays bounded by bytes, not "
+   "just record count; 0 disables the size trigger")
 _d("daemon_rejoin_timeout_s", float, 20.0,
    "how long an orphaned node daemon (head connection lost without an "
    "exit) retries reconnecting to the head address before giving up "
    "and dying; 0 = die immediately (pre-FT behavior)")
+_d("daemon_rejoin_grace_s", float, 10.0,
+   "head-side grace window after a daemon link drops before the node "
+   "is declared dead: the node sits in REJOINING state and its "
+   "in-flight leases are kept alive; a daemon that re-dials within "
+   "the window re-attaches with outbox replay and nothing is lost. "
+   "0 = declare death immediately (pre-failover behavior)")
+_d("client_reconnect_timeout_s", float, 30.0,
+   "ray:// client session-resumption budget: on a dropped connection "
+   "the client re-dials the head address with the SAME session token, "
+   "re-issuing idempotent in-flight ops (get/wait/state/kv), so a "
+   "driver blocked in get() across a head restart resolves late; "
+   "0 = fail pending ops immediately (pre-failover behavior)")
 _d("worker_tpu_access", bool, False,
    "give process workers the TPU plugin bootstrap (default: the head "
    "owns the chip; workers run CPU jax, starting seconds faster)")

@@ -33,18 +33,23 @@ CH_ERROR = "ERROR"
 
 class NodeEntry:
     __slots__ = ("node_id", "index", "resources", "state", "kind",
-                 "last_heartbeat", "pool", "death_reason")
+                 "last_heartbeat", "pool", "death_reason",
+                 "rejoining_since")
 
     def __init__(self, node_id: NodeID, index: int,
                  resources: Dict[str, float], kind: str, pool=None):
         self.node_id = node_id
         self.index = index              # scheduler row
         self.resources = dict(resources)
-        self.state = "ALIVE"
+        self.state = "ALIVE"            # ALIVE | REJOINING | DEAD
         self.kind = kind                # "local" | "process"
         self.last_heartbeat = time.monotonic()
         self.pool = pool                # ProcessWorkerPool for kind=process
         self.death_reason: Optional[str] = None
+        # monotonic timestamp of the link drop that put the node into
+        # REJOINING (the grace window before death is declared); None
+        # while ALIVE/DEAD
+        self.rejoining_since: Optional[float] = None
 
 
 class ActorEntry:
@@ -198,6 +203,20 @@ class GcsService:
         self._actor_recovery: Dict[ActorID, bytes] = {}
         self._journal = journal
         self._ops_since_compact = 0
+        # in-flight remote leases (task dispatches to remote daemons),
+        # mirrored in the journal so a restarted head can reconcile them
+        # against what rejoining daemons report still running
+        self._leases: Dict[bytes, Dict[str, Any]] = {}
+        # remote nodes the journal says were alive pre-restart: the
+        # reconciler waits for these to rejoin before resubmitting
+        # unclaimed leases
+        self.expected_nodes: Dict[bytes, Dict[str, Any]] = {}
+        # 1 when this head recovered prior state from a journal (i.e.
+        # this process IS the post-failover head), else 0; exported as
+        # ray_tpu_head_failovers_total
+        self.head_failovers = 0
+        self.replayed_lease_count = 0
+        self.replayed_node_count = 0
         if journal is not None:
             self._replay(GcsJournal.replay(journal.path))
         # object directory: node rows holding a copy of each object
@@ -216,20 +235,36 @@ class GcsService:
     # journal replay (restore-in-place after a head restart)
     # ------------------------------------------------------------------
     def _replay(self, ops: List[Tuple]) -> None:
-        """Rebuild actor + KV tables from the WAL. Replayed actors come
-        back ORPHANED: name-resolvable immediately, runnable once their
-        node daemon rejoins and the runtime re-attaches. Nodes are NOT
-        journaled — live daemons re-register themselves."""
+        """Rebuild actor + KV tables (and the in-flight lease / expected
+        node views) from the WAL. Replayed actors come back ORPHANED:
+        name-resolvable immediately, runnable once their node daemon
+        rejoins and the runtime re-attaches. Node table rows are NOT
+        rebuilt — live daemons re-register themselves; the journal's
+        node records only feed ``expected_nodes`` so the reconciler
+        knows who should come back.
+
+        Runs inside __init__ before any other thread exists; the lock
+        is taken anyway so every mutation of the guarded tables stays
+        uniformly under it."""
+        with self._lock:
+            self._replay_locked(ops)
+
+    def _replay_locked(self, ops: List[Tuple]) -> None:
         for op in ops:
             kind = op[0]
             if kind == "snapshot":
                 # compaction record: authoritative table state at the
-                # time of the rewrite; later ops apply on top
-                _, actors, kv = op
+                # time of the rewrite; later ops apply on top. Older
+                # journals carry 3-field snapshots (no leases/nodes).
+                actors, kv = op[1], op[2]
+                leases = op[3] if len(op) > 3 else {}
+                nodes = op[4] if len(op) > 4 else {}
                 self._actors.clear()
                 self._actor_names.clear()
                 self._actor_recovery.clear()
                 self._kv.clear()
+                self._leases = dict(leases)
+                self.expected_nodes = dict(nodes)
                 for abin, name, ns, class_name, recovery, state in actors:
                     actor_id = ActorID(abin)
                     entry = ActorEntry(actor_id, name, ns, class_name,
@@ -242,6 +277,16 @@ class GcsService:
                         self._actor_recovery[actor_id] = recovery
                 for ns, k, v in kv:
                     self._kv[(ns, k)] = v
+            elif kind == "lease":
+                _, tid_bin, record = op
+                self._leases[tid_bin] = record
+            elif kind == "lease_done":
+                self._leases.pop(op[1], None)
+            elif kind == "node":
+                _, nbin, info = op
+                self.expected_nodes[nbin] = info
+            elif kind == "node_dead":
+                self.expected_nodes.pop(op[1], None)
             elif kind == "actor":
                 _, abin, name, ns, class_name, recovery = op
                 actor_id = ActorID(abin)
@@ -270,18 +315,35 @@ class GcsService:
                 _, ns, k = op
                 self._kv.pop((ns, k), None)
         if ops:
-            logger.info("GCS journal replayed: %d ops, %d actors, %d kv",
-                        len(ops), len(self._actors), len(self._kv))
+            self.head_failovers = 1
+            self.replayed_lease_count = len(self._leases)
+            # how many remote daemons the PRE-restart cluster had: the
+            # reconciler waits for this many rejoins before resubmitting
+            # unclaimed leases (rejoined daemons get fresh NodeIDs, so a
+            # count — not identity — is the only matchable quantity)
+            self.replayed_node_count = len(self.expected_nodes)
+            logger.info("GCS journal replayed: %d ops, %d actors, %d kv, "
+                        "%d pending leases, %d expected nodes",
+                        len(ops), len(self._actors), len(self._kv),
+                        len(self._leases), len(self.expected_nodes))
 
-    def _log(self, op: Tuple) -> None:
+    def _log(self, op: Tuple, critical: bool = False) -> None:
         if self._journal is None:
             return
         from ray_tpu._private.config import GLOBAL_CONFIG
 
-        self._journal.append(op, fsync=GLOBAL_CONFIG.gcs_journal_fsync)
+        # critical ops (node/actor registration, actor state
+        # transitions) are always fsynced: the failover contract for
+        # re-adoptable state must not depend on the page cache
+        self._journal.append(
+            op, fsync=critical or GLOBAL_CONFIG.gcs_journal_fsync)
         every = GLOBAL_CONFIG.gcs_journal_compact_every
         self._ops_since_compact += 1
         if every and self._ops_since_compact >= every:
+            self.compact_journal()
+            return
+        max_bytes = GLOBAL_CONFIG.gcs_journal_compact_bytes
+        if max_bytes and self._journal.size_bytes() >= max_bytes:
             self.compact_journal()
 
     def compact_journal(self) -> None:
@@ -294,8 +356,53 @@ class GcsService:
                        a.state)
                       for a in self._actors.values()]
             kv = [(ns, k, v) for (ns, k), v in self._kv.items()]
-        self._journal.rewrite([("snapshot", actors, kv)])
+            leases = dict(self._leases)
+            nodes = dict(self.expected_nodes)
+        self._journal.rewrite([("snapshot", actors, kv, leases, nodes)])
         self._ops_since_compact = 0
+
+    # ------------------------------------------------------------------
+    # in-flight lease journal (head-failover reconciliation)
+    # ------------------------------------------------------------------
+    @property
+    def journal_enabled(self) -> bool:
+        """True when this head persists a WAL (callers skip building
+        lease records entirely otherwise — the default-config cost of
+        the failover plane is one attribute read per dispatch)."""
+        return self._journal is not None
+
+    def journal_lease(self, task_id_bin: bytes,
+                      record: Dict[str, Any]) -> None:
+        """Record a task dispatched to a remote daemon. No-op without a
+        journal (zero cost in the default configuration). ``record``
+        carries enough to resubmit: name, fn/args blobs, return oid
+        bins, resources, attempt token."""
+        if self._journal is None:
+            return
+        with self._lock:
+            self._leases[task_id_bin] = record
+            self._log(("lease", task_id_bin, record))
+
+    def journal_lease_done(self, task_id_bin: bytes) -> None:
+        """Terminal completion of a remote lease (done OR failed):
+        removes it from the reconciliation set."""
+        if self._journal is None:
+            return
+        with self._lock:
+            self._leases.pop(task_id_bin, None)
+            self._log(("lease_done", task_id_bin))
+
+    def claim_lease(self, task_id_bin: bytes) -> Optional[Dict[str, Any]]:
+        """A rejoining daemon reported this task still in flight: hand
+        the lease record to the reconciler and drop it from the
+        unclaimed set (claim-once)."""
+        with self._lock:
+            return self._leases.pop(task_id_bin, None)
+
+    def pending_leases(self) -> Dict[bytes, Dict[str, Any]]:
+        """Leases no surviving node has claimed (yet)."""
+        with self._lock:
+            return dict(self._leases)
 
     def actor_recovery_blob(self, actor_id: ActorID) -> Optional[bytes]:
         with self._lock:
@@ -316,6 +423,13 @@ class GcsService:
         with self._lock:
             self._nodes[node_id] = entry
             self._node_by_index[index] = entry
+            if kind == "remote":
+                # critical (fsynced) op: the restarted head's reconciler
+                # uses the expected-node set to know which daemons
+                # should rejoin before it resubmits unclaimed leases
+                info = {"resources": dict(resources)}
+                self.expected_nodes[node_id.binary()] = info
+                self._log(("node", node_id.binary(), info), critical=True)
         self.publish(CH_NODE, {"event": "ALIVE", "node_id": node_id,
                                "index": index})
         return entry
@@ -333,8 +447,42 @@ class GcsService:
                 return
             e.state = "DEAD"
             e.death_reason = reason
+            e.rejoining_since = None
+            if e.kind == "remote":
+                self.expected_nodes.pop(node_id.binary(), None)
+                self._log(("node_dead", node_id.binary()), critical=True)
         self.publish(CH_NODE, {"event": "DEAD", "node_id": node_id,
                                "index": e.index, "reason": reason})
+
+    def mark_node_rejoining(self, node_id: NodeID) -> bool:
+        """Link to the node's daemon dropped: enter the grace window.
+        The node leaves ``alive_process_nodes()`` (health probes pause)
+        but keeps its scheduler row and in-flight leases; a re-dial
+        within the grace flips it back ALIVE via
+        :meth:`mark_node_rejoined`. Returns False when the node is
+        already DEAD (no grace to grant)."""
+        with self._lock:
+            e = self._nodes.get(node_id)
+            if e is None or e.state == "DEAD":
+                return False
+            if e.state != "REJOINING":
+                e.state = "REJOINING"
+                e.rejoining_since = time.monotonic()
+        self.publish(CH_NODE, {"event": "REJOINING", "node_id": node_id,
+                               "index": e.index})
+        return True
+
+    def mark_node_rejoined(self, node_id: NodeID) -> None:
+        """The daemon re-dialed within the grace window."""
+        with self._lock:
+            e = self._nodes.get(node_id)
+            if e is None or e.state != "REJOINING":
+                return
+            e.state = "ALIVE"
+            e.rejoining_since = None
+            e.last_heartbeat = time.monotonic()
+        self.publish(CH_NODE, {"event": "ALIVE", "node_id": node_id,
+                               "index": e.index})
 
     def node_table(self) -> List[NodeEntry]:
         with self._lock:
@@ -456,7 +604,7 @@ class GcsService:
                 # match applied order (GcsJournal has its own _wlock,
                 # so holding self._lock here cannot deadlock)
                 self._log(("actor", actor_id.binary(), name, namespace,
-                           class_name, recovery))
+                           class_name, recovery), critical=True)
         self.publish(CH_ACTOR, {"event": "REGISTERED",
                                 "actor_id": actor_id})
         return entry
@@ -476,7 +624,8 @@ class GcsService:
             if state == "DEAD":
                 self._actor_recovery.pop(actor_id, None)
             if journaled:
-                self._log(("actor_state", actor_id.binary(), state))
+                self._log(("actor_state", actor_id.binary(), state),
+                          critical=True)
         self.publish(CH_ACTOR, {"event": state, "actor_id": actor_id})
 
     def get_actor_by_name(self, name: str,
@@ -597,6 +746,9 @@ class GcsService:
             GLOBAL_CONFIG.health_check_timeout_s / max(interval, 1e-6)))
         while not self._shutdown:
             time.sleep(interval)
+            fault = chaos.poll("head")
+            if fault is not None:
+                self._inject_head_fault(fault)
             for e in self.alive_process_nodes():
                 pool = e.pool
                 if pool is None:
@@ -637,6 +789,32 @@ class GcsService:
                         self.heartbeat(e.node_id)
                     # a dropped heartbeat is "recovered" when the
                     # staleness guard above later declares the node dead
+
+    def _inject_head_fault(self, fault: Dict[str, Any]) -> None:
+        """``head`` chaos site, polled once per health tick. ``flap``
+        severs every remote daemon link in-process (exercising outbox
+        buffering, rejoin re-attach, and replay dedup without killing
+        anyone); ``kill`` SIGKILLs this head process — the arrival index
+        makes the blackout point seed-reproducible. ``restart`` is a
+        marker kind for external harnesses (they poll the plan and
+        kill + relaunch the head subprocess) and is a no-op in-core."""
+        kind = fault.get("kind")
+        if kind == "flap":
+            logger.warning("chaos[head]: flapping all daemon links")
+            for e in self.alive_process_nodes():
+                if e.kind == "remote" and e.pool is not None:
+                    try:
+                        e.pool.sever_link()
+                    except Exception:
+                        logger.exception("chaos[head]: flap of node %s "
+                                         "failed", e.node_id.hex()[:16])
+        elif kind == "kill":
+            import os
+            import signal
+
+            logger.warning("chaos[head]: SIGKILL self (pid %d)",
+                           os.getpid())
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def shutdown(self) -> None:
         self._shutdown = True

@@ -240,6 +240,26 @@ def _render_core(worker) -> List[str]:
     lines.extend(task_events.render_prometheus(
         getattr(worker, "task_events", None)))
 
+    # head failover + daemon outbox plane: did this head replay a
+    # journal at boot, and how much daemon-side traffic is buffered /
+    # has been replayed across link drops
+    emit("ray_tpu_head_failovers_total", "counter",
+         "head restarts this GCS recovered from (journal replays that "
+         "found prior state)", getattr(worker.gcs, "head_failovers", 0))
+    outbox_depth = 0
+    outbox_replayed = 0
+    for e in worker.gcs.node_table():
+        pool = e.pool
+        if pool is not None and getattr(pool, "is_remote", False):
+            outbox_depth += getattr(pool, "outbox_depth", 0)
+            outbox_replayed += getattr(pool, "outbox_replayed", 0)
+    emit("ray_tpu_daemon_outbox_depth", "gauge",
+         "report-class daemon messages currently buffered awaiting "
+         "head acknowledgement (summed over remote nodes)", outbox_depth)
+    emit("ray_tpu_daemon_outbox_replayed_total", "counter",
+         "buffered daemon messages re-sent after a link drop or head "
+         "failover (summed over remote nodes)", outbox_replayed)
+
     from ray_tpu._private.chaos import get_controller
     chaos = get_controller().counters()
     for name, desc, per_site, total in (

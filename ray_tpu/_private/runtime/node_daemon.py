@@ -65,6 +65,17 @@ Daemon -> head messages:
                               object directory
   ("log_listed", rid, rows)   log_list reply
   ("log_data", rid, ok, text) log_read reply
+
+Report-class messages (w / worker_died / pulled / log — anything the
+head must not lose across a blackout) don't travel bare: they ride a
+sequence-numbered outbox envelope ("seq", n, depth, is_replay, inner)
+and are buffered until the head acknowledges them with ("ack", n)
+(high-water mark; the daemon trims its outbox prefix). After a link
+drop the daemon replays every unacked entry on rejoin; the head dedups
+by per-node sequence number, so a transient flap delivers each report
+exactly once. Request/reply tags (fetched/pong/log_listed/log_data)
+and the clock handshake stay bare — their requester died with the old
+link, so replaying them is meaningless.
 """
 
 from __future__ import annotations
@@ -83,9 +94,67 @@ from ray_tpu._private.analysis.runtime_checks import assert_holds
 from ray_tpu._private.ids import ObjectID
 
 
+class _Outbox:
+    """Sequence-numbered buffer of report-class daemon->head messages.
+
+    Every message appended gets the next sequence number and stays
+    buffered until the head acks a high-water mark at or past it
+    (``ack`` trims the prefix). While the head link is down nothing is
+    lost — ``pending()`` snapshots the unacked tail for replay after a
+    rejoin. Depth is bounded in practice by the rejoin timeout times
+    the node's report rate; an explicit cap would silently violate the
+    exactly-once contract, so there isn't one.
+    """
+
+    def __init__(self):
+        import collections
+
+        self._entries = collections.deque()   # (seq, msg), seq ascending
+        self._next_seq = 1
+        self._lock = threading.Lock()
+
+    def append(self, msg: tuple):
+        """Buffer ``msg``; returns (assigned seq, depth after append)."""
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq = seq + 1
+            self._entries.append((seq, msg))
+            return seq, len(self._entries)
+
+    def ack(self, seq: int) -> int:
+        """Trim every entry with sequence <= ``seq`` (the head processed
+        them). Returns how many entries were trimmed. Stale/duplicate
+        acks (already-trimmed prefixes) are no-ops."""
+        trimmed = 0
+        with self._lock:
+            while self._entries and self._entries[0][0] <= seq:
+                self._entries.popleft()
+                trimmed += 1
+        return trimmed
+
+    def pending(self):
+        """Snapshot of unacked (seq, msg) entries, oldest first."""
+        with self._lock:
+            return list(self._entries)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._next_seq - 1
+
+
+# daemon->head tags that ride the outbox (report-class: the head must
+# not lose them across a blackout); everything else is sent bare
+_OUTBOX_TAGS = frozenset(("w", "worker_died", "pulled", "log"))
+
+
 class _WorkerSlot:
-    __slots__ = ("num", "proc", "conn", "ctrl", "pid", "returns", "gets",
-                 "actor_bin", "send_lock", "err_path")
+    __slots__ = ("num", "proc", "conn", "ctrl", "pid", "returns",
+                 "attempts", "gets", "actor_bin", "send_lock", "err_path")
 
     def __init__(self, num: int):
         self.num = num
@@ -100,6 +169,10 @@ class _WorkerSlot:
         # task_id binary -> [return oid binaries] for in-flight payloads,
         # so sealed shm returns can be rewritten on "done"
         self.returns: Dict[bytes, list] = {}
+        # task_id binary -> attempt token (stamped by the head at
+        # dispatch); rides the rejoin in-flight report so a restarted
+        # head can discard stale-attempt replays after a resubmission
+        self.attempts: Dict[bytes, int] = {}
         # req_id -> purpose ("get" | "arg") of get RPCs forwarded to
         # the head, whose replies may carry ("node_shm", oid) markers
         # to rewrite as arena locations / peer pulls (purpose sets the
@@ -446,6 +519,9 @@ class NodeDaemon:
         threading.Thread(target=self._peer_accept_loop, daemon=True,
                          name="ray_tpu_node_peer_accept").start()
 
+        # report-class messages are sequenced through the outbox so a
+        # head blackout loses nothing (see module docstring)
+        self._outbox = _Outbox()
         self._head = Client(head_address, authkey=head_authkey)
         self._head_lock = threading.Lock()
         # arena name travels in the hello so the head can reap the
@@ -476,12 +552,33 @@ class NodeDaemon:
         self._send_head(("pulled", oid_bin))
 
     def _send_head(self, msg: tuple) -> None:
+        if msg[0] in _OUTBOX_TAGS:
+            # report-class: buffer first, THEN try to send — a failed
+            # send just leaves the entry in the outbox for the rejoin
+            # replay (exactly-once: the head dedups by sequence)
+            seq, depth = self._outbox.append(msg)
+            self._send_head_raw(("seq", seq, depth, False, msg))
+        else:
+            self._send_head_raw(msg)
+
+    def _send_head_raw(self, msg: tuple) -> None:
         try:
             with self._head_lock:
                 self._head.send(msg)
         except (OSError, ValueError):
-            # head gone: nothing to report to; the main loop will exit
+            # head gone: outbox entries replay on rejoin; bare
+            # request/reply traffic is moot (its requester died with
+            # the link) and the main loop handles reconnecting
             pass
+
+    def _replay_outbox(self) -> None:
+        """Re-send every unacked report to the (re)joined head, flagged
+        as replay. The head processes entries it has never seen and
+        drops duplicates by sequence number — a flap mid-replay just
+        triggers another (still deduped) replay on the next rejoin."""
+        pending = self._outbox.pending()
+        for i, (seq, msg) in enumerate(pending):
+            self._send_head_raw(("seq", seq, len(pending) - i, True, msg))
 
     # ------------------------------------------------------------------
     # worker lifecycle
@@ -627,6 +724,7 @@ class NodeDaemon:
         if kind in ("done",):
             task_id_bin, entries = msg[1], msg[2]
             return_bins = slot.returns.pop(task_id_bin, [])
+            slot.attempts.pop(task_id_bin, None)
             out = []
             for i, entry in enumerate(entries):
                 if entry[0] == "shm" and i < len(return_bins):
@@ -639,6 +737,7 @@ class NodeDaemon:
             return (msg[0], task_id_bin, out) + tuple(msg[3:])
         if kind == "err":
             slot.returns.pop(msg[1], None)
+            slot.attempts.pop(msg[1], None)
         return msg
 
     def _serve_fetch(self, fid: int, oid_bin: bytes) -> None:
@@ -998,6 +1097,8 @@ class NodeDaemon:
                         rids = p.get("return_ids")
                         if rids:
                             slot.returns[p["task_id"]] = list(rids)
+                            slot.attempts[p["task_id"]] = p.get(
+                                "attempt", 0)
                         if payload[0] == "actor_create":
                             slot.actor_bin = p.get("actor_bin")
                     elif payload[0] == "tasks":
@@ -1005,6 +1106,8 @@ class NodeDaemon:
                             rids = p.get("return_ids")
                             if rids:
                                 slot.returns[p["task_id"]] = list(rids)
+                                slot.attempts[p["task_id"]] = p.get(
+                                    "attempt", 0)
                     elif (payload[0] == "reply"
                           and payload[1] in slot.gets):
                         purpose = slot.gets.pop(payload[1])
@@ -1077,6 +1180,10 @@ class NodeDaemon:
                     pids = {s.num: s.pid for s in self._slots.values()
                             if s.proc is not None and s.proc.poll() is None}
                 self._send_head(("pong", msg[1], pids))
+            elif kind == "ack":
+                # outbox high-water acknowledgment: the head processed
+                # (or deduped) every report up to this sequence number
+                self._outbox.ack(msg[1])
             elif kind == "exit":
                 break
             else:
@@ -1093,8 +1200,13 @@ class NodeDaemon:
     def _try_rejoin(self) -> bool:
         """Re-dial the head address until a (restarted) head accepts
         this node back. The rejoin hello reports the live workers —
-        numbers, pids, and which actor each dedicated worker hosts —
-        so the new head re-adopts them instead of spawning fresh."""
+        numbers, pids, which actor each dedicated worker hosts, and
+        every task still IN FLIGHT (task id -> return oids + attempt
+        token) — so the new head re-adopts them, re-attaches the live
+        leases to their waiting ObjectRefs, and resubmits only what no
+        surviving node claims. Work is never pre-killed here: whether
+        an in-flight lease is still wanted is the HEAD's call (lease
+        reconciliation), not this daemon's."""
         import time
 
         deadline = time.monotonic() + self._rejoin_timeout_s
@@ -1105,26 +1217,17 @@ class NodeDaemon:
             except Exception:  # conn refused / auth failure / reset
                 time.sleep(0.5)
                 continue
-            # plain workers still executing a PRE-crash task are
-            # killed, not reported: their owner died with the old head,
-            # so the in-flight work is orphaned, and the new head must
-            # not queue fresh tasks behind it (actors keep running —
-            # their state is the thing being saved)
-            with self._lock:
-                stale = [s for s in self._slots.values()
-                         if s.returns and s.actor_bin is None
-                         and s.proc is not None and s.proc.poll() is None]
-            for s in stale:
-                try:
-                    s.proc.kill()
-                    s.proc.wait(timeout=5.0)
-                except Exception:
-                    pass
             with self._lock:
                 workers = {
                     s.num: {"pid": s.pid,
                             "actor": (s.actor_bin.hex()
-                                      if s.actor_bin else None)}
+                                      if s.actor_bin else None),
+                            "inflight": {
+                                tid.hex(): {
+                                    "returns": [b.hex() for b in rbins],
+                                    "attempt": s.attempts.get(tid, 0),
+                                }
+                                for tid, rbins in s.returns.items()}}
                     for s in self._slots.values()
                     if s.proc is not None and s.proc.poll() is None}
             from ray_tpu._private.protocol import make_wire_hello
@@ -1145,7 +1248,13 @@ class NodeDaemon:
                 self._head = head
             # re-run the clock handshake: the new head computes a fresh
             # clock_offset for this link
-            self._send_head(("clock", time.time(), time.perf_counter()))
+            self._send_head_raw(("clock", time.time(),
+                                 time.perf_counter()))
+            # replay every unacked report: completions/pulls/logs that
+            # happened during the blackout reach the new head now; the
+            # head's per-node sequence dedup makes this exactly-once
+            # even when the old head never actually died (link flap)
+            self._replay_outbox()
             return True
         return False
 

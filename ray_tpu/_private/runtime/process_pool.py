@@ -103,7 +103,13 @@ class _InFlight:
 
     __slots__ = ("pending", "return_ids", "borrows", "started_at")
 
-    def __init__(self, pending: PendingTask, return_ids: List[ObjectID]):
+    def __init__(self, pending: Optional[PendingTask],
+                 return_ids: List[ObjectID]):
+        # pending=None marks a SYNTHETIC entry: a lease adopted from a
+        # rejoining daemon after head failover. The restarted head's
+        # scheduler/task_manager never saw the task, so completion
+        # handling for these stores results and frees the worker but
+        # must skip every scheduler-side notification.
         self.pending = pending
         self.return_ids = return_ids
         self.borrows: Set[ObjectID] = set()
@@ -558,6 +564,10 @@ class ProcessWorkerPool:
             args_blob=args_blob,
             num_returns=spec.num_returns,
             return_ids=[o.binary() for o in return_ids],
+            # attempt token: daemons echo it in rejoin reports so a head
+            # restarted mid-run can tell a live lease from a stale replay
+            # of an attempt it already resubmitted (failover exactly-once)
+            attempt=spec.attempt_number,
         )
         fault = self._chaos.poll("task", node=self.node_index,
                                  task=spec.name)
@@ -783,6 +793,11 @@ class ProcessWorkerPool:
         if nxt is not None:
             self._assign(h, *nxt)
 
+    def _lease_done(self, task_id: TaskID) -> None:
+        """Hook: a leased attempt reached a terminal state on this
+        pool. RemoteNodePool journals it for failover reconciliation;
+        local pools have nothing to reconcile."""
+
     def _take_inflight(self, h: _Handle, task_id: TaskID):
         """Claim a completion/error: pop the inflight entry AND the
         task index under the pool lock, so a concurrent
@@ -827,6 +842,12 @@ class ProcessWorkerPool:
         inf = self._take_inflight(h, task_id)
         if inf is None:
             return  # force-cancel/worker-failure claimed the task first
+        if inf.pending is None:
+            # adopted failover lease: resolve the refs, free the worker
+            self.store_result_entries(inf.return_ids, entries)
+            self._lease_done(task_id)
+            self._release_taken(h, inf)
+            return
         pending, spec = inf.pending, inf.pending.spec
         self.store_result_entries(inf.return_ids, entries)
         self._worker.task_manager.complete(spec.task_id)
@@ -861,6 +882,16 @@ class ProcessWorkerPool:
                 self._by_task.pop(task_id, None)
                 taken.append((h, task_id, entries, timing, inf))
         for h, task_id, entries, timing, inf in taken:
+            self._lease_done(task_id)
+            if inf.pending is None:
+                # adopted failover lease: store results only (no spec,
+                # no scheduler/task-manager state for this task here)
+                try:
+                    ready_oids.extend(
+                        self._store_entries(inf.return_ids, entries))
+                except Exception:
+                    logger.exception("adopted-lease completion failed")
+                continue
             spec = inf.pending.spec
             try:
                 ready_oids.extend(
@@ -894,6 +925,21 @@ class ProcessWorkerPool:
         inf = self._take_inflight(h, task_id)
         if inf is None:
             return  # force-cancel/worker-failure claimed the task first
+        if inf.pending is None:
+            # adopted failover lease: no spec survives the restart, so
+            # fail the refs terminally instead of consulting retry policy
+            try:
+                exc = cloudpickle.loads(exc_blob)
+            except Exception:
+                exc = RuntimeError(
+                    "worker error (exception undeserializable)")
+            exc._ray_tpu_traceback = tb
+            for oid in inf.return_ids:
+                self._worker.memory_store.put(oid, exc, is_exception=True)
+                self._worker.scheduler.notify_object_ready(oid)
+            self._lease_done(task_id)
+            self._release_taken(h, inf)
+            return
         pending, spec = inf.pending, inf.pending.spec
         try:
             exc = cloudpickle.loads(exc_blob)
@@ -952,6 +998,21 @@ class ProcessWorkerPool:
             # only the force-cancel TARGET gets the cancellation error,
             # innocent pipelined neighbors fail retriably
             for exec_id, inf in inflight:
+                if inf.pending is None:
+                    # adopted failover lease: the spec died with the old
+                    # head, so the refs fail terminally here
+                    err = rex.WorkerCrashedError(
+                        f"worker process {h.pid} died while running a "
+                        f"lease adopted across head failover: {cause}"
+                        + self._err_tail(h))
+                    for oid in inf.return_ids:
+                        self._worker.memory_store.put(
+                            oid, err, is_exception=True)
+                        self._worker.scheduler.notify_object_ready(oid)
+                    self._lease_done(exec_id)
+                    with self._lock:
+                        self._by_task.pop(exec_id, None)
+                    continue
                 spec = inf.pending.spec
                 if h.force_cancel_id == exec_id:
                     exc: BaseException = rex.TaskCancelledError(exec_id)

@@ -47,10 +47,10 @@ import cloudpickle
 
 from ray_tpu import exceptions as rex
 from ray_tpu._private.analysis import runtime_sanitizer
-from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.runtime.process_pool import (_DepError, _Handle,
-                                                   _RequeueDeps,
+                                                   _InFlight, _RequeueDeps,
                                                    ProcessWorkerPool,
                                                    RemotePlaceholder)
 from ray_tpu._private.runtime.worker_process import _PullValue
@@ -91,6 +91,20 @@ class RemoteNodePool(ProcessWorkerPool):
         self._conn = conn
         self._conn_lock = threading.Lock()
         self._conn_dead = False
+        # head->daemon messages that failed (or arrived while the link
+        # was down) wait here and flush in order on re-attach; an
+        # escalated node death discards them (their tasks retry through
+        # the normal inflight bookkeeping)
+        self._pending_sends: List[tuple] = []
+        # outbox bookkeeping (daemon->head exactly-once): highest
+        # sequence number processed, re-attach generation (stale
+        # link-loss callbacks and grace timers check it), and the
+        # failover observability counters metrics.py exports
+        self._seq_lock = threading.Lock()
+        self._last_seen_seq = 0
+        self._attach_gen = 0
+        self.outbox_depth = 0
+        self.outbox_replayed = 0
         self.node_id = node_id
         self._daemon_proc = daemon_proc
         self._hqueues: Dict[int, queue.Queue] = {}
@@ -114,11 +128,17 @@ class RemoteNodePool(ProcessWorkerPool):
                          ).start()
 
     def _send_daemon(self, msg: tuple) -> None:
-        try:
-            with self._conn_lock:
-                self._conn.send(msg)
-        except (OSError, ValueError):
-            pass  # demux EOF handles the failure
+        with self._conn_lock:
+            if not self._conn_dead:
+                try:
+                    self._conn.send(msg)
+                    return
+                except (OSError, ValueError):
+                    pass  # demux EOF handles the failure; buffer below
+            # a send() that raises never delivered a complete frame
+            # (the daemon drops truncated frames with the connection),
+            # so re-sending after re-attach cannot double-deliver
+            self._pending_sends.append(msg)
 
     def _next_req(self) -> int:
         with self._req_lock:
@@ -126,87 +146,152 @@ class RemoteNodePool(ProcessWorkerPool):
             return self._req_seq
 
     def _demux_loop(self) -> None:
+        conn = self._conn
+        with self._seq_lock:
+            gen = self._attach_gen
         while True:
             try:
-                msg = self._conn.recv()
+                msg = conn.recv()
             except (EOFError, OSError, TypeError, ValueError):
                 # TypeError/ValueError: conn closed under a blocked recv
-                self._on_daemon_lost()
+                self._on_daemon_lost(gen)
                 return
             runtime_sanitizer.check_wire("daemon_to_head", msg)
-            kind = msg[0]
-            if kind == "w":
-                num, wmsg = msg[1], msg[2]
-                with self._lock:
-                    h = self._by_num.get(num)
-                q = self._hqueues.get(num)
-                if h is not None and q is not None:
-                    q.put(wmsg)
-            elif kind == "worker_died":
-                q = self._hqueues.get(msg[1])
-                if q is not None:
-                    # msg may carry the worker's .err tail (the remote
-                    # crash traceback) — fold it into the cause so
-                    # WorkerCrashedError surfaces the real reason
-                    cause = f"exit code {msg[2]}"
-                    if len(msg) > 3 and msg[3]:
-                        cause += msg[3]
-                    q.put(("__died__", cause))
-            elif kind == "fetched":
-                slot = self._fetches.pop(msg[1], None)
-                if slot is not None:
-                    slot[1][:] = [msg[2], msg[3]]
-                    slot[0].set()
-            elif kind == "pong":
-                slot = self._pings.pop(msg[1], None)
-                if slot is not None:
-                    slot[1][:] = [msg[2]]
-                    slot[0].set()
-            elif kind == "log":
-                # appended capture lines shipped by the daemon's tailer
-                lm = getattr(self._worker, "log_monitor", None)
-                if lm is not None:
-                    lm.on_remote_lines(self, msg[1], msg[2])
-            elif kind in ("log_listed", "log_data"):
-                slot = self._logreqs.pop(msg[1], None)
-                if slot is not None:
-                    slot[1][:] = list(msg[2:])
-                    slot[0].set()
-            elif kind == "pulled":
-                # a staged (or localization) peer pull completed: this
-                # node now holds a COPY — register it as a secondary
-                # location so later leases can score/stage against it,
-                # and count the cross-node bytes moved
-                oid = ObjectID(msg[1])
-                self._worker.gcs.object_location_add_secondary(
-                    oid, self.node_index)
-                e = self._worker.memory_store.get_entry(oid)
-                if e is not None and e.size:
-                    self._worker.note_transfer("bytes_pulled", e.size)
-            elif kind == "clock":
-                # clock handshake sample sent right after the daemon's
-                # hello (and after every rejoin): maps daemon wall-clock
-                # timestamps onto the head's axis. Error ~ one-way link
-                # latency, far below task-span granularity.
-                self.clock_offset = time.time() - msg[1]
-            else:
-                # exhaustive dispatch: an unknown daemon tag means the
-                # wire protocol drifted (raylint pass 3 checks this
-                # statically; this guard catches version skew at runtime)
-                logger.error(
-                    "head: unknown daemon message tag %r from node %d "
-                    "(protocol drift?)", kind, self.node_index)
+            if msg[0] == "seq":
+                # outbox envelope: dedup by per-node sequence number
+                # (a replay after a transient flap re-delivers entries
+                # this head already processed), then ack the high-water
+                # mark so the daemon trims its buffer
+                _, seq, depth, is_replay, inner = msg
+                with self._seq_lock:
+                    duplicate = seq <= self._last_seen_seq
+                    if not duplicate:
+                        self._last_seen_seq = seq
+                    high_water = self._last_seen_seq
+                    self.outbox_depth = depth
+                    if is_replay:
+                        self.outbox_replayed += 1
+                self._send_daemon(("ack", high_water))
+                if duplicate:
+                    continue
+                runtime_sanitizer.check_wire("daemon_to_head", inner)
+                msg = inner
+            self._dispatch_daemon_msg(msg)
 
-    def _on_daemon_lost(self) -> None:
-        self._conn_dead = True
-        # unblock fetch/ping/log waiters
+    def _dispatch_daemon_msg(self, msg: tuple) -> None:
+        kind = msg[0]
+        if kind == "w":
+            num, wmsg = msg[1], msg[2]
+            with self._lock:
+                h = self._by_num.get(num)
+            q = self._hqueues.get(num)
+            if h is not None and q is not None:
+                q.put(wmsg)
+        elif kind == "worker_died":
+            q = self._hqueues.get(msg[1])
+            if q is not None:
+                # msg may carry the worker's .err tail (the remote
+                # crash traceback) — fold it into the cause so
+                # WorkerCrashedError surfaces the real reason
+                cause = f"exit code {msg[2]}"
+                if len(msg) > 3 and msg[3]:
+                    cause += msg[3]
+                q.put(("__died__", cause))
+        elif kind == "fetched":
+            slot = self._fetches.pop(msg[1], None)
+            if slot is not None:
+                slot[1][:] = [msg[2], msg[3]]
+                slot[0].set()
+        elif kind == "pong":
+            slot = self._pings.pop(msg[1], None)
+            if slot is not None:
+                slot[1][:] = [msg[2]]
+                slot[0].set()
+        elif kind == "log":
+            # appended capture lines shipped by the daemon's tailer
+            lm = getattr(self._worker, "log_monitor", None)
+            if lm is not None:
+                lm.on_remote_lines(self, msg[1], msg[2])
+        elif kind in ("log_listed", "log_data"):
+            slot = self._logreqs.pop(msg[1], None)
+            if slot is not None:
+                slot[1][:] = list(msg[2:])
+                slot[0].set()
+        elif kind == "pulled":
+            # a staged (or localization) peer pull completed: this
+            # node now holds a COPY — register it as a secondary
+            # location so later leases can score/stage against it,
+            # and count the cross-node bytes moved
+            oid = ObjectID(msg[1])
+            self._worker.gcs.object_location_add_secondary(
+                oid, self.node_index)
+            e = self._worker.memory_store.get_entry(oid)
+            if e is not None and e.size:
+                self._worker.note_transfer("bytes_pulled", e.size)
+        elif kind == "clock":
+            # clock handshake sample sent right after the daemon's
+            # hello (and after every rejoin): maps daemon wall-clock
+            # timestamps onto the head's axis. Error ~ one-way link
+            # latency, far below task-span granularity.
+            self.clock_offset = time.time() - msg[1]
+        else:
+            # exhaustive dispatch: an unknown daemon tag means the
+            # wire protocol drifted (raylint pass 3 checks this
+            # statically; this guard catches version skew at runtime)
+            logger.error(
+                "head: unknown daemon message tag %r from node %d "
+                "(protocol drift?)", kind, self.node_index)
+
+    def _on_daemon_lost(self, gen: Optional[int] = None) -> None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        with self._seq_lock:
+            if gen is not None and gen != self._attach_gen:
+                return  # a re-attach superseded this link already
+            self._conn_dead = True
+        # unblock fetch/ping/log waiters: their replies died with the
+        # link regardless of whether the node comes back
         for table in (self._fetches, self._pings, self._logreqs):
             for ev, _slot in list(table.values()):
                 ev.set()
             table.clear()
+        grace = GLOBAL_CONFIG.daemon_rejoin_grace_s
+        daemon_known_dead = (self._daemon_proc is not None
+                             and self._daemon_proc.poll() is not None)
+        if (grace > 0 and not daemon_known_dead and not self._shutdown
+                and not self._node_dead
+                and self._worker.gcs.mark_node_rejoining(self.node_id)):
+            # REJOINING grace window: keep worker handles and in-flight
+            # leases alive — a daemon that re-dials within the window
+            # re-attaches (outbox replay + send-buffer flush) and the
+            # blackout is invisible. A head-spawned daemon whose process
+            # already exited can never re-dial: skip straight to death.
+            logger.warning(
+                "node %s: daemon link lost; REJOINING grace %.1fs",
+                self.node_id.hex()[:16], grace)
+            threading.Thread(
+                target=self._grace_timer, args=(gen, grace), daemon=True,
+                name=f"ray_tpu_rejoin_grace_{self.node_index}").start()
+            return
+        self._fail_lost_daemon()
+
+    def _grace_timer(self, gen: Optional[int], grace: float) -> None:
+        time.sleep(grace)
+        with self._seq_lock:
+            if gen is not None and gen != self._attach_gen:
+                return  # the daemon re-attached in time
+            if not self._conn_dead:
+                return
+        logger.warning("node %s: rejoin grace expired; marking dead",
+                       self.node_id.hex()[:16])
+        self._fail_lost_daemon()
+
+    def _fail_lost_daemon(self) -> None:
         # snapshot: _queue_loop threads pop _hqueues as they die
         for q in list(self._hqueues.values()):
             q.put(("__died__", "daemon connection lost"))
+        with self._conn_lock:
+            self._pending_sends.clear()
         if not self._shutdown and not self._node_dead:
             logger.warning("node %s: daemon connection lost; marking dead",
                            self.node_id.hex()[:16])
@@ -216,6 +301,41 @@ class RemoteNodePool(ProcessWorkerPool):
             except Exception:
                 logger.exception("on_node_failure failed")
         self._unlink_dead_arena()
+
+    def reattach(self, conn) -> None:
+        """The daemon re-dialed after a transient link loss (the head
+        never died): swap in the fresh connection, flush the buffered
+        head->daemon sends in order, and restart the demux. The
+        daemon's outbox replay arrives next and the sequence dedup in
+        _demux_loop drops everything this head already processed."""
+        with self._seq_lock:
+            self._attach_gen += 1  # invalidates stale loss callbacks
+        with self._conn_lock:
+            old = self._conn
+            self._conn = conn
+            self._conn_dead = False
+            pending, self._pending_sends = self._pending_sends, []
+        try:
+            old.close()
+        except Exception:
+            pass
+        for msg in pending:
+            self._send_daemon(msg)
+        self._start_transport()
+        self._worker.gcs.mark_node_rejoined(self.node_id)
+        logger.warning("node %s: daemon re-attached (%d buffered sends "
+                       "flushed)", self.node_id.hex()[:16], len(pending))
+
+    def sever_link(self) -> None:
+        """Chaos (``head`` site, kind ``flap``): close the daemon link
+        without telling anyone. Both sides see EOF — the daemon enters
+        its rejoin loop, this pool enters the REJOINING grace window,
+        and the reunion exercises outbox replay + dedup end to end."""
+        with self._conn_lock:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
 
     def _unlink_dead_arena(self) -> None:
         """A SIGKILLed daemon can't unlink its own arena; reap it once
@@ -263,11 +383,14 @@ class RemoteNodePool(ProcessWorkerPool):
         return h
 
     def adopt_worker(self, num: int, pid: Optional[int],
-                     is_actor: bool) -> _Handle:
+                     is_actor: bool, busy: bool = False) -> _Handle:
         """Attach a handle to a worker process that ALREADY RUNS on the
         rejoining daemon (head-restart re-adoption): same plumbing as
         _spawn minus the spawn message — the process is alive, so it is
-        ready by construction."""
+        ready by construction. ``busy`` marks workers the daemon
+        reported with leases still executing: they are adopted into the
+        handle set but NOT parked idle (adopt_inflight re-attaches
+        their leases next; completion releases them normally)."""
         with self._lock:
             self._worker_seq = max(self._worker_seq, num)
         h = _Handle(num)
@@ -284,8 +407,69 @@ class RemoteNodePool(ProcessWorkerPool):
         if not is_actor:
             with self._lock:
                 self._handles.append(h)
-            self._mark_idle(h)
+            if not busy:
+                self._mark_idle(h)
         return h
+
+    def adopt_inflight(self, h: _Handle, task_id_bin: bytes,
+                       return_bins: List[bytes], attempt: int) -> None:
+        """Re-attach a lease a rejoining daemon reported still running:
+        a SYNTHETIC inflight entry (pending=None, see _InFlight) keyed
+        under the ORIGINAL return oids, so the daemon's eventual
+        done/err (possibly arriving via outbox replay) resolves the
+        exact refs a resumed ray:// client is blocked on."""
+        task_id = TaskID(task_id_bin)
+        inf = _InFlight(None, [ObjectID(b) for b in return_bins])
+        with self._lock:
+            h.inflight[task_id] = inf
+            self._by_task[task_id] = h
+
+    # -- failover lease journal ----------------------------------------
+    def _journal_lease(self, spec, payload: dict) -> None:
+        """Mirror this dispatch into the GCS WAL so a restarted head
+        can resubmit it if no surviving daemon claims it. Args are
+        re-pickled from the RAW spec (the payload's args_blob embeds
+        arena markers that die with this head); tasks whose args can't
+        be pickled journal a record without a resubmit body — their
+        adoption bookkeeping still works, resubmission fails the refs."""
+        import cloudpickle as _cp
+
+        try:
+            args_blob = _cp.dumps((spec.args, spec.kwargs))
+        except Exception:
+            args_blob = None
+        self._worker.gcs.journal_lease(spec.task_id.binary(), {
+            "name": spec.name,
+            "fn_blob": payload.get("fn_blob"),
+            "args_blob": args_blob,
+            "num_returns": spec.num_returns,
+            "returns": list(payload["return_ids"]),
+            "resources": dict(spec.resources or {}),
+            "attempt": spec.attempt_number,
+            "max_retries": spec.max_retries,
+            "node_index": self.node_index,
+        })
+
+    def _assign(self, h: _Handle, pending, payload: dict) -> None:
+        if self._worker.gcs.journal_enabled:
+            self._journal_lease(pending.spec, payload)
+        super()._assign(h, pending, payload)
+
+    def _assign_many(self, h: _Handle, items: List[tuple]) -> None:
+        if self._worker.gcs.journal_enabled:
+            for pending, payload in items:
+                self._journal_lease(pending.spec, payload)
+        super()._assign_many(h, items)
+
+    def _finish_task(self, pending, exec_task_id: TaskID, retry) -> None:
+        # terminal for THIS remote attempt (a retry re-journals at its
+        # own dispatch): drop it from the reconciliation set so a later
+        # failover can never resubmit an attempt that already resolved
+        self._lease_done(exec_task_id)
+        super()._finish_task(pending, exec_task_id, retry)
+
+    def _lease_done(self, task_id: TaskID) -> None:
+        self._worker.gcs.journal_lease_done(task_id.binary())
 
     def _queue_loop(self, h: _Handle, q: queue.Queue) -> None:
         """Per-worker message pump — the remote analog of the local

@@ -367,6 +367,9 @@ class Worker:
         # flips when a REMOTE node pool registers: only then can a
         # dying ref have a remote copy worth a per-ref GCS lookup
         self._has_remote_nodes = False
+        # one-shot guard for the post-failover lease reconciler (kicked
+        # by the first daemon rejoin after a journaled head restart)
+        self._failover_reconciler_started = False
         self.reference_counter = ReferenceCounter(self._on_object_out_of_scope)
         self.task_manager = TaskManager(self)
 
@@ -1427,6 +1430,11 @@ class Worker:
 
         if self._head_server is None:
             self._head_server = HeadServer()
+        # a severed daemon link (chaos flap, transient network drop)
+        # comes back as an UNSOLICITED rejoin hello — without the hook
+        # the accept loop would silently close it and the node would
+        # burn its whole REJOINING grace window dialing a deaf head
+        self._head_server.on_unsolicited = self._on_unsolicited_hello
         token = self._head_server.issue_token()
         slot_ev, slot = self._head_server.expect(token)
         # the daemon (and the workers it spawns) never owns the head's
@@ -1546,9 +1554,31 @@ class Worker:
         elif kind == "join" and len(hello) >= 5:
             self.adopt_remote_node(conn, hello)
         elif kind == "rejoin" and len(hello) >= 7:
-            self.readopt_remote_node(conn, hello)
+            pool = self._find_rejoin_pool(hello[3])
+            if pool is not None:
+                # link flap, not a head restart: THIS head already runs
+                # the node — its pool (leases, refs, worker handles) is
+                # intact, so only the transport swaps. The daemon's
+                # outbox replay follows and the sequence dedup drops
+                # everything this head already processed.
+                pool.reattach(conn)
+            else:
+                self.readopt_remote_node(conn, hello)
         else:
             conn.close()
+
+    def _find_rejoin_pool(self, arena_name):
+        """Match a rejoin hello to a pool this head already owns (by
+        arena name — unique per daemon). A true head restart has no
+        pools to match and falls through to full re-adoption."""
+        if not arena_name:
+            return None
+        for pool in list(self._node_pools.values()):
+            if getattr(pool, "is_remote", False) \
+                    and getattr(pool, "_arena_name", None) == arena_name \
+                    and not pool._node_dead:
+                return pool
+        return None
 
     def adopt_remote_node(self, conn, hello: tuple):
         """A node daemon started out-of-band (`ray_tpu start
@@ -1611,11 +1641,37 @@ class Worker:
         self._node_pools[row] = pool
         self._has_remote_nodes = True
         adopted_actors = 0
+        adopted_leases = 0
         for num, winfo in sorted(workers.items()):
             actor_hex = winfo.get("actor")
+            inflight = winfo.get("inflight") or {}
             h = pool.adopt_worker(int(num), winfo.get("pid"),
-                                  is_actor=actor_hex is not None)
+                                  is_actor=actor_hex is not None,
+                                  busy=bool(inflight))
             if actor_hex is None:
+                # lease reconciliation: tasks this worker still RUNS
+                # re-attach as synthetic inflight entries under their
+                # ORIGINAL return oids, so the done/err (live or outbox
+                # replay) resolves the refs a resumed client is blocked
+                # on. Attempt skew (journal ahead of the report) means
+                # the old head re-dispatched the task elsewhere before
+                # dying: leave the record for its real claimant and let
+                # this worker's stale result drop (no inflight entry).
+                for tid_hex, rep in inflight.items():
+                    tid_bin = bytes.fromhex(tid_hex)
+                    rep_attempt = int(rep.get("attempt", 0))
+                    lease = self.gcs.claim_lease(tid_bin)
+                    if lease is not None \
+                            and int(lease.get("attempt", 0)) != rep_attempt:
+                        self.gcs.journal_lease(tid_bin, lease)
+                        continue
+                    returns = [bytes.fromhex(x)
+                               for x in rep.get("returns", [])]
+                    for rbin in returns:
+                        self.reference_counter.add_owned_object(
+                            ObjectID(rbin))
+                    pool.adopt_inflight(h, tid_bin, returns, rep_attempt)
+                    adopted_leases += 1
                 continue
             actor_id = ActorID(bytes.fromhex(actor_hex))
             entry = self.gcs.orphaned_actor(actor_id)
@@ -1634,9 +1690,10 @@ class Worker:
                 logger.exception("actor %s re-adoption failed",
                                  actor_id.hex()[:16])
                 pool.release_actor_worker(h, kill=True)
-        # the daemon killed plain workers that were mid-task for the
-        # DEAD owner; respawn up to the node's worker count or the row
-        # would advertise CPUs with no one to run on them
+        # plain workers survive with their leases now (the daemon no
+        # longer kills mid-task workers at rejoin); still top up to the
+        # node's declared worker count so the row never advertises CPUs
+        # with no process to run on
         target = int(info.get("num_workers") or max(int(num_cpus), 1))
         plain = sum(1 for w in workers.values() if not w.get("actor"))
         for _ in range(max(0, target - plain)):
@@ -1648,10 +1705,103 @@ class Worker:
             kind="remote", pool=pool)
         self.gcs.start_health_checks()
         self.scheduler.poke()
-        logger.info("re-adopted node %s (row %d): %d workers, "
-                    "%d actors", node_id.hex()[:16], row, len(workers),
-                    adopted_actors)
+        logger.info("re-adopted node %s (row %d): %d workers, %d actors, "
+                    "%d in-flight leases", node_id.hex()[:16], row,
+                    len(workers), adopted_actors, adopted_leases)
+        self._start_failover_reconciler()
         return entry
+
+    # ------------------------------------------------------------------
+    # head-failover lease reconciliation (the resubmission half;
+    # readopt_remote_node above re-attaches the leases survivors claim)
+    # ------------------------------------------------------------------
+    def _start_failover_reconciler(self) -> None:
+        """One-shot, kicked by the first post-restart rejoin: wait for
+        the rest of the pre-crash daemons (count-based — rejoined
+        daemons carry fresh NodeIDs, so identity can't match) and then
+        resubmit every journaled lease no survivor claimed."""
+        if self._failover_reconciler_started:
+            return
+        self._failover_reconciler_started = True
+        if not self.gcs.journal_enabled:
+            return
+        threading.Thread(target=self._reconcile_failover_leases,
+                         args=(self.gcs.replayed_node_count,),
+                         daemon=True,
+                         name="ray_tpu_failover_reconcile").start()
+
+    def _reconcile_failover_leases(self, expected: int) -> None:
+        deadline = time.monotonic() + GLOBAL_CONFIG.daemon_rejoin_grace_s
+        while time.monotonic() < deadline:
+            alive = sum(1 for e in self.gcs.node_table()
+                        if e.kind == "remote" and e.state == "ALIVE")
+            if alive >= expected:
+                break
+            time.sleep(0.2)
+        unclaimed = self.gcs.pending_leases()
+        resub = 0
+        for tid_bin, rec in unclaimed.items():
+            if self.gcs.claim_lease(tid_bin) is None:
+                continue  # a late rejoin claimed it under us
+            self.gcs.journal_lease_done(tid_bin)  # consumed either way
+            if self._resubmit_lease(tid_bin, rec):
+                resub += 1
+        if unclaimed:
+            logger.warning(
+                "head failover: %d journaled leases unclaimed by "
+                "rejoining nodes; %d resubmitted", len(unclaimed), resub)
+
+    def _resubmit_lease(self, tid_bin: bytes, rec: dict) -> bool:
+        """Rebuild a TaskSpec from a journaled lease record and submit
+        it under the ORIGINAL return oids with a bumped attempt token —
+        a stale replay of the dead attempt finds no inflight entry and
+        drops, so the task's side effects run at most once post-restart.
+        Records without a resubmittable body fail their refs instead of
+        hanging the owner's get()."""
+        import cloudpickle
+
+        returns = [ObjectID(b) for b in rec.get("returns", [])]
+        name = rec.get("name") or "failover_resubmit"
+        fn_blob, args_blob = rec.get("fn_blob"), rec.get("args_blob")
+        try:
+            if fn_blob is None or args_blob is None:
+                raise ValueError("lease record has no resubmit body")
+            func = cloudpickle.loads(fn_blob)
+            args, kwargs = cloudpickle.loads(args_blob)
+        except Exception as e:
+            exc = rex.WorkerCrashedError(
+                f"task {name} was in flight on a node that did not "
+                f"rejoin after head failover, and its journal record "
+                f"cannot be resubmitted ({e})")
+            for oid in returns:
+                self.reference_counter.add_owned_object(oid)
+                self.memory_store.put(oid, exc, is_exception=True)
+                self.scheduler.notify_object_ready(oid)
+            return False
+        spec = TaskSpec(
+            task_id=self.next_task_id(),
+            name=name,
+            func=func,
+            func_descriptor=name,
+            args=args,
+            kwargs=kwargs,
+            num_returns=int(rec.get("num_returns", len(returns) or 1)),
+            resources=dict(rec.get("resources") or {"CPU": 1}),
+            max_retries=int(rec.get("max_retries", 0)),
+            serialized_func=fn_blob,
+            attempt_number=int(rec.get("attempt", 0)) + 1,
+        )
+        spec._retry_return_ids = returns  # type: ignore[attr-defined]
+        for oid in returns:
+            self.reference_counter.add_owned_object(
+                oid, lineage_task=spec.task_id)
+        self.task_manager.add_pending(spec, [])
+        self.scheduler.submit(PendingTask(spec=spec, deps=[],
+                                          execute=_noop_exec))
+        logger.warning("head failover: resubmitting %s (lease %s, "
+                       "attempt %d)", name, tid_bin.hex()[:16],
+                       spec.attempt_number)
+        return True
 
     def on_node_failure(self, node_id: NodeID, reason: str = "") -> None:
         """Node death: mark dead, stop scheduling to it, fail/retry its

@@ -107,15 +107,29 @@ def list_nodes() -> List[Dict[str, Any]]:
 
     w = worker_mod.get_worker()
     now = time.monotonic()
-    return [
-        {"node_id": e.node_id.hex(), "index": e.index, "state": e.state,
-         "kind": e.kind, "resources": dict(e.resources),
-         # seconds since the GCS last recorded a heartbeat; compare
-         # against config node_heartbeat_timeout_s to spot nodes the
-         # staleness monitor is about to declare dead
-         "heartbeat_age_s": round(now - e.last_heartbeat, 3)}
-        for e in w.gcs.node_table()
-    ]
+    rows = []
+    for e in w.gcs.node_table():
+        row = {"node_id": e.node_id.hex(), "index": e.index,
+               "state": e.state,
+               "kind": e.kind, "resources": dict(e.resources),
+               # seconds since the GCS last recorded a heartbeat; compare
+               # against config node_heartbeat_timeout_s to spot nodes the
+               # staleness monitor is about to declare dead
+               "heartbeat_age_s": round(now - e.last_heartbeat, 3)}
+        if e.state == "REJOINING" and e.rejoining_since is not None:
+            # how long the daemon link has been down; escalates to DEAD
+            # once it passes config daemon_rejoin_grace_s
+            row["rejoining_for_s"] = round(now - e.rejoining_since, 3)
+        pool = e.pool
+        if pool is not None and getattr(pool, "is_remote", False):
+            # outbox telemetry (same numbers as the metrics endpoint's
+            # ray_tpu_daemon_outbox_* families, but per node): depth is
+            # the daemon's unacked backlog, replayed counts envelopes
+            # re-delivered after rejoins
+            row["outbox_depth"] = getattr(pool, "outbox_depth", 0)
+            row["outbox_replayed"] = getattr(pool, "outbox_replayed", 0)
+        rows.append(row)
+    return rows
 
 
 @_client_dispatch
