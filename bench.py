@@ -576,6 +576,42 @@ def main() -> int:
         OUT["task_event_overhead"] = teo or None
         _emit()
 
+    # --- trace plane: distributed tracing overhead ---------------------
+    # A/B of the e2e harness with the trace plane disabled
+    # (RAY_TPU_TRACE_SAMPLE_RATE=0 — no context stamping at submit, no
+    # span records, no payload "trace" key). The e2e numbers above ran
+    # with tracing ON (sample rate 1.0 is the default); the claim under
+    # test is that full-rate span recording stays within ~10% of the
+    # untraced path on the BATCHED lanes, where per-task bookkeeping is
+    # most exposed.
+    if section("trace_overhead", 25):
+        tro = {}
+        for label, mode, n, batched in (
+                ("thread_batched", "thread", n_thread, True),
+                ("process_batched", "process", n_proc, True)):
+            try:
+                on = e2e.get(label)
+                if on is None:
+                    on = round(_e2e_subprocess(n, mode, batched)
+                               ["tasks_per_sec"], 1)
+                off = round(_e2e_subprocess(
+                    n, mode, batched,
+                    extra_env={"RAY_TPU_TRACE_SAMPLE_RATE": "0"})
+                    ["tasks_per_sec"], 1)
+                tro[label] = {
+                    "trace_on_tasks_per_sec": on,
+                    "trace_off_tasks_per_sec": off,
+                    "overhead_pct": round(100.0 * (off - on) / off, 1),
+                }
+                print(f"  trace overhead[{label}]: {on:.0f} tasks/s "
+                      f"with tracing vs {off:.0f} without "
+                      f"({tro[label]['overhead_pct']}%)",
+                      file=sys.stderr)
+            except Exception:
+                traceback.print_exc()
+        OUT["trace_overhead"] = tro or None
+        _emit()
+
     # --- locality-aware scheduling: cross-node byte A/B ----------------
     # 2-remote-node cluster, large objects produced on one node, a
     # consumer fanout free to run on either. ON: the scheduler's

@@ -36,7 +36,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "cancel", "kill", "get_actor", "ObjectRef", "ActorHandle", "method",
     "available_resources", "cluster_resources", "nodes", "timeline",
-    "snapshot_cluster", "restore_cluster",
+    "trace", "snapshot_cluster", "restore_cluster",
     "get_runtime_context", "chaos", "__version__",
 ]
 
@@ -70,6 +70,32 @@ def timeline(filename: Optional[str] = None):
     from ray_tpu.util.state import task_timeline
 
     events = task_timeline()
+    if filename is not None:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(events, f)
+        return filename
+    return events
+
+
+def trace(trace_id: Optional[str] = None, filename: Optional[str] = None):
+    """Perfetto/Chrome-trace events for one distributed trace; writes
+    JSON to filename when given, else returns the event list.
+
+    With ``trace_id=None`` the most recently active trace is exported.
+    Sourced from the trace plane: per logical span, a submit→resolve
+    span on the driver lane, per-attempt scheduler-decision spans, and
+    exec spans on the owning (node, worker) lanes — all on the head's
+    clock axis, with flow arrows connecting dispatch→exec and parent
+    exec→child exec across lanes. Retried attempts land under the same
+    logical span. Works over ray:// (renders head-side)."""
+    from ray_tpu.util.state import get_trace, list_traces
+
+    if trace_id is None:
+        rows = list_traces()
+        trace_id = rows[0]["trace_id"] if rows else None
+    events = get_trace(trace_id) if trace_id is not None else []
     if filename is not None:
         import json
 

@@ -264,6 +264,42 @@ def _cmd_timeline(args) -> int:
     return 0
 
 
+def _cmd_trace(args) -> int:
+    """List traces or export one as Perfetto JSON (the distributed
+    sibling of `timeline`: one trace's causal tree — driver, scheduler
+    and per-node exec lanes with parent/child flow arrows)."""
+    if not args.address:
+        print("trace needs --address ray://host:port?key=... "
+              "(printed by `python -m ray_tpu start --head`)",
+              file=sys.stderr)
+        return 2
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=args.address)
+    try:
+        if not args.trace_id and not args.latest:
+            rows = state.list_traces()
+            if not rows:
+                print("no traces recorded (is trace_sample_rate 0?)")
+                return 0
+            print(f"{'trace_id':18} {'root':28} {'spans':>6} "
+                  f"{'live':>5} {'failed':>7}")
+            for r in rows:
+                print(f"{r['trace_id'][:16]:18} "
+                      f"{(r['root'] or '?')[:28]:28} {r['spans']:>6} "
+                      f"{r['live_spans']:>5} {r['failed']:>7}")
+            return 0
+        path = ray_tpu.trace(args.trace_id or None, args.output)
+        with open(path) as f:
+            n = len(json.load(f))
+        print(f"wrote {path} ({n} events) — open in "
+              f"chrome://tracing or https://ui.perfetto.dev")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
 def _cmd_summary(args) -> int:
     """Summarize a timeline JSON produced by ray_tpu.timeline()."""
     with open(args.trace) as f:
@@ -405,6 +441,18 @@ def main(argv=None) -> int:
     p.add_argument("--address", default="",
                    help="ray://host:port?key=... of a running head")
     p.set_defaults(fn=_cmd_timeline)
+
+    p = sub.add_parser("trace", help="list distributed traces or "
+                       "export one (Perfetto JSON)")
+    p.add_argument("trace_id", nargs="?", default="",
+                   help="trace id (hex, prefix ok); omit to list")
+    p.add_argument("--latest", action="store_true",
+                   help="export the most recently active trace")
+    p.add_argument("-o", "--output", default="trace_tree.json",
+                   help="output path (default: trace_tree.json)")
+    p.add_argument("--address", default="",
+                   help="ray://host:port?key=... of a running head")
+    p.set_defaults(fn=_cmd_trace)
 
     p = sub.add_parser("summary", help="summarize a timeline trace")
     p.add_argument("trace", help="JSON from ray_tpu.timeline(file)")

@@ -60,6 +60,7 @@ _STATE_VERBS = frozenset({
     "list_tasks", "list_actors", "list_objects", "list_nodes",
     "list_placement_groups", "summarize_tasks", "list_data_streams",
     "list_faults", "list_logs", "get_log", "task_timeline",
+    "list_traces", "get_trace",
 })
 
 
@@ -225,7 +226,8 @@ class ClientServer:
             runtime_env=d.get("runtime_env"),
             generator=d.get("generator", False),
         )
-        refs = self._worker.submit_task(spec)
+        with self._traced("submit"):
+            refs = self._worker.submit_task(spec)
         for r in refs:
             self._pin(s, r.object_id())
         return [r.object_id().binary() for r in refs]
@@ -241,7 +243,8 @@ class ClientServer:
         cls = cloudpickle.loads(cls_blob)
         opts = cloudpickle.loads(opts_blob)
         args, kwargs = cloudpickle.loads(args_blob)
-        handle = ActorClass(cls, opts).remote(*args, **kwargs)
+        with self._traced("create_actor"):
+            handle = ActorClass(cls, opts).remote(*args, **kwargs)
         return (handle.actor_id.binary(), cls.__name__)
 
     def _op_actor_call(self, s, actor_bin: bytes, method: str,
@@ -249,7 +252,9 @@ class ClientServer:
         from ray_tpu.actor import ActorHandle
         handle = ActorHandle(ActorID(actor_bin))
         args, kwargs = cloudpickle.loads(args_blob)
-        refs = handle._submit_method(method, args, kwargs, num_returns)
+        with self._traced(f"actor_call:{method}"):
+            refs = handle._submit_method(method, args, kwargs,
+                                         num_returns)
         refs = refs if isinstance(refs, list) else [refs]
         for r in refs:
             self._pin(s, r.object_id())
@@ -279,6 +284,16 @@ class ClientServer:
         for b in oid_bins:
             self._pin(s, ObjectID(b))
         return True
+
+    def _traced(self, op: str):
+        """Root a client span around a submission-bearing op: the
+        head-side submission it triggers becomes the span's child via
+        the ambient parent (per-request threads, so no cross-talk)."""
+        tp = getattr(self._worker, "trace_plane", None)
+        if tp is None:
+            from contextlib import nullcontext
+            return nullcontext()
+        return tp.client_span(op)
 
     def _op_state(self, s, verb: str, *args) -> Any:
         import ray_tpu

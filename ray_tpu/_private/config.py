@@ -291,6 +291,20 @@ _d("task_events_max", int, 16384,
    "eviction drops finished records before failed ones so failures "
    "outlive successes; 0 disables task event recording entirely (the "
    "bench A/B baseline)")
+_d("trace_sample_rate", float, 1.0,
+   "fraction of root submissions stamped with a sampled TraceContext "
+   "(children always inherit the root's decision); 0 disables the trace "
+   "plane entirely — no context stamping, no span records (the bench "
+   "A/B baseline)")
+_d("traces_max", int, 512,
+   "bounded number of distinct traces kept head-side by the trace "
+   "aggregator (oldest trace evicted wholesale); 0 disables the trace "
+   "plane like trace_sample_rate=0")
+_d("trace_log_markers", bool, False,
+   "emit a '== trace <id> span <id> task <id> ==' marker line into the "
+   "worker's capture file at exec start of each sampled task, so "
+   "get_log output correlates with spans; off by default to keep "
+   "capture files byte-stable for log-plane consumers")
 
 # -- testing / fault injection --------------------------------------------
 _d("testing_inject_task_failure_prob", float, 0.0,

@@ -55,7 +55,14 @@ class EventBuffer:
         Open starts are keyed by (task_id, attempt) — a retry of the
         same task id on another node must not overwrite (or adopt) its
         first attempt's start entry — and the attempt number is emitted
-        in ``args`` so trace consumers can tell attempts apart."""
+        in ``args`` so trace consumers can tell attempts apart.
+
+        A "finished" that misses its exact (task_id, attempt) key falls
+        back to the oldest open start for the same task id: producers
+        that lose attempt context when a richer plane is disabled
+        mid-run (events recorded with attempt, completion without)
+        still pair into a span instead of degrading into two dangling
+        instants."""
         events = self.snapshot()
         spans: List[Dict[str, Any]] = []
         open_start: Dict[Tuple[str, int], tuple] = {}
@@ -63,20 +70,26 @@ class EventBuffer:
             key = (tid, attempt)
             if event == "started":
                 open_start[key] = (ts, name, node)
-            elif event == "finished" and key in open_start:
-                t0, name0, node0 = open_start.pop(key)
-                spans.append({
-                    "name": name0, "ph": "X", "pid": 0,
-                    "tid": max(node0, node, 0),
-                    "ts": t0 * 1e6, "dur": (ts - t0) * 1e6,
-                    "args": {"task_id": tid, "attempt": attempt},
-                })
-            else:
-                spans.append({
-                    "name": f"{name}:{event}", "ph": "i", "pid": 0,
-                    "tid": max(node, 0), "ts": ts * 1e6, "s": "t",
-                    "args": {"task_id": tid, "attempt": attempt},
-                })
+                continue
+            if event == "finished":
+                if key not in open_start:
+                    # pair by task id alone (insertion order = oldest)
+                    key = next((k for k in open_start if k[0] == tid),
+                               key)
+                if key in open_start:
+                    t0, name0, node0 = open_start.pop(key)
+                    spans.append({
+                        "name": name0, "ph": "X", "pid": 0,
+                        "tid": max(node0, node, 0),
+                        "ts": t0 * 1e6, "dur": (ts - t0) * 1e6,
+                        "args": {"task_id": tid, "attempt": key[1]},
+                    })
+                    continue
+            spans.append({
+                "name": f"{name}:{event}", "ph": "i", "pid": 0,
+                "tid": max(node, 0), "ts": ts * 1e6, "s": "t",
+                "args": {"task_id": tid, "attempt": attempt},
+            })
         # still-running (or crashed-mid-run) tasks: emit their start as
         # an instant so the trace records them instead of dropping them
         for (tid, attempt), (t0, name0, node0) in open_start.items():
@@ -92,3 +105,16 @@ class EventBuffer:
         with open(filename, "w") as f:
             json.dump(self.timeline(), f)
         return filename
+
+
+def plane_disabled_timeline(worker) -> List[Dict[str, Any]]:
+    """The ONE degradation path for every disabled observability plane:
+    ``state.task_timeline()`` with task events off and
+    ``state.get_trace()`` with the trace plane off both fall back to
+    the driver-local EventBuffer here, so consumers get the same
+    best-effort chrome-trace shape regardless of which plane was
+    disabled."""
+    events = getattr(worker, "events", None)
+    if events is None:
+        return []
+    return events.timeline()

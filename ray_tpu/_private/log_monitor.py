@@ -138,9 +138,18 @@ class LogMonitor:
 
     # ------------------------------------------------------------------
     def _emit(self, fname: str, lines, node_index: int, pool) -> None:
-        name = self._attribute(_wid_of(fname), pool)
+        name, task, trace = self._attribute(_wid_of(fname), pool)
         stream = sys.stderr if fname.endswith(".err") else sys.stdout
-        prefix = f"({name}, wid={_wid_of(fname)}, node={node_index})"
+        prefix = f"({name}, wid={_wid_of(fname)}, node={node_index}"
+        # task/trace fields are best-effort attribution like the name:
+        # they identify what is leased on that worker NOW, which for a
+        # fast task may already be the next one. Short prefixes keep
+        # the line greppable against state/trace output.
+        if task:
+            prefix += f", task={task}"
+        if trace:
+            prefix += f", trace={trace}"
+        prefix += ")"
         if self._color:
             c = _COLORS[node_index % len(_COLORS)]
             prefix = f"\x1b[{c}m{prefix}\x1b[0m"
@@ -184,24 +193,32 @@ class LogMonitor:
                 pass
 
     # ------------------------------------------------------------------
-    def _attribute(self, wid: str, pool) -> str:
-        """Task/actor name currently leased on the worker whose id
-        prefix is ``wid`` — best-effort: 'worker' when nothing (or
-        nothing anymore) is running there."""
+    def _attribute(self, wid: str, pool) -> Tuple[str, str, str]:
+        """(name, task_id prefix, trace_id prefix) for whatever is
+        currently leased on the worker whose id prefix is ``wid`` —
+        best-effort: ('worker', '', '') when nothing (or nothing
+        anymore) is running there. The trace field only appears for
+        sampled tasks, so grep 'trace=<id>' lines line up 1:1 with
+        ``ray_tpu.trace()`` span output."""
         h = self._find_handle(wid, pool)
         if h is None:
-            return "worker"
+            return "worker", "", ""
         rt = h.actor_rt
         if rt is not None:
-            return (getattr(rt, "name", None)
+            name = (getattr(rt, "name", None)
                     or getattr(getattr(rt, "cls", None), "__name__", None)
                     or "actor")
+            return name, "", ""
         try:
             for inf in h.inflight.values():
-                return inf.pending.spec.name
-        except RuntimeError:
+                spec = inf.pending.spec
+                tctx = getattr(spec, "trace_ctx", None)
+                return (spec.name, spec.task_id.hex()[:8],
+                        tctx[0][:8] if tctx is not None and tctx[3]
+                        else "")
+        except (RuntimeError, AttributeError):
             pass  # dict mutated mid-iteration: attribution is advisory
-        return "worker"
+        return "worker", "", ""
 
     def _find_handle(self, wid: str, pool):
         pools = [pool] if pool is not None else self._pools()

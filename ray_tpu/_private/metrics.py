@@ -240,6 +240,28 @@ def _render_core(worker) -> List[str]:
     lines.extend(task_events.render_prometheus(
         getattr(worker, "task_events", None)))
 
+    # trace plane: span/trace accounting (zero-valued when the plane is
+    # disabled so scrapers see a stable family set either way)
+    tp = getattr(worker, "trace_plane", None)
+    tsum = tp.summary() if tp is not None else {}
+    emit("ray_tpu_trace_spans_recorded_total", "counter",
+         "sampled spans recorded by the trace aggregator",
+         tsum.get("spans_total", 0))
+    emit("ray_tpu_trace_spans_dropped_total", "counter",
+         "spans dropped by the per-trace span cap",
+         tsum.get("spans_dropped", 0))
+    emit("ray_tpu_trace_evicted_total", "counter",
+         "whole traces evicted from the bounded trace ring "
+         "(oldest-first, see config traces_max)",
+         tsum.get("traces_evicted", 0))
+    emit("ray_tpu_traces_resident", "gauge",
+         "distinct traces currently resident in the trace aggregator",
+         tsum.get("traces_resident", 0))
+    emit("ray_tpu_trace_client_ops_total", "counter",
+         "ray:// client operations recorded as trace spans "
+         "(submit / create_actor / actor_call)",
+         tsum.get("client_ops_total", 0))
+
     # head failover + daemon outbox plane: did this head replay a
     # journal at boot, and how much daemon-side traffic is buffered /
     # has been replayed across link drops

@@ -33,6 +33,7 @@ from ray_tpu import exceptions as rex
 from ray_tpu._private import log_plane, spawn_env
 from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private import trace_plane
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.runtime.worker_process import _ShmValue, fn_id_of
@@ -569,6 +570,14 @@ class ProcessWorkerPool:
             # of an attempt it already resubmitted (failover exactly-once)
             attempt=spec.attempt_number,
         )
+        tctx = getattr(spec, "trace_ctx", None)
+        if tctx is not None and tctx[3]:
+            # trace context rides the payload dict (no new wire tag);
+            # the worker restores it around exec so nested submissions
+            # inherit parentage
+            payload["trace"] = tctx
+            if GLOBAL_CONFIG.trace_log_markers:
+                payload["trace_mark"] = True
         fault = self._chaos.poll("task", node=self.node_index,
                                  task=spec.name)
         if fault is not None:
@@ -764,7 +773,9 @@ class ProcessWorkerPool:
                     self._mark_idle(h)
             elif kind == "done":
                 if h.actor_rt is not None:
-                    h.actor_rt._on_remote_done(TaskID(msg[1]), msg[2])
+                    h.actor_rt._on_remote_done(
+                        TaskID(msg[1]), msg[2],
+                        msg[3] if len(msg) > 3 else None)
                 else:
                     self._on_done(h, TaskID(msg[1]), msg[2],
                                   msg[3] if len(msg) > 3 else None)
@@ -856,6 +867,11 @@ class ProcessWorkerPool:
             te.record_finished_batch(
                 ((task_id, timing, h.worker_id.hex(), self.node_index),),
                 offset=self.clock_offset)
+        tp = self._worker.trace_plane
+        if tp is not None:
+            tp.record_finished_batch(
+                ((task_id, timing, h.worker_id.hex(), self.node_index),),
+                offset=self.clock_offset)
         self._finish_task(pending, task_id, None)
         self._release_taken(h, inf)
 
@@ -873,6 +889,7 @@ class ProcessWorkerPool:
         taken: List[tuple] = []
         events = self._worker.events
         te = self._worker.task_events
+        tp = self._worker.trace_plane
         te_rows: List[tuple] = []
         with self._lock:
             for h, task_id, entries, timing in dones:
@@ -899,7 +916,7 @@ class ProcessWorkerPool:
                 self._worker.task_manager.complete(spec.task_id)
                 events.record(task_id, spec.name, "finished",
                               self.node_index)
-                if te is not None:
+                if te is not None or tp is not None:
                     te_rows.append((task_id, timing, h.worker_id.hex(),
                                     self.node_index))
                 deps = _top_level_deps(spec.args, spec.kwargs)
@@ -912,7 +929,12 @@ class ProcessWorkerPool:
             finished.append((task_id, inf.pending.node_index,
                              spec.resources))
         if te_rows:
-            te.record_finished_batch(te_rows, offset=self.clock_offset)
+            if te is not None:
+                te.record_finished_batch(te_rows,
+                                         offset=self.clock_offset)
+            if tp is not None:
+                tp.record_finished_batch(te_rows,
+                                         offset=self.clock_offset)
         self._worker.scheduler.notify_batch(ready_oids, finished)
         for h, task_id, _entries, _timing, inf in taken:
             for oid in inf.borrows:
@@ -951,6 +973,11 @@ class ProcessWorkerPool:
             # attach the execution window before the failure hooks
             # finalize (retry or terminal) this attempt's record
             te.record_exec(task_id, timing, node=self.node_index,
+                           worker=h.worker_id.hex(),
+                           offset=self.clock_offset)
+        tp = self._worker.trace_plane
+        if tp is not None:
+            tp.record_exec(task_id, timing, node=self.node_index,
                            worker=h.worker_id.hex(),
                            offset=self.clock_offset)
         retry = self._worker._handle_task_failure(spec, inf.return_ids, exc)
@@ -1171,7 +1198,10 @@ class ProcessWorkerPool:
             placement_group_bundle_index=d.get("pg_bundle_index", -1),
             placement_group_capture_child_tasks=d.get("pg_capture", False),
         )
-        refs = self._worker.submit_task(spec)
+        # the submitting task's trace context rides the RPC blob: the
+        # nested submission becomes its child via the ambient parent
+        with trace_plane.parent_scope(d.get("trace")):
+            refs = self._worker.submit_task(spec)
         borrows = self._task_borrows(h)
         for r in refs:
             self._worker.reference_counter.add_borrower(
@@ -1187,10 +1217,13 @@ class ProcessWorkerPool:
         from ray_tpu._private.ids import ActorID
         from ray_tpu.actor import ActorHandle
 
-        aid_bin, method, args, kwargs, num_returns = cloudpickle.loads(blob)
+        t = cloudpickle.loads(blob)
+        aid_bin, method, args, kwargs, num_returns = t[:5]
+        tctx = t[5] if len(t) > 5 else None
         handle = ActorHandle(ActorID(aid_bin))
-        out = getattr(handle, method).options(
-            num_returns=num_returns).remote(*args, **kwargs)
+        with trace_plane.parent_scope(tctx):
+            out = getattr(handle, method).options(
+                num_returns=num_returns).remote(*args, **kwargs)
         refs = out if isinstance(out, list) else [out]
         borrows = self._task_borrows(h)
         for r in refs:

@@ -134,7 +134,7 @@ class ProcessWorkerContext:
     def submit_task(self, spec) -> list:
         from ray_tpu._private.object_ref import ObjectRef
 
-        blob = _dump_spec(spec)
+        blob = _dump_spec(spec, trace=self._runner.current_trace)
         return_bins = self._runner.rpc("submit", (blob,))
         return [ObjectRef(ObjectID(b), None) for b in return_bins]
 
@@ -151,7 +151,8 @@ class ProcessWorkerContext:
         from ray_tpu._private.object_ref import ObjectRef
 
         blob = cloudpickle.dumps(
-            (actor_id.binary(), method_name, args, kwargs, num_returns),
+            (actor_id.binary(), method_name, args, kwargs, num_returns,
+             self._runner.current_trace),
             protocol=5)
         ret_bins = self._runner.rpc("actor_call", (blob,))
         refs = [ObjectRef(ObjectID(b), None) for b in ret_bins]
@@ -175,8 +176,11 @@ class ProcessWorkerContext:
             "futures/await on refs are driver-side APIs")
 
 
-def _dump_spec(spec) -> bytes:
-    """Ship a TaskSpec for owner-side admission (func by value)."""
+def _dump_spec(spec, trace=None) -> bytes:
+    """Ship a TaskSpec for owner-side admission (func by value).
+    ``trace`` is the SUBMITTING task's trace context: the owner restores
+    it as the ambient parent around admission so the nested task's own
+    context is stamped as its child."""
     d = dict(
         name=spec.name,
         func_blob=spec.serialized_func or cloudpickle.dumps(spec.func),
@@ -187,6 +191,8 @@ def _dump_spec(spec) -> bytes:
         max_retries=spec.max_retries,
         retry_exceptions=spec.retry_exceptions,
     )
+    if trace is not None:
+        d["trace"] = trace
     if spec.placement_group_id is not None:
         d["pg_id"] = spec.placement_group_id.binary()
         d["pg_bundle_index"] = spec.placement_group_bundle_index
@@ -203,6 +209,10 @@ class _WorkerRunner:
         self.fn_cache: Dict[bytes, Any] = {}
         self.actor_instance: Any = None  # set by actor_create (dedicated)
         self.current_task_id: Optional[TaskID] = None
+        # the running task's TraceContext (from the payload's "trace"
+        # key), re-shipped with nested submissions / actor calls so
+        # parentage crosses the process boundary
+        self.current_trace = None
         self.put_counter = 0
         self.cancelled: set = set()  # task_id binaries
         self._rpc_seq = 0
@@ -422,8 +432,17 @@ class _WorkerRunner:
         # blocking get (see _run_nested)
         prev_task_id = self.current_task_id
         prev_put_counter = self.put_counter
+        prev_trace = self.current_trace
         self.current_task_id = task_id
+        self.current_trace = payload.get("trace")
         self.put_counter = 0
+        if self.current_trace is not None and payload.get("trace_mark"):
+            # correlation marker for the log plane (trace_log_markers
+            # knob): lands in this worker's capture file so get_log
+            # output lines up with the trace's exec spans
+            print(f"== trace {self.current_trace[0]} span "
+                  f"{self.current_trace[1]} task {task_id.hex()} ==",
+                  flush=True)
         pg_token = None
         if payload.get("pg") is not None:
             # placement-group capture context shipped from the owner
@@ -517,6 +536,7 @@ class _WorkerRunner:
                 _current_pg.reset(pg_token)
             self.cancelled.discard(task_id.binary())
             self.current_task_id = prev_task_id
+            self.current_trace = prev_trace
             self.put_counter = prev_put_counter
 
     def _resolve(self, v: Any) -> Any:

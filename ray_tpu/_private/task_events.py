@@ -55,6 +55,8 @@ END = 10
 STATE = 11      # "LIVE" | "FINISHED" | "FAILED"
 RETRIED = 12    # failed attempt that was retried (not terminal)
 STAGED = 13     # dispatch-time arg staging kicked off (None = no staging)
+TCTX = 14       # trace plane context 4-tuple (trace_id, span_id,
+                # parent_span_id, sampled) | None when unsampled
 
 _LIVE, _FINISHED, _FAILED = "LIVE", "FINISHED", "FAILED"
 
@@ -130,15 +132,17 @@ class TaskEventAggregator:
     def _new_rec(self, task_id: Any, name: str, attempt: int,
                  now: float) -> list:
         return [task_id, name, attempt, -1, None, None,
-                now, None, None, None, None, _LIVE, False, None]
+                now, None, None, None, None, _LIVE, False, None, None]
 
     def record_submitted_batch(self, specs: Iterable[Any]) -> None:
         now = time.time()
         with self._lock:
             live = self._live
             for s in specs:
-                live[s.task_id] = self._new_rec(
+                rec = self._new_rec(
                     s.task_id, s.name, s.attempt_number, now)
+                rec[TCTX] = getattr(s, "trace_ctx", None)
+                live[s.task_id] = rec
             if len(live) > self._live_cap:
                 self._trim_live_locked()
 
@@ -265,8 +269,12 @@ class TaskEventAggregator:
                     self.failed_by_type.get(error_type, 0) + 1
                 self._finalize_locked(rec, _FAILED)
             self.retries_total += 1
-            self._live[spec.task_id] = self._new_rec(
+            new_rec = self._new_rec(
                 spec.task_id, spec.name, spec.attempt_number, now)
+            # retry mutates the spec in place, so the new attempt
+            # carries the SAME logical trace context as the failed one
+            new_rec[TCTX] = getattr(spec, "trace_ctx", None)
+            self._live[spec.task_id] = new_rec
 
     # ------------------------------------------------------------------
     # internals (caller holds self._lock)
@@ -360,6 +368,9 @@ class TaskEventAggregator:
             _pid_meta(pid)
             name = rec[NAME]
             args = {"task_id": _hex(rec[TID]), "attempt": rec[ATTEMPT]}
+            tctx = rec[TCTX] if len(rec) > TCTX else None
+            if tctx is not None:
+                args["trace_id"] = tctx[0]
             sub = rec[SUBMITTED]
             rdy = rec[READY] if rec[READY] is not None else sub
             dsp = rec[DISPATCHED]
@@ -442,8 +453,12 @@ def _durations(rec: list):
 
 def _detail(rec: list) -> Dict[str, Any]:
     q, dep, ex = _durations(rec)
+    tctx = rec[TCTX] if len(rec) > TCTX else None
     return {
         "attempt": rec[ATTEMPT],
+        "trace_id": tctx[0] if tctx is not None else None,
+        "span_id": tctx[1] if tctx is not None else None,
+        "parent_span_id": tctx[2] if tctx is not None else None,
         "worker_id": (None if rec[WORKER] is None
                       else str(rec[WORKER])),
         "error_type": rec[ERROR],

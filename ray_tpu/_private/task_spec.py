@@ -96,6 +96,13 @@ class TaskSpec:
     # part of scheduling_class(): tasks differing only in arg objects
     # must still share a class/lease.
     arg_sizes: Any = None
+    # the task's own TraceContext 4-tuple (trace_id, span_id,
+    # parent_span_id, sampled), stamped at submit by the trace plane and
+    # carried to workers so nested submissions inherit parentage. The
+    # logical span survives retries because retry mutates this spec in
+    # place. NOT part of scheduling_class() for the same reason as
+    # arg_sizes.
+    trace_ctx: Any = None
 
     def return_ids(self) -> List[ObjectID]:
         memo = self._rid_memo

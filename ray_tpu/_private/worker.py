@@ -35,6 +35,7 @@ from ray_tpu._private.ref_counting import ReferenceCounter
 from ray_tpu._private.scheduler.base import PendingTask, SchedulerBase
 from ray_tpu._private.scheduler.local import EventScheduler, NodeState
 from ray_tpu._private.task_spec import TaskSpec, TaskType
+from ray_tpu._private import trace_plane
 
 logger = logging.getLogger(__name__)
 
@@ -496,6 +497,14 @@ class Worker:
                             if GLOBAL_CONFIG.task_events_max != 0
                             else None)
         self.scheduler.task_events = self.task_events
+        # trace plane: causal spans keyed by trace_id (None when
+        # trace_sample_rate=0 or traces_max=0 — specs are never stamped
+        # and every producer hook is a None check)
+        from ray_tpu._private.trace_plane import TraceAggregator
+        self.trace_plane = (TraceAggregator()
+                            if (GLOBAL_CONFIG.trace_sample_rate > 0
+                                and GLOBAL_CONFIG.traces_max != 0)
+                            else None)
         # locality column input: the scheduler reads copy locations
         # straight off the GCS object directory (primary first)
         self.scheduler.locations_of = self.gcs.object_locations
@@ -950,6 +959,11 @@ class Worker:
         self.task_manager.add_pending(spec, deps)
         self.events.record(spec.task_id, spec.name, "submitted",
                            attempt=spec.attempt_number)
+        # trace stamping runs BEFORE the task-event record so the
+        # event plane's detail rows can carry the spec's trace context
+        if (self.trace_plane is not None
+                and spec.task_type == TaskType.NORMAL_TASK):
+            self.trace_plane.on_submit(spec)
         if (self.task_events is not None
                 and spec.task_type == TaskType.NORMAL_TASK):
             self.task_events.record_submitted(spec)
@@ -996,6 +1010,11 @@ class Worker:
         self.task_manager.add_pending_batch(specs)
         self.events.record_batch(((s.task_id, s.name) for s in specs),
                                  "submitted")
+        # trace stamping BEFORE the task-event records (detail rows
+        # carry the trace context stamped here)
+        if self.trace_plane is not None:
+            self.trace_plane.on_submit_batch(
+                s for s in specs if s.task_type == TaskType.NORMAL_TASK)
         if self.task_events is not None:
             self.task_events.record_submitted_batch(
                 s for s in specs if s.task_type == TaskType.NORMAL_TASK)
@@ -1140,6 +1159,9 @@ class Worker:
             if self.task_events is not None:
                 self.task_events.record_staged(pending.spec.task_id,
                                                pending.node_index)
+            if self.trace_plane is not None:
+                self.trace_plane.record_staged(pending.spec.task_id,
+                                               pending.node_index)
 
     def _dispatch(self, pending: PendingTask) -> None:
         self._chaos_tick()
@@ -1149,6 +1171,9 @@ class Worker:
         te = self.task_events
         if te is not None:
             te.record_dispatched_batch(
+                ((pending.spec.task_id, pending.node_index),))
+        if self.trace_plane is not None:
+            self.trace_plane.record_dispatched_batch(
                 ((pending.spec.task_id, pending.node_index),))
         boot = getattr(pending.spec, "_actor_boot", None)
         pool = self.pool_for_node(pending.node_index)
@@ -1177,6 +1202,7 @@ class Worker:
         local: List[tuple] = []
         fast: List[PendingTask] = []
         te = self.task_events
+        tp = self.trace_plane
         te_rows: List[tuple] = []
         record = self.events.record
         for pending in pendings:
@@ -1188,7 +1214,7 @@ class Worker:
             elif pool is not None and not pool.is_remote:
                 record(spec.task_id, spec.name, "dispatched",
                        pending.node_index)
-                if te is not None:
+                if te is not None or tp is not None:
                     te_rows.append((spec.task_id, pending.node_index))
                 groups.setdefault(pool, []).append(pending)
             elif pool is None:
@@ -1210,16 +1236,19 @@ class Worker:
                 else:
                     record(spec.task_id, spec.name, "dispatched",
                            pending.node_index)
-                    if te is not None:
+                    if te is not None or tp is not None:
                         te_rows.append((spec.task_id,
                                         pending.node_index))
                     local.append((self._execute_task, (pending,)))
             else:
                 self._dispatch(pending)
-        if te is not None and (te_rows or fast):
-            te.record_dispatched_batch(
-                te_rows + [(p.spec.task_id, p.node_index)
-                           for p in fast])
+        if te_rows or fast:
+            all_rows = te_rows + [(p.spec.task_id, p.node_index)
+                                  for p in fast]
+            if te is not None:
+                te.record_dispatched_batch(all_rows)
+            if tp is not None:
+                tp.record_dispatched_batch(all_rows)
         if fast:
             self.events.record_batch(
                 ((p.spec.task_id, p.spec.name) for p in fast),
@@ -1256,6 +1285,8 @@ class Worker:
         done: List[tuple] = []
         te = self.task_events
         te_done: List[tuple] = []
+        tp = self.trace_plane
+        tp_done: List[tuple] = []
         wkey = threading.get_ident()
         try:
             while True:
@@ -1303,7 +1334,10 @@ class Worker:
                         try:
                             self._maybe_inject_failure()
                             t0 = time.time()
-                            result = spec.func(*spec.args)
+                            with trace_plane.parent_scope(
+                                    spec.trace_ctx if tp is not None
+                                    else None):
+                                result = spec.func(*spec.args)
                             t1 = time.time()
                         except BaseException as e:  # noqa: BLE001
                             flag = self._claim_task_completion(exec_id)
@@ -1334,6 +1368,12 @@ class Worker:
                                     te_done.append(
                                         (exec_id, (t0, t1), wkey,
                                          pending.node_index))
+                                if (tp is not None
+                                        and spec.trace_ctx is not None
+                                        and spec.trace_ctx[3]):
+                                    tp_done.append(
+                                        (exec_id, (t0, t1), wkey,
+                                         pending.node_index))
                 finally:
                     with rlock:
                         running.pop(exec_id, None)
@@ -1355,6 +1395,9 @@ class Worker:
                 if len(te_done) >= 256:
                     te.record_finished_batch(te_done)
                     te_done = []
+                if len(tp_done) >= 256:
+                    tp.record_finished_batch(tp_done)
+                    tp_done = []
         finally:
             ctx.task_id = prev_task
             ctx.put_counter = prev_put
@@ -1362,6 +1405,8 @@ class Worker:
                 complete(done, has_ref)
             if te_done:
                 te.record_finished_batch(te_done)
+            if tp_done:
+                tp.record_finished_batch(tp_done)
             self.placement_groups.poke()
 
     def _run_pool_batch(self, pool, batch: List[PendingTask]) -> None:
@@ -1939,7 +1984,10 @@ class Worker:
             try:
                 self._maybe_inject_failure()
                 t0 = time.time()
-                result = spec.func(*args, **kwargs)
+                with trace_plane.parent_scope(
+                        spec.trace_ctx if self.trace_plane is not None
+                        else None):
+                    result = spec.func(*args, **kwargs)
                 t1 = time.time()
             except BaseException as e:  # noqa: BLE001
                 flag = self._claim_task_completion(exec_task_id)
@@ -1978,6 +2026,10 @@ class Worker:
                 # no-op for records _store_returns already failed
                 # (num_returns mismatch -> _store_error finalized them)
                 self.task_events.record_finished_batch(
+                    ((exec_task_id, (t0, t1), threading.get_ident(),
+                      pending.node_index),))
+            if self.trace_plane is not None:
+                self.trace_plane.record_finished_batch(
                     ((exec_task_id, (t0, t1), threading.get_ident(),
                       pending.node_index),))
         finally:
@@ -2145,6 +2197,11 @@ class Worker:
                 # attempt id opens its own record
                 self.task_events.record_retry(
                     old_id, _task_error_type(exc), spec)
+            if self.trace_plane is not None:
+                # same logical span (spec.trace_ctx is untouched by the
+                # in-place retry): the attempts link under one span
+                self.trace_plane.record_retry(
+                    old_id, _task_error_type(exc), spec)
             unresolved = [d for d in deps if not self.memory_store.contains(d)]
             return PendingTask(spec=spec, deps=unresolved,
                                execute=_noop_exec)
@@ -2173,6 +2230,9 @@ class Worker:
             self.task_events.record_failed(
                 spec.task_id, _task_error_type(exc), name=spec.name,
                 attempt=spec.attempt_number)
+        if self.trace_plane is not None:
+            self.trace_plane.record_failed(spec.task_id,
+                                           _task_error_type(exc))
         for oid in return_ids:
             self.memory_store.put(oid, exc, is_exception=True)
             self.scheduler.notify_object_ready(oid)

@@ -29,6 +29,7 @@ import functools
 import inspect
 import queue
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -37,6 +38,7 @@ from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.task_spec import TaskSpec, TaskType, resources_to_vector
+from ray_tpu._private import trace_plane
 from ray_tpu.remote_function import _DEFAULT_OPTIONS, _build_resources
 
 def _effective_max_restarts(opts: dict) -> int:
@@ -74,16 +76,17 @@ class ActorState(enum.Enum):
 
 class _Call:
     __slots__ = ("method_name", "args", "kwargs", "return_ids", "num_returns",
-                 "task_id")
+                 "task_id", "trace_ctx")
 
     def __init__(self, method_name, args, kwargs, return_ids, num_returns,
-                 task_id):
+                 task_id, trace_ctx=None):
         self.method_name = method_name
         self.args = args
         self.kwargs = kwargs
         self.return_ids = return_ids
         self.num_returns = num_returns
         self.task_id = task_id
+        self.trace_ctx = trace_ctx
 
 
 class _ActorRuntime:
@@ -303,6 +306,23 @@ class _ActorRuntime:
             from ray_tpu.util.placement_group import _current_pg
             _current_pg.reset(token)
 
+    def _trace_done(self, call: _Call, timing, offset: float = 0.0,
+                    worker_key=None) -> None:
+        tp = getattr(self.worker, "trace_plane", None)
+        if tp is None or call.trace_ctx is None:
+            return
+        tp.record_finished_batch(
+            ((call.task_id, timing,
+              worker_key if worker_key is not None
+              else threading.get_ident(),
+              self._current_node_index),), offset=offset)
+
+    def _trace_failed(self, call: _Call, exc: BaseException) -> None:
+        tp = getattr(self.worker, "trace_plane", None)
+        if tp is None or call.trace_ctx is None:
+            return
+        tp.record_failed(call.task_id, type(exc).__name__)
+
     def _execute_call(self, call: _Call):
         method = getattr(self.instance, call.method_name)
         pg_token = self._capture_pg_token()
@@ -311,12 +331,17 @@ class _ActorRuntime:
             args, kwargs, dep_err = self._resolve(call.args, call.kwargs)
             if dep_err is not None:
                 raise dep_err
-            result = method(*args, **kwargs)
+            t0 = time.time()
+            with trace_plane.parent_scope(call.trace_ctx):
+                result = method(*args, **kwargs)
             if inspect.isgenerator(result):
                 result = list(result)
+            t1 = time.time()
             self._store(call, result)
+            self._trace_done(call, (t0, t1))
         except BaseException as e:  # noqa: BLE001
             self._store_error(call, e)
+            self._trace_failed(call, e)
         finally:
             self._env_restore(env_saved)
             self._reset_pg_token(pg_token)
@@ -330,12 +355,17 @@ class _ActorRuntime:
             args, kwargs, dep_err = self._resolve(call.args, call.kwargs)
             if dep_err is not None:
                 raise dep_err
-            result = method(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = await result
+            t0 = time.time()
+            with trace_plane.parent_scope(call.trace_ctx):
+                result = method(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = await result
+            t1 = time.time()
             self._store(call, result)
+            self._trace_done(call, (t0, t1))
         except BaseException as e:  # noqa: BLE001
             self._store_error(call, e)
+            self._trace_failed(call, e)
         finally:
             self._env_restore(env_saved)
             self._reset_pg_token(pg_token)
@@ -524,8 +554,8 @@ class _ProcessActorRuntime(_ActorRuntime):
     def _on_worker_ready(self, h):
         pass  # readiness observed by polling h.ready in _create_remote
 
-    def _on_remote_done(self, task_id, entries):
-        self._round_result = ("done", entries)
+    def _on_remote_done(self, task_id, entries, timing=None):
+        self._round_result = ("done", entries, timing)
         self._round_done.set()
 
     def _on_remote_err(self, task_id, blob, tb):
@@ -714,22 +744,31 @@ class _ProcessActorRuntime(_ActorRuntime):
                 self._store_error(call, rex.ActorUnavailableError(
                     f"actor worker unavailable for {call.method_name}"))
                 return
+            extra = dict(method=call.method_name)
+            if call.trace_ctx is not None and call.trace_ctx[3]:
+                # same payload-dict carriage as normal task leases
+                extra["trace"] = call.trace_ctx
             try:
                 payload, borrows = self._build_payload(
                     h, call.task_id, call.return_ids, call.args, call.kwargs,
-                    dict(method=call.method_name))
+                    extra)
             except Exception as e:
                 self._store_error(call, e)
                 return
             res = self._remote_round("actor_call", payload)
             if res[0] == "done":
                 self._pool.store_result_entries(call.return_ids, res[1])
+                self._trace_done(call,
+                                 res[2] if len(res) > 2 else None,
+                                 offset=self._pool.clock_offset,
+                                 worker_key=h.worker_id.hex())
             elif res[0] == "err":
                 try:
                     exc = cloudpickle.loads(res[1])
                 except Exception:
                     exc = RuntimeError("actor call failed (undecodable)")
                 self._store_error(call, exc)
+                self._trace_failed(call, exc)
             elif attempt < max_task_retries:
                 # worker died mid-call (restart driven by
                 # _on_process_died): retry on the restarted instance
@@ -911,6 +950,14 @@ class ActorHandle:
             worker.reference_counter.add_owned_object(oid)
         call = _Call(method_name, args, kwargs, return_ids, num_returns,
                      task_id)
+        tp = getattr(worker, "trace_plane", None)
+        if tp is not None:
+            # child of the ambient parent: a driver call roots a new
+            # trace, a call from inside a traced task/client op joins it
+            call.trace_ctx = tp.make_context()
+            tp.on_actor_call(call,
+                             f"{self._class_name}.{method_name}",
+                             rt._current_node_index)
         rt.submit(call)
         refs = [ObjectRef(oid, worker.worker_id) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs

@@ -98,6 +98,7 @@ td.st-finished::before { background: var(--good); }
 <div class="panel"><h2>Task summary</h2><div id="tasks"></div></div>
 <div class="panel"><h2>Recent tasks (dep-wait &middot; queue &middot; exec)</h2>
 <div id="taskdetail"></div></div>
+<div class="panel"><h2>Traces</h2><div id="traces"></div></div>
 <div class="panel"><h2>Actors</h2><div id="actors"></div></div>
 <div class="panel"><h2>Data streams</h2><div id="streams"></div></div>
 <div class="panel"><h2>Logs</h2><div id="logfiles" class="sub"></div>
@@ -109,6 +110,7 @@ td.st-finished::before { background: var(--good); }
 <a href="/api/data_streams">streams</a>
 <a href="/api/task_events">task_events</a>
 <a href="/api/timeline">timeline</a>
+<a href="/api/traces">traces</a>
 <a href="/api/logs">logs</a>
 <a href="/api/jobs">jobs</a><a href="/metrics">metrics</a></div>
 <script>
@@ -264,10 +266,11 @@ async function viewLog(f) {
 
 async function refresh() {
   try {
-    const [s, actors, taskEvents] = await Promise.all([
+    const [s, actors, taskEvents, traces] = await Promise.all([
       fetch("/api/summary").then(r => r.json()),
       fetch("/api/actors").then(r => r.json()),
       fetch("/api/task_events").then(r => r.json()).catch(() => []),
+      fetch("/api/traces").then(r => r.json()).catch(() => []),
     ]);
     refreshLogs().catch(() => {});
     const nodes = s.nodes || [];
@@ -318,6 +321,29 @@ async function refresh() {
     document.getElementById("tasks").innerHTML = rows(
       Object.entries(t).map(([state, count]) => ({state, count})),
       ["state", "count"]);
+    // trace rows link to the Perfetto export for that trace id; the
+    // export link carries only the (hex, validated-by-slice) trace id
+    document.getElementById("traces").innerHTML = rows(
+      (traces || []).slice(0, 25).map(tr => ({
+        trace: (tr.trace_id || "").slice(0, 16), root: tr.root || "",
+        spans: tr.spans, live: tr.live_spans,
+        failed: tr.failed || 0,
+        duration: tr.first_ts && tr.last_ts ?
+          fmtS(tr.last_ts - tr.first_ts) : "–",
+        export: "", // filled below via DOM links
+      })), ["trace", "root", "spans", "live", "failed", "duration",
+            "export"]);
+    // attach export links with DOM nodes (ids are escaped by esc()
+    // already; the href is built from encodeURIComponent)
+    document.querySelectorAll("#traces tbody tr").forEach((el, i) => {
+      const tr = (traces || [])[i];
+      if (!tr) return;
+      const a = document.createElement("a");
+      a.href = "/api/trace?trace_id=" +
+        encodeURIComponent(tr.trace_id || "");
+      a.textContent = "perfetto json";
+      el.lastElementChild.replaceChildren(a);
+    });
     document.getElementById("actors").innerHTML = rows(actors.slice(0, 50).map(a => ({
       actor: (a.actor_id || "").slice(0, 12), name: a.name || "",
       state: a.state || "", node: a.node_index ?? "",
@@ -359,6 +385,10 @@ class Dashboard:
             # per-transition timestamps (the task-detail table source)
             "/api/task_events": lambda: state.list_tasks(detail=True),
             "/api/timeline": lambda: state.task_timeline(),
+            # trace plane: resident distributed traces, most recently
+            # active first (the Traces panel source); empty when the
+            # plane is disabled
+            "/api/traces": lambda: state.list_traces(),
             "/api/actors": lambda: state.list_actors(),
             "/api/objects": lambda: state.list_objects(),
             "/api/nodes": lambda: state.list_nodes(),
@@ -397,8 +427,16 @@ class Dashboard:
             return {"filename": filename, "node_id": node_id,
                     "lines": text.split("\n")}
 
+        def trace_export(query) -> list:
+            """/api/trace?trace_id=... — one trace's Perfetto events
+            (id prefix match; save the response and open it in
+            ui.perfetto.dev)."""
+            trace_id = (query.get("trace_id") or [""])[0]
+            return state.get_trace(trace_id)
+
         query_routes = {
             "/api/log_file": log_file,
+            "/api/trace": trace_export,
         }
 
         class Handler(http.server.BaseHTTPRequestHandler):

@@ -231,11 +231,44 @@ def task_timeline() -> List[Dict[str, Any]]:
     worker) with exec spans, all on the head's clock axis. Falls back to
     the driver-local EventBuffer when task events are disabled
     (``task_events_max=0``)."""
+    from ray_tpu._private import events
+
     w = worker_mod.get_worker()
     te = getattr(w, "task_events", None)
     if te is not None:
         return te.timeline()
-    return w.events.timeline()
+    return events.plane_disabled_timeline(w)
+
+
+@_client_dispatch
+def list_traces() -> List[Dict[str, Any]]:
+    """Resident traces from the trace plane, most recently active
+    first: {trace_id, root, spans, live_spans, failed, first_ts,
+    last_ts}. Empty when the plane is disabled
+    (``trace_sample_rate=0`` or ``traces_max=0``)."""
+    w = worker_mod.get_worker()
+    tp = getattr(w, "trace_plane", None)
+    if tp is None:
+        return []
+    return tp.list_traces()
+
+
+@_client_dispatch
+def get_trace(trace_id: str) -> List[Dict[str, Any]]:
+    """Perfetto/Chrome-trace events for ONE trace (id prefix match
+    allowed): the driver lane holds each logical span submit→resolve,
+    the scheduler lane the per-attempt decision windows, per-(node,
+    worker) lanes the exec windows on the head's clock axis, with flow
+    arrows connecting dispatch→exec and parent exec→child exec across
+    lanes. Falls back to the same driver-local EventBuffer degradation
+    path as ``task_timeline`` when the plane is disabled."""
+    from ray_tpu._private import events
+
+    w = worker_mod.get_worker()
+    tp = getattr(w, "trace_plane", None)
+    if tp is None:
+        return events.plane_disabled_timeline(w)
+    return tp.trace(trace_id)
 
 
 @_client_dispatch

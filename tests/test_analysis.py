@@ -318,6 +318,38 @@ class TestWireProtocol:
         assert any("op-undefined" in k and "nope" in k for k in keys), keys
         assert any("op-unsent" in k and "extra" in k for k in keys), keys
 
+    def test_trace_extension_drift_caught(self, tmp_path):
+        """Trace-plane satellite: the TraceContext rides EXISTING
+        envelopes (a payload-dict key, a pickled-blob element), so the
+        real channel table needed no new tags — this fixture injects
+        the violation that WOULD appear if a trace field were instead
+        added as new framed tuples on one side only, and asserts the
+        pass catches both failure modes (arity drift on an extended
+        tag; a trace tag sent with no recv branch at all)."""
+        _write(tmp_path, "sender.py", """
+            def go(conn):
+                conn.send(("trace_span", "tid", "sid", "psid"))
+                conn.send(("trace_mark", "tid"))
+            """)
+        _write(tmp_path, "recv.py", """
+            def handle(msg):
+                kind = msg[0]
+                if kind == "trace_span":
+                    # expects a 5th element the sender never ships
+                    return msg[4]
+                return None
+            """)
+        channels = [ChannelSpec(name="trace",
+                                sends=[SendSpec("sender.py", "send")],
+                                recvs=[RecvSpec("recv.py", "handle")])]
+        keys = _keys(wire_protocol.analyze(str(tmp_path), _mk,
+                                           channels=channels,
+                                           op_channels=[]))
+        assert any(k.startswith("wire:arity:") and "trace_span" in k
+                   for k in keys), keys
+        assert any(k.startswith("wire:sent-unhandled:")
+                   and "trace_mark" in k for k in keys), keys
+
     def test_real_channels_have_no_drift(self):
         # satellite (f): remote_pool<->node_daemon (and the other three
         # channels) must agree on tags and arities; the daemon/demux

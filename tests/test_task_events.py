@@ -488,10 +488,12 @@ def test_task_event_overhead_within_10_percent():
         finally:
             os.environ.pop("RAY_TPU_TASK_EVENTS_MAX", None)
 
-    off = run(events_on=False)
-    # shared-VM noise between trials can exceed the margin under test;
-    # best-of-3 per side plus one re-measure keeps the guard honest
-    for attempt in range(2):
+    # shared-VM noise between trials can exceed the margin under test,
+    # and load drifts over a long suite run — so each retry re-measures
+    # a fresh off/on PAIR under the same machine conditions; a real
+    # systematic >10% overhead fails every pair
+    for attempt in range(3):
+        off = run(events_on=False)
         on = run(events_on=True)
         if on >= 0.9 * off:
             break
