@@ -509,6 +509,37 @@ def main() -> int:
         OUT["e2e_budget_us"] = dict(budgets)
         _emit()
 
+    # --- control ring: shm control-plane A/B ---------------------------
+    # A/B of the process-batched e2e lane with the shm control ring
+    # disabled (RAY_TPU_CONTROL_RING=0 — per-task framed pipe messages,
+    # the pre-ring transport). The e2e numbers above ran with the ring
+    # ON (the default); the claim under test is that batched lease
+    # envelopes over the ring are never slower than the pipe path
+    # (tests/test_benchmarks.py guards the recorded artifact).
+    if section("e2e_ring", 25):
+        er = {}
+        try:
+            on = e2e.get("process_batched")
+            if on is None:
+                on = round(_e2e_subprocess(n_proc, "process", True)
+                           ["tasks_per_sec"], 1)
+            off = round(_e2e_subprocess(
+                n_proc, "process", True,
+                extra_env={"RAY_TPU_CONTROL_RING": "0"})
+                ["tasks_per_sec"], 1)
+            er = {
+                "ring_on_tasks_per_sec": on,
+                "ring_off_tasks_per_sec": off,
+                "speedup_pct": round(100.0 * (on - off) / off, 1),
+            }
+            print(f"  e2e_ring: {on:.0f} tasks/s with ring vs "
+                  f"{off:.0f} over the pipe "
+                  f"({er['speedup_pct']:+.1f}%)", file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+        OUT["e2e_ring"] = er or None
+        _emit()
+
     # --- log plane: stdout/stderr capture overhead ---------------------
     # A/B of the e2e harness with capture disabled (RAY_TPU_LOG_CAPTURE=0
     # — no session dir, no per-worker files, no monitor thread). The e2e

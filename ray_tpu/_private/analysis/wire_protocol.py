@@ -102,6 +102,10 @@ DEFAULT_CHANNELS: List[ChannelSpec] = [
         name="owner_to_worker",
         sends=[
             SendSpec("_private/runtime/process_pool.py", "send"),
+            # control-ring writer: ("env", envelope) rides a shm slot
+            # when it fits, the pipe verbatim otherwise — one schema
+            # covers both transports
+            SendSpec("_private/runtime/process_pool.py", "_ring_send"),
             SendSpec("actor.py", "_remote_round",
                      style="first_arg_tag", fixed_arity=2),
         ],
@@ -124,12 +128,18 @@ DEFAULT_CHANNELS: List[ChannelSpec] = [
         sends=[
             SendSpec("_private/runtime/worker_process.py", "send"),
             SendSpec("_private/runtime/worker_process.py", "_emit"),
+            # completion-ring writer: ("cenv", envelope) on the shm
+            # ring (the pipe fallback re-sends the buffered originals,
+            # already covered by the send/_emit specs above)
+            SendSpec("_private/runtime/worker_process.py", "_ring_emit"),
         ],
         recvs=[
             RecvSpec("_private/runtime/process_pool.py",
                      "ProcessWorkerPool._demux_loop"),
             RecvSpec("_private/runtime/process_pool.py",
                      "ProcessWorkerPool._handle_worker_msg"),
+            RecvSpec("_private/runtime/process_pool.py",
+                     "ProcessWorkerPool._handle_ring_msg"),
         ],
         # the daemon's _intercept peeks at done/err tails in transit
         # but the authoritative dispatcher is the owner pool

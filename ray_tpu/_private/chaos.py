@@ -162,6 +162,14 @@ class FaultController:
             self._recovered = {}
 
     # -- the hot hook -------------------------------------------------------
+    def armed(self) -> bool:
+        """Lock-free fast-path gate (same read poll() itself leads
+        with): callers that poll non-``task`` sites once per leased
+        task may skip the whole loop when no plan is armed — arrival
+        counting only happens while armed, so the skip is invisible to
+        any plan's ``when`` coordinates."""
+        return self._armed
+
     def poll(self, site: str, **context: Any) -> Optional[Dict[str, Any]]:
         """Consult the controller at an injection site. Returns a fault
         descriptor ``{"kind": ..., <params>}`` or None. Counts one

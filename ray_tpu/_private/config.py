@@ -174,6 +174,16 @@ _d("worker_pipeline_depth", int, 0,
    "max tasks in flight per process-worker pipe (lease pipelining, "
    "reference: max_tasks_in_flight_per_worker); 0 = auto from the "
    "worker-count / host-core ratio (1 on unoversubscribed hosts)")
+_d("control_ring", bool, True,
+   "ship task-lease envelopes and completion batches to local process "
+   "workers over per-worker shared-memory SPSC rings (pipe kept as "
+   "doorbell + fallback); off = pre-ring per-message pipe transport")
+_d("control_ring_slots", int, 64,
+   "slots per control ring (one task ring + one completion ring per "
+   "local process worker); a power of two keeps the modulo cheap")
+_d("control_ring_slot_bytes", int, 16 * 1024,
+   "bytes per control-ring slot; an envelope larger than one slot "
+   "falls back to the pipe (rings carry single-slot messages only)")
 _d("inline_object_max_bytes", int, 100 * 1024,
    "objects at or under this size are stored in the owner's in-process "
    "memory store (reference inlines <100KB into task specs)")

@@ -282,6 +282,29 @@ def _render_core(worker) -> List[str]:
          "buffered daemon messages re-sent after a link drop or head "
          "failover (summed over remote nodes)", outbox_replayed)
 
+    # shared-memory control ring (local process pools): envelope
+    # traffic vs pipe fallback. Schema-stable zeros when the ring is
+    # disabled or no process pool exists.
+    ring = {"msgs": 0, "bytes": 0, "fallback": 0, "full_waits": 0}
+    for e in worker.gcs.node_table():
+        rs = getattr(e.pool, "ring_stats", None)
+        if rs:
+            for k in ring:
+                ring[k] += rs.get(k, 0)
+    emit("ray_tpu_control_ring_msgs_total", "counter",
+         "control messages (lease + completion envelopes) delivered "
+         "over shm control rings", ring["msgs"])
+    emit("ray_tpu_control_ring_bytes_total", "counter",
+         "payload bytes carried by shm control-ring slots",
+         ring["bytes"])
+    emit("ray_tpu_control_ring_fallback_total", "counter",
+         "control messages that fell back to the worker pipe "
+         "(oversized envelope, full ring, or no ring)",
+         ring["fallback"])
+    emit("ray_tpu_control_ring_full_waits_total", "counter",
+         "ring-full backpressure events observed by producers before "
+         "falling back to the pipe", ring["full_waits"])
+
     from ray_tpu._private.chaos import get_controller
     chaos = get_controller().counters()
     for name, desc, per_site, total in (

@@ -36,10 +36,15 @@ from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private import trace_plane
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.runtime.shm_store import (
+    RING_TAG_BYTE as _RING_TAG_BYTE, RING_TAGS as _RING_TAGS, ControlRing)
 from ray_tpu._private.runtime.worker_process import _ShmValue, fn_id_of
 from ray_tpu._private.scheduler.base import PendingTask
-from ray_tpu._private.serialization import SerializedObject, deserialize, serialize
-from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.serialization import (
+    NONE_FRAMED, SerializedObject, decode_completion_envelope,
+    deserialize, serialize)
+from ray_tpu._private.task_spec import (
+    EMPTY_ARGS_BLOB, TaskSpec, encode_task_envelope)
 
 logger = logging.getLogger(__name__)
 
@@ -120,9 +125,11 @@ class _InFlight:
 class _Handle:
     __slots__ = ("worker_num", "proc", "conn", "ctrl", "worker_id", "pid",
                  "inflight", "borrows",
-                 "sent_fns", "dead", "force_cancel_id", "timeout_cancel_id",
+                 "sent_fns", "sent_hdrs", "dead", "force_cancel_id",
+                 "timeout_cancel_id",
                  "chaos_kill", "send_lock",
-                 "ready", "actor_rt", "oom_kill", "log_paths")
+                 "ready", "actor_rt", "oom_kill", "log_paths",
+                 "ring_in", "ring_out", "ring_region")
 
     def __init__(self, worker_num: int):
         self.actor_rt = None  # set for dedicated actor workers
@@ -138,6 +145,15 @@ class _Handle:
         self.oom_kill = False         # memory monitor killed this worker
         self.borrows: Set[ObjectID] = set()  # actor-runtime bookkeeping
         self.sent_fns: Set[bytes] = set()
+        # lease-envelope header dedupe: (fn_id, name, num_returns) ->
+        # small int id the worker caches the pickled header under
+        self.sent_hdrs: Dict[tuple, int] = {}
+        # shm control rings (local pools with control_ring on): task
+        # ring owner->worker, completion ring worker->owner, plus the
+        # (offset, nbytes) pairs to hand back to the arena free list
+        self.ring_in: Optional[ControlRing] = None
+        self.ring_out: Optional[ControlRing] = None
+        self.ring_region: Optional[Tuple[Tuple[int, int], ...]] = None
         self.dead = False
         self.force_cancel_id: Optional[TaskID] = None
         # deadline enforcement killed this worker for this task: the
@@ -181,6 +197,22 @@ class ProcessWorkerPool:
         # probability set after pool construction was never observed)
         from ray_tpu._private.chaos import get_controller
         self._chaos = get_controller()
+        # shared-memory control ring (local pools only: remote pools'
+        # daemon inspects the pipe's "tasks" payloads for lease
+        # journaling, so their transport stays framed messages)
+        self._ring_on = bool(GLOBAL_CONFIG.control_ring) \
+            and not self.is_remote
+        self._ring_slots = int(GLOBAL_CONFIG.control_ring_slots)
+        self._ring_slot_bytes = int(GLOBAL_CONFIG.control_ring_slot_bytes)
+        # control-plane counters exported as the
+        # ray_tpu_control_ring_* metric families; plain ints bumped
+        # under each handle's send lock (msgs/bytes/full_waits) or the
+        # demux thread (drained completions), schema-stable zeros when
+        # the ring is off
+        self.ring_stats = {"msgs": 0, "bytes": 0, "fallback": 0,
+                           "full_waits": 0}
+        # pool-level pickle cache for envelope invariant headers
+        self._hdr_blobs: Dict[tuple, bytes] = {}
         # lease pipelining (reference: NormalTaskSubmitter
         # max_tasks_in_flight_per_worker + ReportWorkerBacklog): several
         # tasks ride one worker pipe so a wakeup executes a batch. Depth
@@ -250,15 +282,60 @@ class ProcessWorkerPool:
             use_accelerator=GLOBAL_CONFIG.worker_tpu_access,
             inherit_sys_path=True,
             extra=extra)
+        ring_arg = "-"
+        if self._ring_on:
+            ring_arg = self._alloc_rings(h)
         h.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.runtime.worker_process",
              self._listener.address, self._shm.arena.name,
-             str(self._inline_max), str(num)],
+             str(self._inline_max), str(num), ring_arg],
             env=env, close_fds=True)
         h.pid = h.proc.pid
         threading.Thread(target=self._monitor_proc, args=(h,), daemon=True,
                          name=f"ray_tpu_pool_monitor_{num}").start()
         return h
+
+    def _alloc_rings(self, h: _Handle) -> str:
+        """Carve this worker's pair of control rings out of the shm
+        arena; returns the geometry argv token the child attaches with
+        ("-" = no rings, pipe-only — e.g. the arena has no room)."""
+        from ray_tpu._private.object_store import ObjectStoreFullError
+
+        arena = self._shm.arena
+        nslots, sbytes = self._ring_slots, self._ring_slot_bytes
+        rb = ControlRing.region_bytes(nslots, sbytes)
+        try:
+            off_in = arena.allocate(rb)
+        except ObjectStoreFullError:
+            return "-"
+        try:
+            off_out = arena.allocate(rb)
+        except ObjectStoreFullError:
+            arena.free(off_in, rb)
+            return "-"
+        h.ring_in = ControlRing(arena, off_in, nslots, sbytes, create=True)
+        h.ring_out = ControlRing(arena, off_out, nslots, sbytes, create=True)
+        h.ring_region = ((off_in, rb), (off_out, rb))
+        return f"{off_in}:{off_out}:{nslots}:{sbytes}"
+
+    def _free_rings(self, h: _Handle) -> None:
+        """Return a dead/released worker's ring regions to the arena.
+        Detach under the send lock so a racing producer (executor
+        thread) or the demux drain never touches freed memory; a
+        respawned replacement gets fresh zeroed rings."""
+        with h.send_lock:
+            rings = (h.ring_in, h.ring_out)
+            region = h.ring_region
+            h.ring_in = h.ring_out = h.ring_region = None
+        for r in rings:
+            if r is not None:
+                r.close()
+        if region is not None:
+            for off, rb in region:
+                try:
+                    self._shm.arena.free(off, rb)
+                except Exception:
+                    pass  # arena already shut down
 
     def _monitor_proc(self, h: _Handle) -> None:
         h.proc.wait()
@@ -491,6 +568,9 @@ class ProcessWorkerPool:
 
     def _assign_many(self, h: _Handle, items: List[tuple]) -> None:
         """Lease a run of tasks onto one worker with ONE pipe write."""
+        if self._ring_on:
+            self._assign_many_ring(h, items)
+            return
         out = []
         for pending, payload in items:
             spec = pending.spec
@@ -522,6 +602,77 @@ class ProcessWorkerPool:
         except (OSError, ValueError) as e:
             self._on_worker_failure(h, e)
 
+    def _assign_many_ring(self, h: _Handle, items: List[tuple]) -> None:
+        """Envelope variant of _assign_many: the tick's leases for this
+        worker pack into ONE struct-framed envelope on the shm ring
+        (pipe doorbell after; framed pipe send as fallback).
+
+        Tasks are grouped by invariant header first and all owner-side
+        bookkeeping runs in that grouped order — the worker executes
+        the envelope front to back, and the inflight FIFO must match
+        execution order (a worker RPC's borrow attaches to the OLDEST
+        inflight lease)."""
+        groups: Dict[tuple, list] = {}
+        for pending, payload in items:
+            groups.setdefault(
+                (payload["fn_id"], payload["name"],
+                 payload["num_returns"]), []).append((pending, payload))
+        infs = []
+        for pairs in groups.values():
+            for pending, payload in pairs:
+                contained = payload.pop("_contained")
+                inf = _InFlight(pending,
+                                [ObjectID(b)
+                                 for b in payload["return_ids"]])
+                for oid in contained:
+                    self._worker.reference_counter.add_borrower(
+                        oid, h.worker_id)
+                    inf.borrows.add(oid)
+                infs.append((pending.spec.task_id, inf))
+        h.oom_kill = False
+        with self._lock:
+            for tid, inf in infs:
+                h.inflight[tid] = inf
+                self._by_task[tid] = h
+        self._worker.events.record_batch(
+            [(p.spec.task_id, p.spec.name)
+             for pairs in groups.values() for p, _ in pairs],
+            "started", self.node_index)
+        if self._chaos.armed():
+            for pairs in groups.values():
+                for pending, _payload in pairs:
+                    if self._chaos_assign(h, pending.spec):
+                        return  # killed/dropped: inflight recovers
+        try:
+            with h.send_lock:
+                blob = encode_task_envelope(
+                    [(key, [p for _, p in pairs])
+                     for key, pairs in groups.items()],
+                    h.sent_fns, h.sent_hdrs, self._hdr_blobs)
+                self._ring_send(("env", blob), h)
+        except (OSError, ValueError) as e:
+            self._on_worker_failure(h, e)
+
+    def _ring_send(self, msg: tuple, h: _Handle) -> None:
+        """Ship one control message to the worker: ring slot + pipe
+        doorbell when it fits, framed pipe message otherwise. Caller
+        holds h.send_lock — the ring is strictly single-producer, and
+        the doorbell-after-put ordering is what keeps ring traffic
+        FIFO-consistent with everything else on the pipe."""
+        ring = h.ring_in
+        stats = self.ring_stats
+        if ring is not None:
+            data = _RING_TAG_BYTE[msg[0]] + msg[1]
+            if len(data) <= ring.max_msg:
+                if ring.try_put(data):
+                    stats["msgs"] += 1
+                    stats["bytes"] += len(data)
+                    h.conn.send(("ring",))
+                    return
+                stats["full_waits"] += 1
+        stats["fallback"] += 1
+        h.conn.send(msg)
+
     def _pick_worker_locked(
             self, provisional: Optional[Dict["_Handle", int]] = None,
     ) -> Optional[_Handle]:
@@ -535,6 +686,15 @@ class ProcessWorkerPool:
             return self._idle.popleft()
         if self._pipeline_depth <= 1:
             return None
+        # while a worker is still booting, QUEUE instead of pipelining
+        # onto an already-busy sibling: its ready message parks it via
+        # _mark_idle, which drains the queue — piling up early would
+        # serialize a burst onto the first worker to come up (the
+        # envelope transport made first-task latency shorter than
+        # worker startup, so this window is routinely hit now)
+        for h in self._handles:
+            if not h.dead and not h.ready and h.actor_rt is None:
+                return None
         best = None
         best_n = self._pipeline_depth
         for h in self._handles:
@@ -543,15 +703,29 @@ class ProcessWorkerPool:
             n = len(h.inflight)
             if provisional:
                 n += provisional.get(h, 0)
-            if 0 < n < best_n:
+            # n == 0 here means a FREE worker that simply hasn't been
+            # re-parked in _idle yet (completion handling re-parks after
+            # popping inflight) — it must win over piling a second task
+            # onto a busy handle, or a burst submitted right as the
+            # previous one completes serializes onto one process
+            if n < best_n:
                 best, best_n = h, n
+                if n == 0:
+                    break
         return best
 
     def _build_payload(self, spec: TaskSpec,
                        return_ids: List[ObjectID]) -> Tuple[dict, list]:
-        args = tuple(self._resolve_for_ship(a) for a in spec.args)
-        kwargs = {k: self._resolve_for_ship(v) for k, v in spec.kwargs.items()}
-        args_blob, contained = _dumps_collect_refs((args, kwargs))
+        if not spec.args and not spec.kwargs:
+            # the dominant high-rate shape (fan-outs of no-arg tasks)
+            # skips the pickler entirely; the shared constant also lets
+            # the envelope encoder elide the blob by identity
+            args_blob, contained = EMPTY_ARGS_BLOB, []
+        else:
+            args = tuple(self._resolve_for_ship(a) for a in spec.args)
+            kwargs = {k: self._resolve_for_ship(v)
+                      for k, v in spec.kwargs.items()}
+            args_blob, contained = _dumps_collect_refs((args, kwargs))
         fn_blob = spec.serialized_func
         fn_id = spec.func_id
         if fn_blob is None:
@@ -649,6 +823,11 @@ class ProcessWorkerPool:
         return False
 
     def _assign(self, h: _Handle, pending: PendingTask, payload: dict) -> None:
+        if self._ring_on:
+            # singles ride the same envelope/ring path as batches: one
+            # transport, one set of dedupe caches, one wire schema
+            self._assign_many_ring(h, [(pending, payload)])
+            return
         spec = pending.spec
         contained = payload.pop("_contained")
         inf = _InFlight(pending, [ObjectID(b) for b in payload["return_ids"]])
@@ -716,18 +895,37 @@ class ProcessWorkerPool:
                     runtime_sanitizer.check_wire("worker_to_owner", msg)
                     kind = msg[0]
                     if kind == "many":
-                        # a worker's buffered batch completions
-                        for sub in msg[1]:
-                            if sub[0] == "done" and h.actor_rt is None:
-                                dones.append((h, TaskID(sub[1]), sub[2],
-                                              sub[3] if len(sub) > 3
-                                              else None))
-                            else:
-                                dones = self._flush_dones_safe(dones)
-                                self._handle_worker_msg(h, sub)
+                        # a worker's buffered batch completions; the
+                        # dominant shape is all-"done" 4-tuples, which
+                        # extracts in ONE batched pass (the former
+                        # per-sub tail probe with its repeated length
+                        # guards was measurable at high completion
+                        # rates) — anything mixed takes the slow path
+                        subs = msg[1]
+                        if h.actor_rt is None and all(
+                                s[0] == "done" and len(s) == 4
+                                for s in subs):
+                            dones.extend(
+                                (h, TaskID(s[1]), s[2], s[3])
+                                for s in subs)
+                        else:
+                            for sub in subs:
+                                if sub[0] == "done" \
+                                        and h.actor_rt is None:
+                                    dones.append(
+                                        (h, TaskID(sub[1]), sub[2],
+                                         sub[3] if len(sub) > 3
+                                         else None))
+                                else:
+                                    dones = self._flush_dones_safe(dones)
+                                    self._handle_worker_msg(h, sub)
                     elif kind == "done" and h.actor_rt is None:
                         dones.append((h, TaskID(msg[1]), msg[2],
                                       msg[3] if len(msg) > 3 else None))
+                    elif kind == "cring":
+                        # completion-ring doorbell: drain the worker's
+                        # shm ring (envelopes decode outside any lock)
+                        dones = self._drain_comp_ring(h, dones)
                     else:
                         # per-worker message order is a protocol
                         # invariant (e.g. an rpc_put's borrow attaches
@@ -758,6 +956,47 @@ class ProcessWorkerPool:
             except Exception:
                 logger.exception("batched completion handling failed")
         return []
+
+    def _drain_comp_ring(self, h: _Handle,
+                         dones: List[tuple]) -> List[tuple]:
+        """Pop every envelope off one worker's completion ring. The
+        byte copies happen under the handle's send lock (so _free_rings
+        can never pull the region out from under us); decode and
+        completion handling run unlocked."""
+        with h.send_lock:
+            ring = h.ring_out
+            msgs = ring.drain() if ring is not None else ()
+        if msgs:
+            stats = self.ring_stats
+            stats["msgs"] += len(msgs)
+            stats["bytes"] += sum(len(m) for m in msgs)
+        for data in msgs:
+            tag = _RING_TAGS.get(data[0])
+            if tag is None:
+                logger.error("unknown ring tag %d from worker %d",
+                             data[0], h.worker_num)
+                continue
+            msg = (tag, bytes(memoryview(data)[1:]))
+            runtime_sanitizer.check_wire("worker_to_owner", msg)
+            dones = self._handle_ring_msg(h, msg, dones)
+        return dones
+
+    def _handle_ring_msg(self, h: _Handle, msg: tuple,
+                         dones: List[tuple]) -> List[tuple]:
+        """Dispatch one reconstructed ring message (same tag/arity
+        discipline as the pipe: raylint's wire pass checks this handler
+        against the ring send sites)."""
+        kind = msg[0]
+        if kind == "cenv":
+            for item in decode_completion_envelope(msg[1]):
+                if item[0] == "done" and h.actor_rt is None:
+                    dones.append((h, TaskID(item[1]), item[2], item[3]))
+                else:
+                    # errors keep the completions-before-anything-else
+                    # ordering invariant, exactly like the pipe path
+                    dones = self._flush_dones_safe(dones)
+                    self._handle_worker_msg(h, item)
+        return dones
 
     def _handle_worker_msg(self, h: _Handle, msg: tuple) -> None:
         """One worker->owner message (shared by the local per-worker
@@ -839,8 +1078,14 @@ class ProcessWorkerPool:
                 self._shm.seal(oid)
                 self._worker.memory_store.put(oid, _PLACEHOLDER)
             else:
-                value = deserialize(SerializedObject.from_bytes(entry[1]))
-                self._worker.memory_store.put(oid, value)
+                data = entry[1]
+                if data == NONE_FRAMED:
+                    # precomputed no-result frame: skip the pickler
+                    self._worker.memory_store.put(oid, None)
+                else:
+                    value = deserialize(
+                        SerializedObject.from_bytes(data))
+                    self._worker.memory_store.put(oid, value)
         return return_ids
 
     def store_result_entries(self, return_ids: List[ObjectID],
@@ -876,9 +1121,9 @@ class ProcessWorkerPool:
         self._release_taken(h, inf)
 
     def _on_done_batch(self, dones: List[tuple]) -> None:
-        """N completions -> one store pass + ONE scheduler wakeup
-        (object-ready and task-finished events delivered together via
-        notify_batch), then handle release/requeue per worker. The
+        """N completions -> one store pass, release/requeue per worker,
+        then ONE scheduler wakeup (object-ready and task-finished
+        events delivered together via notify_batch). The
         inflight entry is POPPED under the pool lock up front so a
         concurrent _on_worker_failure (monitor/tick threads) can never
         double-handle a task as both completed and crashed."""
@@ -935,12 +1180,18 @@ class ProcessWorkerPool:
             if tp is not None:
                 tp.record_finished_batch(te_rows,
                                          offset=self.clock_offset)
-        self._worker.scheduler.notify_batch(ready_oids, finished)
+        # park/refeed the workers BEFORE waking the scheduler: a driver
+        # blocked in get() resumes the moment notify_batch lands, and if
+        # it submits immediately the picker must already see these
+        # workers as idle (the ring coalesces a whole burst into one
+        # batch, so with notify first NO worker would be parked yet and
+        # the next burst would pile onto a single handle)
         for h, task_id, _entries, _timing, inf in taken:
             for oid in inf.borrows:
                 self._worker.reference_counter.remove_borrower(
                     oid, h.worker_id)
             self._mark_idle(h)
+        self._worker.scheduler.notify_batch(ready_oids, finished)
 
     def _on_err(self, h: _Handle, task_id: TaskID, exc_blob: bytes,
                 tb: str, timing=None) -> None:
@@ -1014,6 +1265,7 @@ class ProcessWorkerPool:
                 pass
             shutting_down = self._shutdown
         if h.actor_rt is not None:
+            self._free_rings(h)
             if not shutting_down and not was_dead:
                 h.actor_rt._on_process_died(h, cause)
             return
@@ -1072,9 +1324,11 @@ class ProcessWorkerPool:
                         oid, h.worker_id)
                 with self._lock:
                     self._by_task.pop(exec_id, None)
+        self._free_rings(h)
         if not shutting_down and not self._node_dead \
                 and not self._respawn_disabled:
-            # replacement worker keeps the pool at capacity
+            # replacement worker keeps the pool at capacity (with its
+            # own fresh rings — _spawn re-initializes the geometry)
             replacement = self._spawn()
             with self._lock:
                 try:
@@ -1321,6 +1575,7 @@ class ProcessWorkerPool:
                 except subprocess.TimeoutExpired:
                     h.proc.kill()
         for h in handles:
+            self._free_rings(h)
             for c in (h.conn, h.ctrl):
                 if c is not None:
                     try:

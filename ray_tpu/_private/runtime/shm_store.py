@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import struct
 import tempfile
 import threading
 from multiprocessing import shared_memory
@@ -205,6 +206,109 @@ class ShmArena:
                 self._shm.unlink()
             except FileNotFoundError:
                 pass
+
+
+# one tag byte prefixes every ring slot so both directions stay
+# self-describing (and the runtime wire sanitizer can reconstruct the
+# tagged-tuple form a pipe would have carried)
+RING_TAGS = {1: "env", 2: "cenv"}
+RING_TAG_BYTE = {name: bytes([code]) for code, name in RING_TAGS.items()}
+
+
+class ControlRing:
+    """Fixed-slot SPSC ring over a region of the shm arena — the
+    control-plane sibling of the data-plane object store.
+
+    Reference surface: LMAX-disruptor-style sequence stamping. Layout:
+    a 128-byte header (two cache lines: producer cursor at +0, consumer
+    cursor at +64) followed by ``nslots`` slots of ``slot_bytes`` each;
+    a slot is ``[seq u32][len u32][payload]``. The producer writes the
+    payload and length first and publishes by storing the slot's
+    sequence stamp LAST — a single aligned 4-byte store, so the
+    consumer observes either the whole message or none of it (x86/ARM
+    release-on-store is sufficient for SPSC; the pipe doorbell that
+    follows every put provides the cross-core ordering hop anyway).
+
+    Strictly single-producer / single-consumer: the owner serializes
+    producers with the handle's send lock, the worker consumes from its
+    main thread only. Messages never span slots — anything larger than
+    ``max_msg`` is the caller's cue to fall back to the pipe.
+    """
+
+    HEADER = 128
+    _U32 = struct.Struct("<I")
+
+    def __init__(self, arena: "ShmArena", offset: int, nslots: int,
+                 slot_bytes: int, create: bool = False):
+        self.offset = offset
+        self.nslots = nslots
+        self.slot_bytes = slot_bytes
+        self.max_msg = slot_bytes - 8
+        self._buf = arena.view(offset, self.region_bytes(nslots,
+                                                         slot_bytes))
+        self._wseq = 0  # producer-local cursor
+        self._rseq = 0  # consumer-local cursor
+        if create:
+            # zero cursors AND every slot stamp: the region may be
+            # recycled from the arena free list, and a stale stamp
+            # equal to an expected sequence would replay garbage
+            u32, buf = self._U32, self._buf
+            u32.pack_into(buf, 0, 0)
+            u32.pack_into(buf, 64, 0)
+            for i in range(nslots):
+                u32.pack_into(buf, self.HEADER + i * slot_bytes, 0)
+
+    @classmethod
+    def region_bytes(cls, nslots: int, slot_bytes: int) -> int:
+        return cls.HEADER + nslots * slot_bytes
+
+    def try_put(self, data) -> bool:
+        """Publish one message; False = full or oversized (caller falls
+        back to the pipe). Producer side only."""
+        n = len(data)
+        if n > self.max_msg:
+            return False
+        u32, buf = self._U32, self._buf
+        w = self._wseq
+        if ((w - u32.unpack_from(buf, 64)[0]) & 0xFFFFFFFF) >= self.nslots:
+            return False  # consumer hasn't released the oldest slot
+        off = self.HEADER + (w % self.nslots) * self.slot_bytes
+        buf[off + 8:off + 8 + n] = data
+        u32.pack_into(buf, off + 4, n)
+        seq = (w + 1) & 0xFFFFFFFF
+        u32.pack_into(buf, off, seq)       # publish: stamp goes last
+        u32.pack_into(buf, 0, seq)         # advertised producer cursor
+        self._wseq = seq
+        return True
+
+    def try_get(self) -> Optional[bytes]:
+        """Pop the next message, or None when the ring is empty.
+        Consumer side only."""
+        u32, buf = self._U32, self._buf
+        r = self._rseq
+        off = self.HEADER + (r % self.nslots) * self.slot_bytes
+        expect = (r + 1) & 0xFFFFFFFF
+        if u32.unpack_from(buf, off)[0] != expect:
+            return None
+        n = u32.unpack_from(buf, off + 4)[0]
+        data = bytes(buf[off + 8:off + 8 + n])
+        self._rseq = expect
+        u32.pack_into(buf, 64, expect)     # release the slot
+        return data
+
+    def drain(self) -> List[bytes]:
+        out: List[bytes] = []
+        msg = self.try_get()
+        while msg is not None:
+            out.append(msg)
+            msg = self.try_get()
+        return out
+
+    def close(self) -> None:
+        try:
+            self._buf.release()
+        except Exception:
+            pass
 
 
 class _Alloc:

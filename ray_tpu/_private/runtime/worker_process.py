@@ -31,8 +31,13 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
-from ray_tpu._private.runtime.shm_store import ShmArena
-from ray_tpu._private.serialization import SerializedObject, deserialize, serialize
+from ray_tpu._private.runtime.shm_store import (
+    RING_TAG_BYTE as _RING_TAG_BYTE, RING_TAGS as _RING_TAGS,
+    ControlRing, ShmArena)
+from ray_tpu._private.serialization import (
+    NONE_FRAMED, SerializedObject, deserialize,
+    encode_completion_envelope, serialize)
+from ray_tpu._private.task_spec import decode_task_envelope
 
 INLINE_MAX_DEFAULT = 100 * 1024
 
@@ -201,11 +206,25 @@ def _dump_spec(spec, trace=None) -> bytes:
 
 
 class _WorkerRunner:
-    def __init__(self, conn, ctrl_conn, arena_name: str, inline_max: int):
+    def __init__(self, conn, ctrl_conn, arena_name: str, inline_max: int,
+                 ring_spec: Optional[Tuple[int, int, int, int]] = None):
         self.conn = conn
         self.ctrl_conn = ctrl_conn
         self.arena = ShmArena.attach(arena_name) if arena_name else None
         self.inline_max = inline_max
+        # shm control rings (local pools, control_ring on): the owner
+        # carved (task-ring offset, completion-ring offset, nslots,
+        # slot_bytes) out of the arena and passed the geometry on argv;
+        # daemon-spawned remote workers stay pipe-only (no ring_spec)
+        self.task_ring: Optional[ControlRing] = None
+        self.comp_ring: Optional[ControlRing] = None
+        if ring_spec is not None and self.arena is not None:
+            off_in, off_out, nslots, sbytes = ring_spec
+            self.task_ring = ControlRing(self.arena, off_in, nslots, sbytes)
+            self.comp_ring = ControlRing(self.arena, off_out, nslots, sbytes)
+        # lease-envelope invariant headers, keyed by the small int id
+        # the owner assigned (see task_spec.decode_task_envelope)
+        self.hdr_cache: Dict[int, tuple] = {}
         self.fn_cache: Dict[bytes, Any] = {}
         self.actor_instance: Any = None  # set by actor_create (dedicated)
         self.current_task_id: Optional[TaskID] = None
@@ -237,10 +256,30 @@ class _WorkerRunner:
         if not buf:
             return
         self._done_buf = []
+        if self.comp_ring is not None:
+            blob = encode_completion_envelope(buf)
+            if blob is not None and self._ring_emit(("cenv", blob)):
+                return
+        # pipe path: no ring, envelope-ineligible items, oversize, or
+        # ring full — exactly the pre-ring framed messages
         if len(buf) == 1:
             self.conn.send(buf[0])
         else:
             self.conn.send(("many", buf))
+
+    def _ring_emit(self, msg: tuple) -> bool:
+        """Publish one completion envelope on the shm ring + pipe
+        doorbell; False = caller falls back to the pipe. Only the main
+        thread produces (nested executions flush per-completion over
+        the pipe), so the SPSC contract holds without a lock."""
+        ring = self.comp_ring
+        if ring is None:
+            return False
+        data = _RING_TAG_BYTE[msg[0]] + msg[1]
+        if len(data) > ring.max_msg or not ring.try_put(data):
+            return False
+        self.conn.send(("cring",))
+        return True
 
     # -- RPC to the owner --------------------------------------------------
     def rpc(self, op: str, args: tuple):
@@ -266,7 +305,7 @@ class _WorkerRunner:
                     if not ok:
                         raise cloudpickle.loads(data)
                     return data
-                if msg[0] in ("task", "tasks"):
+                if msg[0] in ("task", "tasks", "env", "ring"):
                     if blocking:
                         # a pipelined task queued BEHIND a task that is
                         # blocked waiting (possibly on that very task's
@@ -290,8 +329,15 @@ class _WorkerRunner:
         them); task context saves/restores around each execution."""
         buf, self._done_buf = self._done_buf, None
         try:
-            if msg[0] == "task":
+            kind = msg[0]
+            if kind == "task":
                 self.execute(msg[1])
+            elif kind == "env":
+                for p in decode_task_envelope(msg[1], self.hdr_cache):
+                    self.execute(p)
+            elif kind == "ring":
+                for p in self._drain_ring_payloads():
+                    self.execute(p)
             else:
                 for p in msg[1]:
                     self.execute(p)
@@ -301,6 +347,11 @@ class _WorkerRunner:
     # -- value movement ----------------------------------------------------
     def store_value(self, oid: ObjectID, value: Any) -> tuple:
         """Serialize; small -> inline tuple, large -> create/seal in arena."""
+        if value is None:
+            # no-return tasks dominate high-rate fan-outs; reuse the
+            # precomputed frame (the owner recognizes it by bytes and
+            # skips deserialization too)
+            return ("inline", NONE_FRAMED)
         sobj = serialize(value)
         nbytes = sobj.framed_nbytes()
         if self.arena is None or nbytes <= self.inline_max:
@@ -482,9 +533,14 @@ class _WorkerRunner:
                     sp = mgr.ensure_pip(list(payload["pip"]))
                 env_ctx = rte.applied_env(wd_path, sp, use_cwd=True)
                 env_ctx.__enter__()
-            args, kwargs = cloudpickle.loads(payload["args_blob"])
-            args = tuple(self._resolve(a) for a in args)
-            kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
+            ab = payload["args_blob"]
+            if ab is None:
+                # the lease envelope elides the empty-args blob
+                args, kwargs = (), {}
+            else:
+                args, kwargs = cloudpickle.loads(ab)
+                args = tuple(self._resolve(a) for a in args)
+                kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
             # the owner's seeded FaultController decided per task at
             # payload build; the worker only enacts the chosen kind
             inject = payload.get("inject_fault")
@@ -558,6 +614,39 @@ class _WorkerRunner:
             return self.load_location(loc)
         return v
 
+    def _run_batch(self, payloads) -> None:
+        """A leased batch: execute in order, completions buffered and
+        shipped in chunks (an rpc from any task flushes early to keep
+        owner-side ordering). Chunked — not end-of-batch — flushing
+        lets the owner process completions and refill this worker while
+        the rest of the batch is still executing."""
+        self._done_buf = []
+        try:
+            for p in payloads:
+                self.execute(p)
+                if len(self._done_buf) >= 16:
+                    self._flush_dones()
+        finally:
+            self._flush_dones()
+            self._done_buf = None
+
+    def _drain_ring_payloads(self) -> list:
+        """Every task payload currently published on the task ring —
+        the nested (blocked-rpc) twin of the idle loop's doorbell
+        branch, where completions must ship immediately instead of
+        buffering."""
+        out: list = []
+        ring = self.task_ring
+        if ring is None:
+            return out
+        data = ring.try_get()
+        while data is not None:
+            if _RING_TAGS.get(data[0]) == "env":
+                out.extend(decode_task_envelope(
+                    memoryview(data)[1:], self.hdr_cache))
+            data = ring.try_get()
+        return out
+
     # -- main loop ---------------------------------------------------------
     def run(self) -> None:
         threading.Thread(target=self._ctrl_loop, daemon=True,
@@ -575,21 +664,22 @@ class _WorkerRunner:
             if kind == "task":
                 self.execute(msg[1])
             elif kind == "tasks":
-                # a leased batch: execute in order, completions buffered
-                # and shipped in chunks (an rpc from any task flushes
-                # early to keep owner-side ordering). Chunked — not
-                # end-of-batch — flushing lets the owner process
-                # completions and refill this pipe while the rest of the
-                # batch is still executing.
-                self._done_buf = []
-                try:
-                    for p in msg[1]:
-                        self.execute(p)
-                        if len(self._done_buf) >= 16:
-                            self._flush_dones()
-                finally:
-                    self._flush_dones()
-                    self._done_buf = None
+                self._run_batch(msg[1])
+            elif kind == "env":
+                # a lease envelope that overflowed the ring rode the
+                # pipe whole; same decode, same batch semantics
+                self._run_batch(
+                    decode_task_envelope(msg[1], self.hdr_cache))
+            elif kind == "ring":
+                # task-ring doorbell: drain every envelope currently
+                # published (later doorbells for these find it empty)
+                data = self.task_ring.try_get() \
+                    if self.task_ring is not None else None
+                while data is not None:
+                    if _RING_TAGS.get(data[0]) == "env":
+                        self._run_batch(decode_task_envelope(
+                            memoryview(data)[1:], self.hdr_cache))
+                    data = self.task_ring.try_get()
             elif kind == "actor_create":
                 self.actor_create(msg[1])
             elif kind == "actor_call":
@@ -600,9 +690,12 @@ class _WorkerRunner:
                 raise RuntimeError(f"unexpected message {kind!r} in idle loop")
 
 
-def worker_main(conn, ctrl_conn, arena_name: str, inline_max: int) -> None:
+def worker_main(conn, ctrl_conn, arena_name: str, inline_max: int,
+                ring_spec: Optional[Tuple[int, int, int, int]] = None
+                ) -> None:
     """Worker entry once both pipes are connected."""
-    runner = _WorkerRunner(conn, ctrl_conn, arena_name, inline_max)
+    runner = _WorkerRunner(conn, ctrl_conn, arena_name, inline_max,
+                           ring_spec)
     # install the API shim so user code inside tasks can call ray_tpu.*
     from ray_tpu._private import worker as worker_mod
 
@@ -635,6 +728,13 @@ def _main(argv: List[str]) -> None:
 
     address, arena_name, inline_max, worker_num = (
         argv[0], argv[1], int(argv[2]), int(argv[3]))
+    # optional 5th arg: control-ring geometry "off_in:off_out:slots:
+    # slot_bytes" ("-" or absent = pipe-only; daemon-spawned remote
+    # workers never pass it)
+    ring_spec = None
+    if len(argv) > 4 and argv[4] != "-":
+        a, b, c, d = argv[4].split(":")
+        ring_spec = (int(a), int(b), int(c), int(d))
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     from ray_tpu._private.protocol import make_wire_hello
 
@@ -645,7 +745,7 @@ def _main(argv: List[str]) -> None:
         ctrl.send(make_wire_hello("worker", worker_num, "ctrl"))
     except (FileNotFoundError, ConnectionError, OSError):
         return  # pool already shut down while we were starting
-    worker_main(conn, ctrl, arena_name, inline_max)
+    worker_main(conn, ctrl, arena_name, inline_max, ring_spec)
 
 
 if __name__ == "__main__":

@@ -218,3 +218,116 @@ def _device_to_host(value: Any) -> Any:
     if isinstance(value, dict):
         return {k: _device_to_host(v) for k, v in value.items()}
     return value
+
+
+# ---------------------------------------------------------------------------
+# completion-envelope codec: batched worker -> owner results
+# ---------------------------------------------------------------------------
+# The worker's buffered completions (the ``_done_buf`` the pipe used to
+# carry as per-message pickles) pack into one struct-framed envelope for
+# the shared-memory completion ring. Item shapes are exactly the pipe
+# messages: ("done", task_id, entries, (t0, t1)) with entries of
+# ("inline", blob) | ("shm", offset, nbytes), and
+# ("err", task_id, exc_blob, traceback_str, (t0, t1)).
+#
+# Layout (little-endian):
+#   u8 version, u16 nitems
+#   item: u8 kind (0 done / 1 err), 16s task_id, d t0, d t1
+#     done: u8 nentries; entry: u8 etype
+#           etype 0: u32 len, inline blob
+#           etype 1: u64 offset, u64 nbytes
+#     err:  u32 len, exc blob; u32 len, utf-8 traceback
+
+import struct as _struct
+
+COMPLETION_VERSION = 1
+_C_U8 = _struct.Struct("<B")
+_C_U16 = _struct.Struct("<H")
+_C_U32 = _struct.Struct("<I")
+_C_FIX = _struct.Struct("<B16sdd")
+_C_SHM = _struct.Struct("<QQ")
+
+
+def encode_completion_envelope(items) -> "bytes | None":
+    """Pack a completion batch; None = an item has a shape the codec
+    doesn't know (caller keeps it on the pipe)."""
+    parts = [_C_U8.pack(COMPLETION_VERSION), _C_U16.pack(len(items))]
+    ap = parts.append
+    try:
+        for it in items:
+            kind = it[0]
+            if kind == "done":
+                _, tid, entries, (t0, t1) = it
+                ap(_C_FIX.pack(0, tid, t0, t1))
+                ap(_C_U8.pack(len(entries)))
+                for e in entries:
+                    if e[0] == "inline":
+                        ap(b"\x00")
+                        ap(_C_U32.pack(len(e[1])))
+                        ap(e[1])
+                    elif e[0] == "shm":
+                        ap(b"\x01")
+                        ap(_C_SHM.pack(e[1], e[2]))
+                    else:
+                        return None
+            elif kind == "err":
+                _, tid, blob, tb, (t0, t1) = it
+                tbb = tb.encode("utf-8", "replace")
+                ap(_C_FIX.pack(1, tid, t0, t1))
+                ap(_C_U32.pack(len(blob)))
+                ap(blob)
+                ap(_C_U32.pack(len(tbb)))
+                ap(tbb)
+            else:
+                return None
+    except Exception:
+        return None
+    return b"".join(parts)
+
+
+def decode_completion_envelope(data) -> list:
+    """Unpack back into the pipe-shaped completion tuples (tags
+    restored, so downstream handling is transport-agnostic)."""
+    mv = memoryview(data)
+    if mv[0] != COMPLETION_VERSION:
+        raise ValueError(f"unknown completion-envelope version {mv[0]}")
+    n = _C_U16.unpack_from(mv, 1)[0]
+    off = 3
+    out = []
+    for _ in range(n):
+        kind, tid, t0, t1 = _C_FIX.unpack_from(mv, off)
+        off += 33
+        if kind == 0:
+            ne = mv[off]
+            off += 1
+            entries = []
+            for _ in range(ne):
+                et = mv[off]
+                off += 1
+                if et == 0:
+                    ln = _C_U32.unpack_from(mv, off)[0]
+                    off += 4
+                    entries.append(("inline", bytes(mv[off:off + ln])))
+                    off += ln
+                else:
+                    o, nb = _C_SHM.unpack_from(mv, off)
+                    off += 16
+                    entries.append(("shm", o, nb))
+            out.append(("done", tid, entries, (t0, t1)))
+        else:
+            ln = _C_U32.unpack_from(mv, off)[0]
+            off += 4
+            blob = bytes(mv[off:off + ln])
+            off += ln
+            ln = _C_U32.unpack_from(mv, off)[0]
+            off += 4
+            tb = str(mv[off:off + ln], "utf-8")
+            off += ln
+            out.append(("err", tid, blob, tb, (t0, t1)))
+    return out
+
+
+# the framed serialization of None, precomputed: workers return it for
+# no-result tasks by reference and the owner recognizes it by bytes,
+# so the dominant fan-out shape never touches a pickler on either side
+NONE_FRAMED = serialize(None).to_bytes()

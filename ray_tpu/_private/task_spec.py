@@ -11,7 +11,10 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import struct
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
 
 from ray_tpu._private.ids import ActorID, ObjectID, PlacementGroupID, TaskID
 
@@ -149,3 +152,221 @@ class TaskSpec:
 
     def resource_vector(self) -> Tuple[float, ...]:
         return resources_to_vector(self.resources)
+
+
+# ---------------------------------------------------------------------------
+# lease-envelope codec: the vectorized spec wire format
+# ---------------------------------------------------------------------------
+# A scheduler tick's worth of leases for one worker packs into a single
+# envelope instead of N cloudpickled payload dicts. The spec splits into
+# a per-class INVARIANT header (name, fn_id, num_returns — pickled once,
+# cached per worker by a small int id, riding the same dedupe discipline
+# as the fn-blob pre-cache) and a struct-packed per-task VARYING section
+# (task id, attempt, args/ObjectRef blob, trace context). Anything
+# unusual (explicit retry return_ids, placement-group capture, injected
+# faults, runtime-env extras) rides a per-task pickled extras dict, so
+# every payload the pipe could carry is envelope-expressible.
+#
+# Layout (little-endian):
+#   u8 version, u16 ngroups
+#   group: u16 hdr_id, u32 hdr_len (0 = receiver caches hdr_id), hdr,
+#          u32 fn_len (0 = fn cache has it), fn_blob, u16 ntasks, tasks
+#   task:  16s task_id, u32 attempt, u8 flags,
+#          [flags&1] u8 n, n x 20s explicit return_ids
+#          [flags&2] u8 mark, then trace/span/parent as u8-len ascii
+#                    (parent len 255 = None)
+#          [flags&4] u32 len, args_blob
+#          [flags&8] u32 len, pickled extras dict
+
+ENVELOPE_VERSION = 1
+_F_RIDS, _F_TRACE, _F_ARGS, _F_EXTRAS = 1, 2, 4, 8
+_U8 = struct.Struct("<B")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_HDR_FIX = struct.Struct("<HI")
+_TASK_FIX = struct.Struct("<16sIB")
+_RIDX = [struct.pack(">I", i) for i in range(64)]  # ids.py return index
+
+# the serialized empty (args, kwargs) — shared so the owner encodes it
+# by identity and the envelope omits it entirely (the dominant shape in
+# high-rate fan-outs is a no-arg task)
+EMPTY_ARGS_BLOB = cloudpickle.dumps(((), {}))
+
+_CORE_KEYS = frozenset((
+    "task_id", "name", "fn_id", "fn_blob", "args_blob", "num_returns",
+    "return_ids", "attempt", "trace", "trace_mark"))
+
+
+def _ret_index(i: int) -> bytes:
+    return _RIDX[i] if i < 64 else struct.pack(">I", i)
+
+
+def _encode_trace(tr, mark: bool) -> Optional[bytes]:
+    """Struct-pack a well-formed TraceContext; None = not packable
+    (rides the extras pickle instead)."""
+    try:
+        t, s, ps, _sampled = tr
+        tb = t.encode("ascii")
+        sb = s.encode("ascii")
+        pb = b"" if ps is None else ps.encode("ascii")
+        if len(tb) > 254 or len(sb) > 254 or len(pb) > 254:
+            return None
+        return b"".join((
+            _U8.pack(1 if mark else 0),
+            _U8.pack(len(tb)), tb,
+            _U8.pack(len(sb)), sb,
+            _U8.pack(255 if ps is None else len(pb)), pb))
+    except Exception:
+        return None
+
+
+def encode_task_envelope(groups, sent_fns, sent_hdrs, hdr_blobs) -> bytes:
+    """Pack one worker's tick of leases.
+
+    ``groups``: list of ``(key, payloads)`` with ``key = (fn_id, name,
+    num_returns)`` shared by every payload in the group. ``sent_fns`` /
+    ``sent_hdrs`` are the per-worker dedupe caches (mutated — the
+    caller holds the handle's send lock); ``hdr_blobs`` is a pool-level
+    header-pickle cache keyed the same way."""
+    parts = [_U8.pack(ENVELOPE_VERSION), _U16.pack(len(groups))]
+    ap = parts.append
+    for key, payloads in groups:
+        hid = sent_hdrs.get(key)
+        if hid is None:
+            hid = sent_hdrs[key] = len(sent_hdrs)
+            hdr = hdr_blobs.get(key)
+            if hdr is None:
+                fn_id, name, num_returns = key
+                hdr = hdr_blobs[key] = cloudpickle.dumps(
+                    (name, fn_id, num_returns))
+            ap(_HDR_FIX.pack(hid, len(hdr)))
+            ap(hdr)
+        else:
+            ap(_HDR_FIX.pack(hid, 0))
+        p0 = payloads[0]
+        fid = p0["fn_id"]
+        blob = p0["fn_blob"]
+        if blob is not None and (fid is None or fid not in sent_fns):
+            if fid is not None:
+                sent_fns.add(fid)
+            ap(_U32.pack(len(blob)))
+            ap(blob)
+        else:
+            ap(_U32.pack(0))
+        ap(_U16.pack(len(payloads)))
+        for p in payloads:
+            tid = p["task_id"]
+            flags = 0
+            opt = []
+            rids = p["return_ids"]
+            nr = len(rids)
+            if not all(rids[i] == tid + _ret_index(i) for i in range(nr)):
+                # retry reusing prior attempt ids — ship them explicitly
+                flags |= _F_RIDS
+                opt.append(_U8.pack(nr))
+                opt.extend(rids)
+            tr = p.get("trace")
+            tr_spill = False
+            if tr is not None:
+                enc = _encode_trace(tr, bool(p.get("trace_mark")))
+                if enc is not None:
+                    flags |= _F_TRACE
+                    opt.append(enc)
+                else:
+                    tr_spill = True
+            ab = p["args_blob"]
+            if ab is not EMPTY_ARGS_BLOB:
+                flags |= _F_ARGS
+                opt.append(_U32.pack(len(ab)))
+                opt.append(ab)
+            nbase = 8 + ("trace" in p) + ("trace_mark" in p)
+            if len(p) > nbase or tr_spill:
+                extras = {k: v for k, v in p.items()
+                          if k not in _CORE_KEYS}
+                if tr_spill:
+                    extras["trace"] = tr
+                    if p.get("trace_mark"):
+                        extras["trace_mark"] = True
+                flags |= _F_EXTRAS
+                xb = cloudpickle.dumps(extras)
+                opt.append(_U32.pack(len(xb)))
+                opt.append(xb)
+            ap(_TASK_FIX.pack(tid, p["attempt"], flags))
+            parts.extend(opt)
+    return b"".join(parts)
+
+
+def decode_task_envelope(data, hdr_cache: Dict[int, tuple]) -> list:
+    """Unpack an envelope into the per-task payload dicts the worker's
+    execute() path already understands. ``hdr_cache`` maps header id ->
+    (name, fn_id, num_returns) for this connection's lifetime."""
+    mv = memoryview(data)
+    if mv[0] != ENVELOPE_VERSION:
+        raise ValueError(f"unknown task-envelope version {mv[0]}")
+    ngroups = _U16.unpack_from(mv, 1)[0]
+    off = 3
+    out = []
+    for _ in range(ngroups):
+        hid, hlen = _HDR_FIX.unpack_from(mv, off)
+        off += 6
+        if hlen:
+            hdr_cache[hid] = cloudpickle.loads(mv[off:off + hlen])
+            off += hlen
+        name, fn_id, num_returns = hdr_cache[hid]
+        flen = _U32.unpack_from(mv, off)[0]
+        off += 4
+        fn_blob = bytes(mv[off:off + flen]) if flen else None
+        off += flen
+        ntasks = _U16.unpack_from(mv, off)[0]
+        off += 2
+        for _ in range(ntasks):
+            tid, attempt, flags = _TASK_FIX.unpack_from(mv, off)
+            off += 21
+            if flags & _F_RIDS:
+                n = mv[off]
+                off += 1
+                rids = [bytes(mv[off + 20 * i:off + 20 * i + 20])
+                        for i in range(n)]
+                off += 20 * n
+            else:
+                rids = [tid + _ret_index(i) for i in range(num_returns)]
+            p = {"task_id": tid, "name": name, "fn_id": fn_id,
+                 "fn_blob": fn_blob, "args_blob": None,
+                 "num_returns": num_returns, "return_ids": rids,
+                 "attempt": attempt}
+            # only the group's first task carries the fn blob; the
+            # worker fn cache (keyed on arrival) serves the rest
+            fn_blob = None
+            if flags & _F_TRACE:
+                mark = mv[off]
+                off += 1
+                ln = mv[off]
+                off += 1
+                t = str(mv[off:off + ln], "ascii")
+                off += ln
+                ln = mv[off]
+                off += 1
+                s = str(mv[off:off + ln], "ascii")
+                off += ln
+                ln = mv[off]
+                off += 1
+                if ln == 255:
+                    ps = None
+                else:
+                    ps = str(mv[off:off + ln], "ascii")
+                    off += ln
+                p["trace"] = (t, s, ps, True)
+                if mark:
+                    p["trace_mark"] = True
+            if flags & _F_ARGS:
+                alen = _U32.unpack_from(mv, off)[0]
+                off += 4
+                p["args_blob"] = bytes(mv[off:off + alen])
+                off += alen
+            if flags & _F_EXTRAS:
+                xlen = _U32.unpack_from(mv, off)[0]
+                off += 4
+                p.update(cloudpickle.loads(mv[off:off + xlen]))
+                off += xlen
+            out.append(p)
+    return out

@@ -1204,7 +1204,9 @@ class Worker:
         te = self.task_events
         tp = self.trace_plane
         te_rows: List[tuple] = []
-        record = self.events.record
+        # profile-event rows batch per node (record_batch takes one
+        # node): one ring append pass per tick, not one call per task
+        ev_rows: Dict[int, List[tuple]] = {}
         for pending in pendings:
             spec = pending.spec
             pool = self.pool_for_node(pending.node_index)
@@ -1212,8 +1214,8 @@ class Worker:
                     or spec.task_type != TaskType.NORMAL_TASK):
                 self._dispatch(pending)
             elif pool is not None and not pool.is_remote:
-                record(spec.task_id, spec.name, "dispatched",
-                       pending.node_index)
+                ev_rows.setdefault(pending.node_index, []).append(
+                    (spec.task_id, spec.name))
                 if te is not None or tp is not None:
                     te_rows.append((spec.task_id, pending.node_index))
                 groups.setdefault(pool, []).append(pending)
@@ -1234,14 +1236,16 @@ class Worker:
                         and not getattr(spec, "_deps_memo", None)):
                     fast.append(pending)
                 else:
-                    record(spec.task_id, spec.name, "dispatched",
-                           pending.node_index)
+                    ev_rows.setdefault(pending.node_index, []).append(
+                        (spec.task_id, spec.name))
                     if te is not None or tp is not None:
                         te_rows.append((spec.task_id,
                                         pending.node_index))
                     local.append((self._execute_task, (pending,)))
             else:
                 self._dispatch(pending)
+        for node, rows in ev_rows.items():
+            self.events.record_batch(rows, "dispatched", node)
         if te_rows or fast:
             all_rows = te_rows + [(p.spec.task_id, p.node_index)
                                   for p in fast]

@@ -350,6 +350,56 @@ class TestWireProtocol:
         assert any(k.startswith("wire:sent-unhandled:")
                    and "trace_mark" in k for k in keys), keys
 
+    def test_ring_schema_drift_caught(self, tmp_path):
+        """Control-ring satellite: ring traffic reuses the tuple
+        framing (1 tag byte + blob reconstructed to ("env", blob) /
+        ("cenv", blob)), so the real table only grew the _ring_send /
+        _ring_emit send sites and the _handle_ring_msg recv. This
+        fixture injects the drift that WOULD appear if the ring schema
+        diverged: an envelope tag sent through the ring callee with no
+        recv branch, and a completion handler expecting an element the
+        ring sender never ships."""
+        _write(tmp_path, "owner.py", """
+            def pump(self, h):
+                self._ring_send(("env", b"blob"), h)
+                self._ring_send(("env2", b"blob"), h)
+            """)
+        _write(tmp_path, "wrk.py", """
+            def flush(self):
+                self._ring_emit(("cenv", b"blob"))
+            """)
+        _write(tmp_path, "recv_o.py", """
+            def handle_ring(msg):
+                kind = msg[0]
+                if kind == "env":
+                    return msg[1]
+                return None
+            """)
+        _write(tmp_path, "recv_w.py", """
+            def handle_comp(msg):
+                kind = msg[0]
+                if kind == "cenv":
+                    return msg[2]
+                return None
+            """)
+        channels = [
+            ChannelSpec(name="o2w_ring",
+                        sends=[SendSpec("owner.py", "_ring_send")],
+                        recvs=[RecvSpec("recv_o.py", "handle_ring")]),
+            ChannelSpec(name="w2o_ring",
+                        sends=[SendSpec("wrk.py", "_ring_emit")],
+                        recvs=[RecvSpec("recv_w.py", "handle_comp")]),
+        ]
+        keys = _keys(wire_protocol.analyze(str(tmp_path), _mk,
+                                           channels=channels,
+                                           op_channels=[]))
+        assert any(k.startswith("wire:sent-unhandled:") and "env2" in k
+                   for k in keys), keys
+        assert any(k.startswith("wire:arity:") and "cenv" in k
+                   for k in keys), keys
+        # the conformant env tag raises nothing
+        assert not any(k.split(":")[-1] == "env" for k in keys), keys
+
     def test_real_channels_have_no_drift(self):
         # satellite (f): remote_pool<->node_daemon (and the other three
         # channels) must agree on tags and arities; the daemon/demux

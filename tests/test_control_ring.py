@@ -1,0 +1,463 @@
+"""Shared-memory control ring: batched lease envelopes over fixed-slot
+SPSC rings between the owner and local process workers, with the pipe
+retained as doorbell + fallback.
+
+Covers the ring primitive (wraparound, full, oversize, recycled-region
+re-init), both envelope codecs (task + completion), the owner-side
+fallback accounting, and the end-to-end paths: ring on, ring off
+(byte-for-byte pipe behavior), oversized-envelope fallback, worker
+SIGKILL mid-ring with ring re-init on respawn, and sanitizer wire
+checks over ring traffic.
+"""
+
+import os
+import time
+import types
+
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu._private.object_store import ObjectStoreFullError
+from ray_tpu._private.runtime.process_pool import ProcessWorkerPool
+from ray_tpu._private.runtime.shm_store import ControlRing, ShmArena
+from ray_tpu._private.serialization import (NONE_FRAMED,
+                                            decode_completion_envelope,
+                                            encode_completion_envelope)
+from ray_tpu._private.task_spec import (EMPTY_ARGS_BLOB,
+                                        decode_task_envelope,
+                                        encode_task_envelope)
+
+
+# ----------------------------------------------------------------------
+# ControlRing primitive (no processes)
+# ----------------------------------------------------------------------
+
+class TestControlRing:
+    def _ring(self, arena, nslots=8, slot_bytes=64, create=True):
+        off = arena.allocate(ControlRing.region_bytes(nslots, slot_bytes))
+        return ControlRing(arena, off, nslots, slot_bytes, create=create)
+
+    def test_roundtrip_and_fifo(self):
+        a = ShmArena(1 << 16)
+        try:
+            r = self._ring(a)
+            msgs = [bytes([i]) * (i + 1) for i in range(5)]
+            for m in msgs:
+                assert r.try_put(m)
+            assert r.drain() == msgs
+            assert r.try_get() is None  # empty again
+        finally:
+            a.close()
+            a.unlink()
+
+    def test_wraparound_many_generations(self):
+        """1000 messages through an 8-slot ring: the sequence stamps
+        wrap the slot array ~125 times and every message survives."""
+        a = ShmArena(1 << 16)
+        try:
+            r = self._ring(a, nslots=8)
+            for i in range(1000):
+                m = i.to_bytes(4, "little")
+                assert r.try_put(m)
+                got = r.try_get()
+                assert got == m, i
+        finally:
+            a.close()
+            a.unlink()
+
+    def test_full_refuses_until_consumed(self):
+        a = ShmArena(1 << 16)
+        try:
+            r = self._ring(a, nslots=4)
+            for i in range(4):
+                assert r.try_put(b"x")
+            assert not r.try_put(b"overflow")  # full: consumer behind
+            assert r.try_get() == b"x"
+            assert r.try_put(b"now fits")  # slot released
+        finally:
+            a.close()
+            a.unlink()
+
+    def test_oversized_refused(self):
+        a = ShmArena(1 << 16)
+        try:
+            r = self._ring(a, slot_bytes=64)
+            assert r.max_msg == 56
+            assert r.try_put(b"a" * 56)
+            assert not r.try_put(b"a" * 57)
+        finally:
+            a.close()
+            a.unlink()
+
+    def test_create_zeroes_recycled_region(self):
+        """A ring built with create=True over a region holding stale
+        stamps (arena free-list recycling) must read as empty — a stale
+        stamp equal to an expected sequence would replay garbage."""
+        a = ShmArena(1 << 16)
+        try:
+            nslots, sb = 8, 64
+            rb = ControlRing.region_bytes(nslots, sb)
+            off = a.allocate(rb)
+            r1 = ControlRing(a, off, nslots, sb, create=True)
+            for i in range(3):
+                assert r1.try_put(b"stale")
+            r1.close()
+            a.free(off, rb)
+            off2 = a.allocate(rb)  # free list hands the hole back
+            r2 = ControlRing(a, off2, nslots, sb, create=True)
+            assert r2.try_get() is None
+            assert r2.try_put(b"fresh")
+            assert r2.try_get() == b"fresh"
+        finally:
+            a.close()
+            a.unlink()
+
+
+# ----------------------------------------------------------------------
+# envelope codecs (no processes)
+# ----------------------------------------------------------------------
+
+def _payload(tid, name="f", fn_id=b"F" * 16, fn_blob=b"<fn>",
+             num_returns=1, **extra):
+    p = {"task_id": tid, "name": name, "fn_id": fn_id,
+         "fn_blob": fn_blob, "args_blob": EMPTY_ARGS_BLOB,
+         "num_returns": num_returns,
+         "return_ids": [tid + i.to_bytes(4, "big")
+                        for i in range(num_returns)],
+         "attempt": 0}
+    p.update(extra)
+    return p
+
+
+class TestTaskEnvelope:
+    def _roundtrip(self, groups, sent_fns=None, sent_hdrs=None,
+                   hdr_cache=None):
+        blob = encode_task_envelope(
+            groups, sent_fns if sent_fns is not None else set(),
+            sent_hdrs if sent_hdrs is not None else {}, {})
+        return decode_task_envelope(
+            blob, hdr_cache if hdr_cache is not None else {})
+
+    def test_basic_group_roundtrip(self):
+        key = (b"F" * 16, "f", 1)
+        ps = [_payload(bytes([i]) * 16) for i in range(3)]
+        out = self._roundtrip([(key, ps)])
+        assert [p["task_id"] for p in out] == [p["task_id"] for p in ps]
+        assert all(p["name"] == "f" and p["num_returns"] == 1
+                   for p in out)
+        # fn blob rides only the first task of the group
+        assert out[0]["fn_blob"] == b"<fn>"
+        assert out[1]["fn_blob"] is None and out[2]["fn_blob"] is None
+        # empty args elided entirely; worker reconstructs ((), {})
+        assert all(p["args_blob"] is None for p in out)
+        # derived return ids reconstructed
+        assert out[0]["return_ids"] == ps[0]["return_ids"]
+
+    def test_header_and_fn_dedupe_across_envelopes(self):
+        key = (b"F" * 16, "f", 2)
+        sent_fns, sent_hdrs, hdr_blobs = set(), {}, {}
+        hdr_cache = {}
+        b1 = encode_task_envelope(
+            [(key, [_payload(b"\x01" * 16, num_returns=2)])],
+            sent_fns, sent_hdrs, hdr_blobs)
+        b2 = encode_task_envelope(
+            [(key, [_payload(b"\x02" * 16, num_returns=2)])],
+            sent_fns, sent_hdrs, hdr_blobs)
+        # second envelope: header cached by id, fn blob deduped
+        assert len(b2) < len(b1)
+        (p1,) = decode_task_envelope(b1, hdr_cache)
+        (p2,) = decode_task_envelope(b2, hdr_cache)
+        assert p1["fn_blob"] == b"<fn>"
+        assert p2["fn_blob"] is None  # worker fn cache serves it
+        assert p2["name"] == "f" and p2["num_returns"] == 2
+
+    def test_explicit_return_ids_survive(self):
+        """Retry leases reuse prior-attempt return ids that don't match
+        the derived pattern — they must ship explicitly."""
+        tid = b"\x07" * 16
+        rids = [b"\xaa" * 20, b"\xbb" * 20]
+        key = (b"F" * 16, "f", 2)
+        p = _payload(tid, num_returns=2)
+        p["return_ids"] = rids
+        p["attempt"] = 3
+        (out,) = self._roundtrip([(key, [p])])
+        assert out["return_ids"] == rids
+        assert out["attempt"] == 3
+
+    def test_trace_context_packs(self):
+        tr = ("a" * 16, "b" * 16, None, True)
+        key = (b"F" * 16, "f", 1)
+        p = _payload(b"\x03" * 16, trace=tr, trace_mark=True)
+        (out,) = self._roundtrip([(key, [p])])
+        assert out["trace"] == tr
+        assert out["trace_mark"] is True
+        p2 = _payload(b"\x04" * 16, trace=("c" * 16, "d" * 16,
+                                           "e" * 16, True))
+        (out2,) = self._roundtrip([(key, [p2])])
+        assert out2["trace"][2] == "e" * 16
+
+    def test_extras_and_args_blob(self):
+        key = (b"F" * 16, "f", 1)
+        p = _payload(b"\x05" * 16, timeout_s=1.5)
+        p["args_blob"] = b"ARGS"
+        (out,) = self._roundtrip([(key, [p])])
+        assert out["args_blob"] == b"ARGS"
+        assert out["timeout_s"] == 1.5
+
+
+class TestCompletionEnvelope:
+    def test_done_and_err_roundtrip(self):
+        tid1, tid2, tid3 = b"\x01" * 16, b"\x02" * 16, b"\x03" * 16
+        items = [
+            ("done", tid1, [("inline", b"payload")], (1.0, 2.0)),
+            ("done", tid2, [("shm", 4096, 512), ("inline", b"x")],
+             (2.0, 3.0)),
+            ("err", tid3, b"<exc>", "Traceback: boom", (3.0, 4.0)),
+        ]
+        blob = encode_completion_envelope(items)
+        assert blob is not None
+        out = decode_completion_envelope(blob)
+        assert out == items
+
+    def test_unknown_shape_returns_none(self):
+        # unknown kind and unknown entry type both punt to the pipe
+        assert encode_completion_envelope([("weird", 1)]) is None
+        assert encode_completion_envelope(
+            [("done", b"\x01" * 16, [("mystery",)], (0.0, 0.0))]) is None
+
+    def test_none_framed_is_serialized_none(self):
+        from ray_tpu._private.serialization import deserialize, serialize
+        assert NONE_FRAMED == serialize(None).to_bytes()
+        sobj = serialize(None)
+        assert deserialize(sobj) is None
+
+
+# ----------------------------------------------------------------------
+# owner-side fallback accounting (stubbed handle, no processes)
+# ----------------------------------------------------------------------
+
+class _RecordingConn:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+
+class TestRingSendFallback:
+    def _pool_stub(self):
+        return types.SimpleNamespace(
+            ring_stats={"msgs": 0, "bytes": 0, "fallback": 0,
+                        "full_waits": 0})
+
+    def test_ring_hit_sends_doorbell(self):
+        a = ShmArena(1 << 16)
+        try:
+            off = a.allocate(ControlRing.region_bytes(4, 64))
+            ring = ControlRing(a, off, 4, 64, create=True)
+            h = types.SimpleNamespace(ring_in=ring, conn=_RecordingConn())
+            pool = self._pool_stub()
+            ProcessWorkerPool._ring_send(pool, ("env", b"blob"), h)
+            assert pool.ring_stats["msgs"] == 1
+            assert pool.ring_stats["fallback"] == 0
+            assert h.conn.sent == [("ring",)]  # doorbell, not payload
+            data = ring.try_get()
+            assert data is not None and bytes(data[1:]) == b"blob"
+        finally:
+            a.close()
+            a.unlink()
+
+    def test_full_ring_falls_back_to_pipe(self):
+        a = ShmArena(1 << 16)
+        try:
+            off = a.allocate(ControlRing.region_bytes(2, 64))
+            ring = ControlRing(a, off, 2, 64, create=True)
+            assert ring.try_put(b"x") and ring.try_put(b"y")  # fill it
+            h = types.SimpleNamespace(ring_in=ring, conn=_RecordingConn())
+            pool = self._pool_stub()
+            ProcessWorkerPool._ring_send(pool, ("env", b"blob"), h)
+            assert pool.ring_stats["full_waits"] == 1
+            assert pool.ring_stats["fallback"] == 1
+            assert h.conn.sent == [("env", b"blob")]  # whole message
+        finally:
+            a.close()
+            a.unlink()
+
+    def test_oversized_falls_back_without_full_wait(self):
+        a = ShmArena(1 << 16)
+        try:
+            off = a.allocate(ControlRing.region_bytes(4, 64))
+            ring = ControlRing(a, off, 4, 64, create=True)
+            h = types.SimpleNamespace(ring_in=ring, conn=_RecordingConn())
+            pool = self._pool_stub()
+            big = ("env", b"z" * 1024)
+            ProcessWorkerPool._ring_send(pool, big, h)
+            assert pool.ring_stats["fallback"] == 1
+            assert pool.ring_stats["full_waits"] == 0
+            assert h.conn.sent == [big]
+        finally:
+            a.close()
+            a.unlink()
+
+    def test_no_ring_is_pure_pipe(self):
+        h = types.SimpleNamespace(ring_in=None, conn=_RecordingConn())
+        pool = self._pool_stub()
+        ProcessWorkerPool._ring_send(pool, ("env", b"b"), h)
+        assert pool.ring_stats["msgs"] == 0
+        assert pool.ring_stats["fallback"] == 1
+        assert h.conn.sent == [("env", b"b")]
+
+
+# ----------------------------------------------------------------------
+# end-to-end, worker_mode=process
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ring_ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=2,
+                 _system_config={"worker_mode": "process",
+                                 "object_store_memory": 64 * 1024 * 1024})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _pool():
+    return ray_tpu._private.worker.global_worker.process_pool
+
+
+class TestRingEndToEnd:
+    def test_tasks_flow_over_ring(self, ring_ray):
+        @ray_tpu.remote
+        def double(i):
+            return i * 2
+
+        before = dict(_pool().ring_stats)
+        out = ray_tpu.get([double.remote(i) for i in range(32)],
+                          timeout=60)
+        assert out == [i * 2 for i in range(32)]
+        stats = _pool().ring_stats
+        assert stats["msgs"] > before["msgs"]  # envelopes + completions
+        assert stats["bytes"] > before["bytes"]
+        for h in _pool()._handles:
+            assert h.ring_in is not None and h.ring_out is not None
+
+    def test_map_remote_vectorized_over_ring(self, ring_ray):
+        @ray_tpu.remote
+        def sq(i):
+            return i * i
+
+        refs = sq.map_remote([(i,) for i in range(64)])
+        assert ray_tpu.get(refs, timeout=60) == [i * i for i in range(64)]
+
+    def test_worker_sigkill_mid_ring_retries_and_reinits(self, ring_ray):
+        """Chaos worker-kill while leases ride the ring: the task
+        retries on a fresh worker, and the respawned handle gets fresh
+        zeroed rings (no stale stamps replay)."""
+        chaos.arm(chaos.FaultPlan(77, faults=[("worker", 0, "kill")]))
+        try:
+            @ray_tpu.remote(max_retries=3)
+            def work(i):
+                time.sleep(0.02)
+                return i + 100
+
+            out = ray_tpu.get([work.remote(i) for i in range(16)],
+                              timeout=120)
+            assert sorted(out) == [i + 100 for i in range(16)]
+            ctr = chaos.counters()
+            assert ctr["injected"]["worker"] >= 1
+            assert ctr["recovered"]["worker"] >= 1
+        finally:
+            chaos.disarm()
+        # every live handle (including the respawn) has rings attached
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            live = [h for h in _pool()._handles if not h.dead]
+            if len(live) >= 2 and all(
+                    h.ring_in is not None and h.ring_out is not None
+                    for h in live):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("respawned worker never re-attached rings")
+
+        @ray_tpu.remote
+        def ping():
+            return os.getpid()
+
+        assert isinstance(ray_tpu.get(ping.remote(), timeout=60), int)
+
+
+@pytest.mark.chaos
+def test_sanitizer_wire_checks_over_ring_traffic():
+    """RAY_TPU_SANITIZE-armed run: every reconstructed ring message
+    passes the wire-protocol conformance check (the static channel
+    table knows the env/cenv tags)."""
+    from ray_tpu._private.analysis import runtime_sanitizer
+
+    ray_tpu.shutdown()
+    runtime_sanitizer.arm()
+    ray_tpu.init(num_workers=2,
+                 _system_config={"worker_mode": "process",
+                                 "object_store_memory": 32 * 1024 * 1024})
+    try:
+        @ray_tpu.remote
+        def f(i):
+            return i + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(16)],
+                           timeout=60) == list(range(1, 17))
+        assert _pool().ring_stats["msgs"] > 0
+        assert runtime_sanitizer.wire_violations() == []
+        ray_tpu.shutdown()  # files the report
+        rep = runtime_sanitizer.last_report()
+        assert rep is not None and rep["wire_violations"] == []
+    finally:
+        ray_tpu.shutdown()
+        runtime_sanitizer.disarm()
+
+
+def test_ring_off_restores_pipe_behavior():
+    """control_ring=False: no rings allocated, counters stay
+    schema-stable zeros, results identical."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=2,
+                 _system_config={"worker_mode": "process",
+                                 "control_ring": False,
+                                 "object_store_memory": 32 * 1024 * 1024})
+    try:
+        @ray_tpu.remote
+        def f(i):
+            return i * 3
+
+        assert ray_tpu.get([f.remote(i) for i in range(16)],
+                           timeout=60) == [i * 3 for i in range(16)]
+        pool = _pool()
+        assert pool.ring_stats == {"msgs": 0, "bytes": 0, "fallback": 0,
+                                   "full_waits": 0}
+        for h in pool._handles:
+            assert h.ring_in is None and h.ring_out is None
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oversized_envelope_falls_back_to_pipe():
+    """Tiny slots + fat args: the envelope exceeds max_msg, rides the
+    pipe, and the fallback counter records it — results unaffected."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=2,
+                 _system_config={"worker_mode": "process",
+                                 "control_ring_slot_bytes": 256,
+                                 "object_store_memory": 32 * 1024 * 1024})
+    try:
+        @ray_tpu.remote
+        def tail(s):
+            return s[-4:]
+
+        big = "y" * 4096  # inline arg >> 256-byte slots
+        assert ray_tpu.get([tail.remote(big) for _ in range(4)],
+                           timeout=60) == ["yyyy"] * 4
+        assert _pool().ring_stats["fallback"] >= 1
+    finally:
+        ray_tpu.shutdown()
