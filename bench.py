@@ -643,6 +643,42 @@ def main() -> int:
         OUT["trace_overhead"] = tro or None
         _emit()
 
+    # --- profile plane: continuous sampling profiler overhead ----------
+    # A/B of the e2e harness with the profile/utilization plane ENABLED
+    # (RAY_TPU_PROFILE_HZ=100 — sampler thread per worker + head, folded
+    # stack aggregation, resource samplers). Unlike the other planes the
+    # profiler is OFF by default, so here the instrumented lane is the
+    # env-override one and the baseline is the plain e2e number. The
+    # claim under test: 100 Hz sampling stays within ~10% of the
+    # unprofiled path on the BATCHED lanes.
+    if section("profile_overhead", 25):
+        pro = {}
+        for label, mode, n, batched in (
+                ("thread_batched", "thread", n_thread, True),
+                ("process_batched", "process", n_proc, True)):
+            try:
+                off = e2e.get(label)
+                if off is None:
+                    off = round(_e2e_subprocess(n, mode, batched)
+                                ["tasks_per_sec"], 1)
+                on = round(_e2e_subprocess(
+                    n, mode, batched,
+                    extra_env={"RAY_TPU_PROFILE_HZ": "100"})
+                    ["tasks_per_sec"], 1)
+                pro[label] = {
+                    "profile_on_tasks_per_sec": on,
+                    "profile_off_tasks_per_sec": off,
+                    "overhead_pct": round(100.0 * (off - on) / off, 1),
+                }
+                print(f"  profile overhead[{label}]: {on:.0f} tasks/s "
+                      f"at 100 Hz vs {off:.0f} unprofiled "
+                      f"({pro[label]['overhead_pct']}%)",
+                      file=sys.stderr)
+            except Exception:
+                traceback.print_exc()
+        OUT["profile_overhead"] = pro or None
+        _emit()
+
     # --- locality-aware scheduling: cross-node byte A/B ----------------
     # 2-remote-node cluster, large objects produced on one node, a
     # consumer fanout free to run on either. ON: the scheduler's

@@ -36,7 +36,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "cancel", "kill", "get_actor", "ObjectRef", "ActorHandle", "method",
     "available_resources", "cluster_resources", "nodes", "timeline",
-    "trace", "snapshot_cluster", "restore_cluster",
+    "trace", "profile", "snapshot_cluster", "restore_cluster",
     "get_runtime_context", "chaos", "__version__",
 ]
 
@@ -103,6 +103,46 @@ def trace(trace_id: Optional[str] = None, filename: Optional[str] = None):
             json.dump(events, f)
         return filename
     return events
+
+
+def profile(duration_s: float = 5.0, filename: Optional[str] = None):
+    """Flamegraph of the last ``duration_s`` seconds of cluster CPU
+    time, from the continuous profiler (requires ``profile_hz > 0``).
+
+    Snapshots the profile plane's folded-stack counts, sleeps
+    ``duration_s``, snapshots again and diffs — so the report covers
+    exactly the window, not the whole session. Returns a dict with
+    ``collapsed`` (Brendan Gregg folded-stack text), ``speedscope``
+    (drop the JSON on speedscope.app), ``top_tasks`` (samples + CPU
+    share by task) and ``samples``. With ``filename`` writes the
+    speedscope JSON (or the collapsed text for ``.txt``/``.folded``
+    names) and returns the path. Works over ray:// (stack counts read
+    head-side)."""
+    import time as _time
+
+    from ray_tpu._private import profile_plane as _pp
+    from ray_tpu.util.state import profile_stacks
+
+    key = (lambda r: (r["node"], r["task"], r["stack"]))
+    base = {key(r): r["count"] for r in profile_stacks()}
+    _time.sleep(duration_s)
+    rows = []
+    for r in profile_stacks():
+        delta = r["count"] - base.get(key(r), 0)
+        if delta > 0:
+            rows.append(dict(r, count=delta))
+    report = _pp.flamegraph_report(rows)
+    if filename is not None:
+        if filename.endswith((".txt", ".folded")):
+            with open(filename, "w") as f:
+                f.write(report["collapsed"])
+        else:
+            import json
+
+            with open(filename, "w") as f:
+                json.dump(report["speedscope"], f)
+        return filename
+    return report
 
 
 def init(*args, **kwargs):

@@ -15,6 +15,52 @@ import os
 import sys
 
 
+def _status_over_address(address: str) -> int:
+    """One-shot cluster health summary over ray://: node table (state,
+    REJOINING grace, daemon outbox depth), task counts, and the latest
+    utilization snapshot per node — which carries the head's internal
+    gauges (scheduler queue depths, inflight leases, failover count)
+    when the cluster runs with profile_hz > 0."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=address)
+    try:
+        nodes = state.list_nodes()
+        print(f"nodes ({len(nodes)}):")
+        for n in nodes:
+            line = (f"  [{n['index']}] {n['node_id'][:12]} "
+                    f"{n['state']:<9} {n['kind']:<7} "
+                    f"hb={n['heartbeat_age_s']:.1f}s")
+            if "rejoining_for_s" in n:
+                line += f" rejoining_for={n['rejoining_for_s']:.1f}s"
+            if "outbox_depth" in n:
+                line += (f" outbox={n['outbox_depth']}"
+                         f" replayed={n['outbox_replayed']}")
+            print(line)
+        print("tasks:")
+        for k, v in sorted(state.summarize_tasks().items()):
+            print(f"  {k}: {v}")
+        util = state.list_utilization()
+        latest: dict = {}
+        for r in util:
+            if r["points"]:
+                latest.setdefault(r["node"], {})[r["series"]] = \
+                    r["points"][-1][1]
+        if latest:
+            print("utilization (latest sample per node):")
+            for node in sorted(latest):
+                kv = " ".join(f"{s}={latest[node][s]:g}"
+                              for s in sorted(latest[node]))
+                print(f"  [{node}] {kv}")
+        else:
+            print("utilization: no samples (head runs with "
+                  "profile_hz=0?)")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
 def _cmd_status(args) -> int:
     if args.metrics_port:
         import urllib.request
@@ -23,6 +69,8 @@ def _cmd_status(args) -> int:
         body = urllib.request.urlopen(url, timeout=5).read().decode()
         print(body)
         return 0
+    if args.address:
+        return _status_over_address(args.address)
     import os
 
     import ray_tpu
@@ -300,6 +348,44 @@ def _cmd_trace(args) -> int:
     return 0
 
 
+def _cmd_profile(args) -> int:
+    """Profile the running cluster for a window and print the
+    top-tasks-by-CPU table, optionally exporting the flamegraph
+    (requires the head to run with profile_hz > 0)."""
+    if not args.address:
+        print("profile needs --address ray://host:port?key=... "
+              "(printed by `python -m ray_tpu start --head`)",
+              file=sys.stderr)
+        return 2
+    import ray_tpu
+
+    ray_tpu.init(address=args.address)
+    try:
+        report = ray_tpu.profile(args.duration)
+        if not report["samples"]:
+            print("no samples recorded over the window (head runs "
+                  "with profile_hz=0?)")
+            return 1
+        print(f"{report['samples']} samples over "
+              f"{args.duration:.1f}s")
+        print(f"{'node':>4} {'task':36} {'samples':>8} {'cpu%':>6}")
+        for r in report["top_tasks"]:
+            print(f"{r['node']:>4} {r['task'][:36]:36} "
+                  f"{r['samples']:>8} {r['cpu_pct']:>6.1f}")
+        if args.output:
+            if args.output.endswith((".txt", ".folded")):
+                with open(args.output, "w") as f:
+                    f.write(report["collapsed"])
+            else:
+                with open(args.output, "w") as f:
+                    json.dump(report["speedscope"], f)
+            print(f"wrote {args.output} — open in "
+                  f"https://www.speedscope.app")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
 def _cmd_summary(args) -> int:
     """Summarize a timeline JSON produced by ray_tpu.timeline()."""
     with open(args.trace) as f:
@@ -398,9 +484,14 @@ def main(argv=None) -> int:
     p.add_argument("--jax-process-id", type=int, default=-1)
     p.set_defaults(fn=_cmd_start)
 
-    p = sub.add_parser("status", help="show node/cluster resources")
+    p = sub.add_parser("status", help="show node/cluster resources, or "
+                       "a running cluster's health over --address")
     p.add_argument("--metrics-port", type=int, default=0,
                    help="scrape a running driver's metrics endpoint")
+    p.add_argument("--address", default="",
+                   help="ray://host:port?key=... of a running head: "
+                   "one-shot health summary (nodes, outbox depth, "
+                   "utilization snapshot, queue depths)")
     p.set_defaults(fn=_cmd_status)
 
     p = sub.add_parser("microbenchmark",
@@ -453,6 +544,17 @@ def main(argv=None) -> int:
     p.add_argument("--address", default="",
                    help="ray://host:port?key=... of a running head")
     p.set_defaults(fn=_cmd_trace)
+
+    p = sub.add_parser("profile", help="flamegraph + top-tasks-by-CPU "
+                       "from the continuous profiler")
+    p.add_argument("-d", "--duration", type=float, default=5.0,
+                   help="profiling window in seconds (default: 5)")
+    p.add_argument("-o", "--output", default="",
+                   help="write the flamegraph here: speedscope JSON, "
+                   "or folded-stack text for .txt/.folded names")
+    p.add_argument("--address", default="",
+                   help="ray://host:port?key=... of a running head")
+    p.set_defaults(fn=_cmd_profile)
 
     p = sub.add_parser("summary", help="summarize a timeline trace")
     p.add_argument("trace", help="JSON from ray_tpu.timeline(file)")

@@ -60,7 +60,7 @@ _STATE_VERBS = frozenset({
     "list_tasks", "list_actors", "list_objects", "list_nodes",
     "list_placement_groups", "summarize_tasks", "list_data_streams",
     "list_faults", "list_logs", "get_log", "task_timeline",
-    "list_traces", "get_trace",
+    "list_traces", "get_trace", "profile_stacks", "list_utilization",
 })
 
 

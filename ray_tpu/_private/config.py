@@ -315,6 +315,25 @@ _d("trace_log_markers", bool, False,
    "worker's capture file at exec start of each sampled task, so "
    "get_log output correlates with spans; off by default to keep "
    "capture files byte-stable for log-plane consumers")
+_d("profile_hz", float, 0.0,
+   "continuous-profiler sampling rate: every process worker (and the "
+   "head) walks sys._current_frames() profile_hz times a second and "
+   "ships folded-stack counts tagged with the running task; 0 (the "
+   "default, and the bench A/B baseline) disables the whole "
+   "profile/utilization plane — no sampler threads, no wire traffic")
+_d("utilization_interval_s", float, 1.0,
+   "per-node resource sampling cadence (/proc/stat, /proc/meminfo, shm "
+   "arena + control-ring + scheduler gauges) while the profile plane "
+   "is on (profile_hz > 0); also the fixed downsampling interval of "
+   "the head-side utilization ring")
+_d("utilization_ring", int, 512,
+   "bounded points kept per (node, series) in the head-side "
+   "utilization time-series ring; oldest points fall off")
+_d("profile_stacks_max", int, 20000,
+   "bounded distinct (node, task, stack) folded-stack counts kept "
+   "head-side by the profile plane; least recently bumped entries are "
+   "evicted (counted in ray_tpu_profile_samples_dropped_total's "
+   "sibling summary)")
 
 # -- testing / fault injection --------------------------------------------
 _d("testing_inject_task_failure_prob", float, 0.0,

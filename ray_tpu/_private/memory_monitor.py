@@ -30,17 +30,12 @@ logger = logging.getLogger(__name__)
 
 
 def host_memory() -> Tuple[int, int]:
-    """(used_bytes, total_bytes) from /proc/meminfo."""
-    total = available = 0
-    with open("/proc/meminfo") as f:
-        for line in f:
-            if line.startswith("MemTotal:"):
-                total = int(line.split()[1]) * 1024
-            elif line.startswith("MemAvailable:"):
-                available = int(line.split()[1]) * 1024
-            if total and available:
-                break
-    return total - available, total
+    """(used_bytes, total_bytes) from /proc/meminfo — the profile
+    plane's shared parser (one /proc reader for the monitor, the
+    utilization sampler, and anything else that needs host memory)."""
+    from ray_tpu._private.profile_plane import read_meminfo
+
+    return read_meminfo()
 
 
 class MemoryMonitor:

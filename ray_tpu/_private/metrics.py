@@ -305,6 +305,40 @@ def _render_core(worker) -> List[str]:
          "ring-full backpressure events observed by producers before "
          "falling back to the pipe", ring["full_waits"])
 
+    # profile/utilization plane: sampler accounting + the latest value
+    # of each node's resource series (zero-valued with an empty label
+    # set when profile_hz=0 so scrapers see a stable family set)
+    pp = getattr(worker, "profile_plane", None)
+    psum = pp.summary() if pp is not None else {}
+    emit("ray_tpu_profile_samples_recorded_total", "counter",
+         "folded stack samples recorded by the head profile plane "
+         "(all nodes)", psum.get("samples_recorded", 0))
+    emit("ray_tpu_profile_samples_dropped_total", "counter",
+         "stack samples lost to bounded sampler buffers or evicted "
+         "from the head stack table",
+         psum.get("samples_dropped", 0) + psum.get("stacks_evicted", 0))
+    latest = pp.utilization_latest() if pp is not None else {}
+    for name, desc, series in (
+            ("ray_tpu_node_cpu_percent",
+             "host CPU utilization sampled from /proc/stat deltas",
+             "cpu_percent"),
+            ("ray_tpu_node_rss_bytes",
+             "resident set size of the node's runtime process",
+             "rss_bytes"),
+            ("ray_tpu_node_arena_used_bytes",
+             "shm object-arena bytes in use on the node",
+             "arena_used_bytes")):
+        lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} gauge")
+        total = 0.0
+        for node in sorted(latest):
+            v = latest[node].get(series)
+            if v is None:
+                continue
+            lines.append(f'{name}{{node="{node}"}} {v}')
+            total += v
+        lines.append(f"{name} {round(total, 2)}")
+
     from ray_tpu._private.chaos import get_controller
     chaos = get_controller().counters()
     for name, desc, per_site, total in (

@@ -148,8 +148,10 @@ class _Outbox:
 
 
 # daemon->head tags that ride the outbox (report-class: the head must
-# not lose them across a blackout); everything else is sent bare
-_OUTBOX_TAGS = frozenset(("w", "worker_died", "pulled", "log"))
+# not lose them across a blackout); everything else is sent bare.
+# "util" = resource samples for the utilization ring; "w"-wrapped
+# worker "prof" batches are covered by "w" itself
+_OUTBOX_TAGS = frozenset(("w", "worker_died", "pulled", "log", "util"))
 
 
 class _WorkerSlot:
@@ -594,6 +596,10 @@ class NodeDaemon:
         from ray_tpu._private import log_plane, spawn_env
         from ray_tpu._private.config import GLOBAL_CONFIG
         extra = {"RAY_TPU_AUTHKEY": self._authkey.hex()}
+        if GLOBAL_CONFIG.profile_hz > 0:
+            # propagate the head's profile knob (this daemon got it the
+            # same way, via its own spawn env) so remote workers sample
+            extra["RAY_TPU_PROFILE_HZ"] = str(GLOBAL_CONFIG.profile_hz)
         stem = (f"worker-{wid_hex}" if wid_hex
                 else f"worker-{num}-{os.getpid()}")
         log_env = log_plane.child_log_env(
@@ -812,6 +818,33 @@ class NodeDaemon:
                     "utf-8", "replace").split("\n")
                 if lines:
                     self._send_head(("log", n, lines))
+
+    # ------------------------------------------------------------------
+    # utilization sampling (profile plane, profile_hz > 0 only)
+    # ------------------------------------------------------------------
+    def _ship_util(self, payload: dict) -> None:
+        """One resource sample for the head's utilization ring. "util"
+        is report-class (rides the outbox), so samples taken during a
+        head blackout land, deduped and in order, after rejoin."""
+        self._send_head(("util", payload))
+
+    def _start_util_sampler(self) -> None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        if GLOBAL_CONFIG.profile_hz <= 0 \
+                or GLOBAL_CONFIG.utilization_interval_s <= 0:
+            return
+        from ray_tpu._private import profile_plane
+
+        store = self.store
+
+        def _arena_used() -> int:
+            return max(store.arena.size - store.arena.free_bytes(), 0)
+
+        self._util_sampler = profile_plane.ResourceSampler(
+            GLOBAL_CONFIG.utilization_interval_s, self._ship_util,
+            gauges={"arena_used_bytes": _arena_used},
+            name="ray_tpu_node_util").start()
 
     # ------------------------------------------------------------------
     # peer transfer plane (direct node-to-node pulls)
@@ -1059,6 +1092,7 @@ class NodeDaemon:
                          name="ray_tpu_node_accept").start()
         threading.Thread(target=self._log_tail_loop, daemon=True,
                          name="ray_tpu_node_log_tail").start()
+        self._start_util_sampler()
         while not self._shutdown:
             try:
                 msg = self._head.recv()
@@ -1260,6 +1294,9 @@ class NodeDaemon:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        sampler = getattr(self, "_util_sampler", None)
+        if sampler is not None:
+            sampler.stop()
         with self._lock:
             slots = list(self._slots.values())
         for s in slots:

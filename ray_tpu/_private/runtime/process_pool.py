@@ -269,6 +269,11 @@ class ProcessWorkerPool:
         # costs seconds of import, a device-lease fight, and (with a
         # degraded tunnel) an indefinite hang at `import jax`
         extra = {"RAY_TPU_AUTHKEY": self._authkey.hex()}
+        if GLOBAL_CONFIG.profile_hz > 0:
+            # the owner may have been configured via _system_config (no
+            # env var) — re-export so the fresh interpreter's GLOBAL_CONFIG
+            # starts its profile sampler
+            extra["RAY_TPU_PROFILE_HZ"] = str(GLOBAL_CONFIG.profile_hz)
         log_dir = log_plane.get_session_log_dir()
         if log_dir:
             stem = f"worker-{h.worker_id.hex()[:12]}"
@@ -1027,6 +1032,13 @@ class ProcessWorkerPool:
                                  msg[4] if len(msg) > 4 else None)
             elif kind == "rpc":
                 self._on_rpc(h, msg[1], msg[2], msg[3])
+            elif kind == "prof":
+                # folded-stack batch from the worker's profile sampler;
+                # shared branch covers local pipes AND daemon-forwarded
+                # ("w", ...) reports from remote workers
+                pp = getattr(self._worker, "profile_plane", None)
+                if pp is not None:
+                    pp.record_batch(self.node_index, msg[1])
         except Exception:
             logger.exception("pool reader failed handling %s", kind)
 

@@ -228,6 +228,9 @@ class _WorkerRunner:
         self.fn_cache: Dict[bytes, Any] = {}
         self.actor_instance: Any = None  # set by actor_create (dedicated)
         self.current_task_id: Optional[TaskID] = None
+        # the running task's user-facing name: the profile sampler tags
+        # folded stacks "name:taskid" so flamegraphs read in task terms
+        self.current_task_name: Optional[str] = None
         # the running task's TraceContext (from the payload's "trace"
         # key), re-shipped with nested submissions / actor calls so
         # parentage crosses the process boundary
@@ -245,11 +248,17 @@ class _WorkerRunner:
 
     def _emit(self, msg: tuple) -> None:
         """Completion message: buffered during a leased batch (one pipe
-        write per batch, one owner wakeup), immediate otherwise."""
+        write per batch, one owner wakeup), immediate otherwise.
+
+        Pipe writes here and below take _rpc_lock: the profile sampler
+        thread shares this pipe for its ("prof", ...) batches, and
+        interleaved frames would corrupt the stream. Uncontended (the
+        sampler does not exist) when profile_hz=0."""
         if self._done_buf is not None:
             self._done_buf.append(msg)
         else:
-            self.conn.send(msg)
+            with self._rpc_lock:
+                self.conn.send(msg)
 
     def _flush_dones(self) -> None:
         buf = self._done_buf
@@ -262,10 +271,11 @@ class _WorkerRunner:
                 return
         # pipe path: no ring, envelope-ineligible items, oversize, or
         # ring full — exactly the pre-ring framed messages
-        if len(buf) == 1:
-            self.conn.send(buf[0])
-        else:
-            self.conn.send(("many", buf))
+        with self._rpc_lock:
+            if len(buf) == 1:
+                self.conn.send(buf[0])
+            else:
+                self.conn.send(("many", buf))
 
     def _ring_emit(self, msg: tuple) -> bool:
         """Publish one completion envelope on the shm ring + pipe
@@ -278,7 +288,8 @@ class _WorkerRunner:
         data = _RING_TAG_BYTE[msg[0]] + msg[1]
         if len(data) > ring.max_msg or not ring.try_put(data):
             return False
-        self.conn.send(("cring",))
+        with self._rpc_lock:
+            self.conn.send(("cring",))
         return True
 
     # -- RPC to the owner --------------------------------------------------
@@ -484,8 +495,10 @@ class _WorkerRunner:
         prev_task_id = self.current_task_id
         prev_put_counter = self.put_counter
         prev_trace = self.current_trace
+        prev_task_name = self.current_task_name
         self.current_task_id = task_id
         self.current_trace = payload.get("trace")
+        self.current_task_name = payload.get("name")
         self.put_counter = 0
         if self.current_trace is not None and payload.get("trace_mark"):
             # correlation marker for the log plane (trace_log_markers
@@ -593,6 +606,7 @@ class _WorkerRunner:
             self.cancelled.discard(task_id.binary())
             self.current_task_id = prev_task_id
             self.current_trace = prev_trace
+            self.current_task_name = prev_task_name
             self.put_counter = prev_put_counter
 
     def _resolve(self, v: Any) -> Any:
@@ -651,7 +665,8 @@ class _WorkerRunner:
     def run(self) -> None:
         threading.Thread(target=self._ctrl_loop, daemon=True,
                          name="ray_tpu_worker_ctrl").start()
-        self.conn.send(("ready", os.getpid()))
+        with self._rpc_lock:
+            self.conn.send(("ready", os.getpid()))
         while not self._stop:
             if self._inbox:
                 msg = self._inbox.pop(0)
@@ -700,9 +715,41 @@ def worker_main(conn, ctrl_conn, arena_name: str, inline_max: int,
     from ray_tpu._private import worker as worker_mod
 
     worker_mod.global_worker = ProcessWorkerContext(runner)  # type: ignore
+    sampler = None
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if GLOBAL_CONFIG.profile_hz > 0:
+        # continuous profiler: folded main-thread stacks tagged with
+        # the running task, batched over the owner pipe (daemon-spawned
+        # workers: the daemon forwards them as outbox-covered ("w", ...)
+        # reports, so samples survive a head blackout + rejoin)
+        from ray_tpu._private import profile_plane
+
+        def _label() -> Optional[str]:
+            tid = runner.current_task_id
+            if tid is None:
+                return None
+            return f"{runner.current_task_name or 'task'}:{tid.hex()[:8]}"
+
+        def _ship(payload: dict) -> bool:
+            # non-blocking: never stall sampling behind a task blocked
+            # inside a get/wait rpc (which holds _rpc_lock throughout)
+            if not runner._rpc_lock.acquire(blocking=False):
+                return False
+            try:
+                runner.conn.send(("prof", payload))
+            finally:
+                runner._rpc_lock.release()
+            return True
+
+        sampler = profile_plane.StackSampler(
+            GLOBAL_CONFIG.profile_hz, _ship, label_fn=_label,
+            name="ray_tpu_profile_worker").start()
     try:
         runner.run()
     finally:
+        if sampler is not None:
+            sampler.stop()
         if runner.arena is not None:
             runner.arena.close()
 

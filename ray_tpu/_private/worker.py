@@ -505,6 +505,16 @@ class Worker:
                             if (GLOBAL_CONFIG.trace_sample_rate > 0
                                 and GLOBAL_CONFIG.traces_max != 0)
                             else None)
+        # profile/utilization plane: continuous sampling profiler +
+        # per-node resource time series (None when profile_hz=0, the
+        # default — no sampler threads anywhere, every producer hook is
+        # a None check, metric families render schema-stable zeros)
+        self.profile_plane = None
+        if GLOBAL_CONFIG.profile_hz > 0:
+            from ray_tpu._private.profile_plane import ProfilePlane
+            self.profile_plane = ProfilePlane()
+            self.profile_plane.start_head_samplers(
+                gauges=self._head_util_gauges())
         # locality column input: the scheduler reads copy locations
         # straight off the GCS object directory (primary first)
         self.scheduler.locations_of = self.gcs.object_locations
@@ -817,6 +827,45 @@ class Worker:
         if pool is not None and getattr(pool, "is_remote", False):
             return getattr(pool, "peer_address", None)
         return None
+
+    def _head_util_gauges(self) -> dict:
+        """Internal gauges the head's resource sampler folds into node
+        0's utilization series: shm arena occupancy, scheduler queue
+        depths, inflight leases, control-ring traffic. Closures are
+        evaluated once per utilization_interval_s tick, so the cheap
+        locked reads below never touch a hot path."""
+        def _arena_used() -> int:
+            arena = getattr(getattr(self, "shm_store", None), "arena",
+                            None)
+            if arena is None:
+                return 0
+            return max(arena.size - arena.free_bytes(), 0)
+
+        def _sched(key: str):
+            def g():
+                return self.scheduler.stats().get(key, 0)
+            return g
+
+        def _ring(key: str):
+            def g():
+                total = 0
+                for e in self.gcs.node_table():
+                    rs = getattr(e.pool, "ring_stats", None)
+                    if rs:
+                        total += rs.get(key, 0)
+                return total
+            return g
+
+        return {
+            "arena_used_bytes": _arena_used,
+            "sched_ready_queue": _sched("ready_queue"),
+            "sched_waiting_deps": _sched("waiting_deps"),
+            "inflight_tasks": _sched("running"),
+            "ring_msgs_total": _ring("msgs"),
+            "ring_fallback_total": _ring("fallback"),
+            "head_failovers": lambda: getattr(self.gcs,
+                                              "head_failovers", 0),
+        }
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         self._drain_out_of_scope()
@@ -1491,6 +1540,13 @@ class Worker:
         # can't hang its `import jax` (see spawn_env docstring)
         from ray_tpu._private import log_plane, spawn_env
         extra = {"RAY_TPU_HEAD_AUTHKEY": self._head_server.authkey.hex()}
+        if GLOBAL_CONFIG.profile_hz > 0:
+            # hand the daemon the head's live profile knobs (they may
+            # have arrived via _system_config, not env) so it starts
+            # its utilization sampler and re-exports to its workers
+            extra["RAY_TPU_PROFILE_HZ"] = str(GLOBAL_CONFIG.profile_hz)
+            extra["RAY_TPU_UTILIZATION_INTERVAL_S"] = str(
+                GLOBAL_CONFIG.utilization_interval_s)
         if self.session_log_dir is not None:
             # the daemon's own node log dir nests under the head's
             # session dir (same-host clusters; a true remote host just
@@ -2506,6 +2562,8 @@ class Worker:
         self.scheduler.shutdown()
         self.gcs.shutdown()
         self.memory_monitor.shutdown()
+        if self.profile_plane is not None:
+            self.profile_plane.shutdown()
         if self.metrics_server is not None:
             self.metrics_server.shutdown()
         for row, pool in list(self._node_pools.items()):

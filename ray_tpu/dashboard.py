@@ -94,6 +94,11 @@ td.st-finished::before { background: var(--good); }
   <div id="chartwrap"><svg id="tp" width="880" height="120"
     role="img" aria-label="tasks finished per second over the last two minutes"></svg>
   <div id="tp-tip"></div></div></div>
+<div class="panel"><h2>Utilization</h2>
+<div class="sub">per-node resource series from the profile plane
+&middot; <a href="/api/flamegraph" download="profile.speedscope.json"
+id="fg-link">download flamegraph (speedscope json)</a></div>
+<div id="util"></div></div>
 <div class="panel"><h2>Nodes</h2><div id="nodes"></div></div>
 <div class="panel"><h2>Task summary</h2><div id="tasks"></div></div>
 <div class="panel"><h2>Recent tasks (dep-wait &middot; queue &middot; exec)</h2>
@@ -111,6 +116,9 @@ td.st-finished::before { background: var(--good); }
 <a href="/api/task_events">task_events</a>
 <a href="/api/timeline">timeline</a>
 <a href="/api/traces">traces</a>
+<a href="/api/utilization">utilization</a>
+<a href="/api/profile_stacks">profile_stacks</a>
+<a href="/api/flamegraph">flamegraph</a>
 <a href="/api/logs">logs</a>
 <a href="/api/jobs">jobs</a><a href="/metrics">metrics</a></div>
 <script>
@@ -185,6 +193,53 @@ function taskDetailRows(list) {
   }).join("");
   return `<table><thead><tr>${head}</tr></thead>` +
     `<tbody>${body}</tbody></table>`;
+}
+
+function spark(points, w, h) {
+  // inline sparkline for one utilization series (numbers only — no
+  // cluster strings enter the markup)
+  if (!points || points.length < 2) { return ""; }
+  const vs = points.map(p => Number(p[1]) || 0);
+  const vmax = Math.max(...vs), vmin = Math.min(...vs, 0);
+  const x = i => 1 + (w - 2) * i / (points.length - 1);
+  const y = v => h - 2 - (h - 4) * (v - vmin) / ((vmax - vmin) || 1);
+  let d = "";
+  vs.forEach((v, i) => {
+    d += (i ? "L" : "M") + x(i).toFixed(1) + " " + y(v).toFixed(1);
+  });
+  return `<svg width="${w}" height="${h}" role="img"><path d="${d}"
+    fill="none" stroke="var(--series-1)" stroke-width="1.5"
+    stroke-linejoin="round"/></svg>`;
+}
+
+function fmtBytes(v) {
+  v = Number(v) || 0;
+  return v >= 1 << 30 ? (v / (1 << 30)).toFixed(1) + "GB"
+    : (v / (1 << 20)).toFixed(0) + "MB";
+}
+
+function utilRows(util) {
+  if (!util || !util.length) {
+    return '<div class="sub">no samples (head runs with profile_hz=0)</div>';
+  }
+  const series = ["cpu_percent", "rss_bytes", "arena_used_bytes"];
+  const byNode = {};
+  for (const r of util) {
+    (byNode[r.node] = byNode[r.node] || {})[r.series] = r;
+  }
+  const head = ["node", ...series].map(c => `<th>${esc(c)}</th>`).join("");
+  const body = Object.keys(byNode).sort((a, b) => a - b).map(n => {
+    const cells = series.map(s => {
+      const r = byNode[n][s];
+      if (!r || !r.points.length) { return "<td>–</td>"; }
+      const last = Number(r.points[r.points.length - 1][1]) || 0;
+      const label = s === "cpu_percent" ? last.toFixed(1) + "%"
+        : fmtBytes(last);
+      return `<td>${spark(r.points.slice(-48), 140, 26)} ${label}</td>`;
+    }).join("");
+    return `<tr><td>${Number(n)}</td>${cells}</tr>`;
+  }).join("");
+  return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
 }
 
 function drawChart() {
@@ -266,11 +321,12 @@ async function viewLog(f) {
 
 async function refresh() {
   try {
-    const [s, actors, taskEvents, traces] = await Promise.all([
+    const [s, actors, taskEvents, traces, util] = await Promise.all([
       fetch("/api/summary").then(r => r.json()),
       fetch("/api/actors").then(r => r.json()),
       fetch("/api/task_events").then(r => r.json()).catch(() => []),
       fetch("/api/traces").then(r => r.json()).catch(() => []),
+      fetch("/api/utilization").then(r => r.json()).catch(() => []),
     ]);
     refreshLogs().catch(() => {});
     const nodes = s.nodes || [];
@@ -300,6 +356,16 @@ async function refresh() {
       tile("ingest overlap", (s.data_streams || []).length ?
            (100 * (s.data_streams[s.data_streams.length - 1]
                      .overlap_fraction || 0)).toFixed(0) + "%" : "–");
+    const ring = s.control_ring;
+    if (ring) {
+      document.getElementById("tiles").innerHTML +=
+        tile("ring msgs", ring.msgs ?? 0) +
+        tile("ring bytes", fmtBytes(ring.bytes ?? 0)) +
+        tile("ring fallbacks", ring.fallback ?? 0,
+             ring.fallback ? "critical" : null) +
+        tile("ring full-waits", ring.full_waits ?? 0);
+    }
+    document.getElementById("util").innerHTML = utilRows(util);
     const lat = s.task_latency;
     if (lat && lat.n) {
       document.getElementById("tiles").innerHTML +=
@@ -379,6 +445,25 @@ class Dashboard:
 
             return call
 
+        def ring_totals() -> dict:
+            """Control-ring counters summed over pools (the same
+            numbers as the ray_tpu_control_ring_* metric families)."""
+            ring = {"msgs": 0, "bytes": 0, "fallback": 0,
+                    "full_waits": 0}
+            for e in worker.gcs.node_table():
+                rs = getattr(e.pool, "ring_stats", None)
+                if rs:
+                    for k in ring:
+                        ring[k] += rs.get(k, 0)
+            return ring
+
+        def flamegraph() -> dict:
+            """Speedscope document over every resident folded stack —
+            save the response and drop it on speedscope.app."""
+            from ray_tpu._private import profile_plane
+
+            return profile_plane.speedscope(state.profile_stacks())
+
         routes = {
             "/api/tasks": lambda: state.list_tasks(),
             # live rows + the durable FINISHED/FAILED ring, with
@@ -396,12 +481,19 @@ class Dashboard:
                 lambda: state.list_placement_groups(),
             "/api/data_streams": lambda: state.list_data_streams(),
             "/api/logs": lambda: state.list_logs(),
+            # profile plane: per-node utilization series + folded
+            # stacks (the Utilization panel source); empty when the
+            # plane is disabled (profile_hz=0)
+            "/api/utilization": lambda: state.list_utilization(),
+            "/api/profile_stacks": lambda: state.profile_stacks(),
+            "/api/flamegraph": flamegraph,
             "/api/jobs": lambda: {
                 j.hex(): meta
                 for j, meta in worker.gcs.job_table().items()},
             "/api/summary": lambda: {
                 "tasks": state.summarize_tasks(),
                 "scheduler": worker.scheduler.stats(),
+                "control_ring": ring_totals(),
                 "task_latency": (
                     worker.task_events.latency_summary()
                     if getattr(worker, "task_events", None) is not None

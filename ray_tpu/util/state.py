@@ -272,6 +272,49 @@ def get_trace(trace_id: str) -> List[Dict[str, Any]]:
 
 
 @_client_dispatch
+def profile_stacks() -> List[Dict[str, Any]]:
+    """Resident folded-stack counts from the profile plane, highest
+    sample count first: {node, node_id, task, stack, count} where
+    ``task`` is "name:taskid8" for samples taken inside a task and
+    "idle"/a thread name otherwise. Empty when the plane is disabled
+    (``profile_hz=0``, the default)."""
+    w = worker_mod.get_worker()
+    pp = getattr(w, "profile_plane", None)
+    if pp is None:
+        return []
+    ids = {e.index: e.node_id.hex() for e in w.gcs.node_table()}
+    rows = pp.profile_stacks()
+    for r in rows:
+        r["node_id"] = ids.get(r["node"], "")
+    return rows
+
+
+@_client_dispatch
+def list_utilization(node_id: Optional[str] = None,
+                     series: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Utilization time series from the profile plane's head-side
+    ring: {node, node_id, series, points: [[ts, value], ...]} with
+    every timestamp on the HEAD's clock axis (daemon samples are
+    shifted by the link's clock offset). ``node_id`` prefix-filters
+    like ``get_trace``; ``series`` selects one series (e.g.
+    "cpu_percent"). Empty when the plane is disabled
+    (``profile_hz=0``)."""
+    w = worker_mod.get_worker()
+    pp = getattr(w, "profile_plane", None)
+    if pp is None:
+        return []
+    ids = {e.index: e.node_id.hex() for e in w.gcs.node_table()}
+    out = []
+    for r in pp.list_utilization(series=series):
+        nid = ids.get(r["node"], "")
+        if node_id is not None and not nid.startswith(node_id):
+            continue
+        r["node_id"] = nid
+        out.append(r)
+    return out
+
+
+@_client_dispatch
 def summarize_tasks() -> Dict[str, int]:
     """Counts by state (reference: ray summary tasks). Includes
     FAILED_TOTAL and per-error-type FAILED(<Type>) counts from the task

@@ -400,6 +400,55 @@ class TestWireProtocol:
         # the conformant env tag raises nothing
         assert not any(k.split(":")[-1] == "env" for k in keys), keys
 
+    def test_profile_channel_drift_caught(self, tmp_path):
+        """Profile-plane satellite: the sampler's ("prof", payload)
+        batches ride the existing worker pipe and the daemon's ("util",
+        payload) reports ride the outbox link, so the real channel
+        table grew no new send/recv FILES — the new tags flow through
+        already-declared callees and are validated by the same pass.
+        This fixture injects the drift that WOULD appear if the two
+        halves diverged: a prof batch whose recv expects an element the
+        sampler never ships, and a util tag shipped with no dispatch
+        branch at the head."""
+        _write(tmp_path, "wkr.py", """
+            def ship(conn, payload):
+                conn.send(("prof", payload))
+            """)
+        _write(tmp_path, "recv_prof.py", """
+            def handle(msg):
+                kind = msg[0]
+                if kind == "prof":
+                    # expects a node index the worker never ships
+                    return msg[2]
+                return None
+            """)
+        _write(tmp_path, "daemon.py", """
+            def ship_util(self, payload):
+                self._send_head(("util", payload))
+            """)
+        _write(tmp_path, "recv_util.py", """
+            def dispatch(msg):
+                kind = msg[0]
+                if kind == "clock":
+                    return msg[1]
+                return None
+            """)
+        channels = [
+            ChannelSpec(name="w2o_prof",
+                        sends=[SendSpec("wkr.py", "send")],
+                        recvs=[RecvSpec("recv_prof.py", "handle")]),
+            ChannelSpec(name="d2h_util",
+                        sends=[SendSpec("daemon.py", "_send_head")],
+                        recvs=[RecvSpec("recv_util.py", "dispatch")]),
+        ]
+        keys = _keys(wire_protocol.analyze(str(tmp_path), _mk,
+                                           channels=channels,
+                                           op_channels=[]))
+        assert any(k.startswith("wire:arity:") and "prof" in k
+                   for k in keys), keys
+        assert any(k.startswith("wire:sent-unhandled:") and "util" in k
+                   for k in keys), keys
+
     def test_real_channels_have_no_drift(self):
         # satellite (f): remote_pool<->node_daemon (and the other three
         # channels) must agree on tags and arities; the daemon/demux

@@ -438,7 +438,12 @@ def _soak_run(seed):
     ray_tpu.init(num_cpus=8, num_workers=2,
                  _system_config={"worker_mode": "process",
                                  "object_store_memory": 32 * 1024 * 1024,
-                                 "task_retry_delay_s": 0.02})
+                                 "task_retry_delay_s": 0.02,
+                                 # keep the profile plane hot during the
+                                 # soak: worker kills + retries exercise
+                                 # the "prof"/"util" channels under the
+                                 # armed sanitizer's wire schema checks
+                                 "profile_hz": 25.0})
     try:
         chaos.arm(chaos.FaultPlan(seed, faults=SOAK_PLAN))
 

@@ -1,0 +1,629 @@
+"""Profile plane: continuous profiling + utilization time series.
+
+Reference surface: py-spy-style sampling grafted onto the framework's
+own threads — a StackSampler per process worker (and on the head)
+walking sys._current_frames() at profile_hz, folding stacks tagged
+with the currently-executing task, batches riding the EXISTING links
+(the worker pipe as ("prof", ...), the daemon outbox as ("util", ...))
+into one head-side ProfilePlane: a bounded folded-stack table plus a
+bounded per-(node, series) UtilizationRing with off-head timestamps
+aligned onto the head's clock.  Consumers: ``ray_tpu.profile()``
+flamegraph export, ``state.profile_stacks()`` /
+``state.list_utilization()`` over ray://, ``python -m ray_tpu
+profile`` / ``status --address``, the dashboard Utilization panel and
+the ``ray_tpu_node_*`` / ``ray_tpu_profile_samples_*`` metric
+families.  Disabled contract: ``profile_hz=0`` (the default) leaves
+``worker.profile_plane`` as None — no sampler threads anywhere,
+schema-stable zero metrics.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import profile_plane
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.profile_plane import (CpuPercent, ProfilePlane,
+                                            ResourceSampler, StackSampler,
+                                            UtilizationRing, collapsed,
+                                            flamegraph_report, fold_stack,
+                                            read_meminfo, read_proc_stat,
+                                            read_self_rss, speedscope,
+                                            top_tasks)
+from ray_tpu.util import state
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ON_LINUX = os.path.exists("/proc/stat")
+
+
+def _poll(fn, timeout=30.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while True:
+        out = fn()
+        if out or time.monotonic() >= deadline:
+            return out
+        time.sleep(interval)
+
+
+def _burn(seconds):
+    end = time.time() + seconds
+    x = 0
+    while time.time() < end:
+        x += 1
+    return x
+
+
+# ----------------------------------------------------------------------
+# /proc parsers (the ONE implementation memory_monitor also uses)
+# ----------------------------------------------------------------------
+
+class TestParsers:
+    def test_read_meminfo_shape(self):
+        used, total = read_meminfo()
+        assert total >= 1
+        assert 0 <= used <= total
+
+    def test_host_memory_delegates_to_shared_parser(self):
+        # satellite: memory_monitor.host_memory() must be the same
+        # parser, not a second /proc/meminfo reader that can drift
+        from ray_tpu._private import memory_monitor
+        used, total = memory_monitor.host_memory()
+        assert (used, total) != (0, 0)
+        assert total == read_meminfo()[1]
+
+    @pytest.mark.skipif(not ON_LINUX, reason="needs /proc")
+    def test_read_self_rss_positive(self):
+        assert read_self_rss() > 0
+
+    @pytest.mark.skipif(not ON_LINUX, reason="needs /proc")
+    def test_proc_stat_and_cpu_percent(self):
+        busy, total = read_proc_stat()
+        assert 0 <= busy <= total
+        cpu = CpuPercent()
+        assert cpu.sample() >= 0.0  # deltas, never negative
+        _burn(0.05)
+        assert 0.0 <= cpu.sample() <= 100.0
+
+    def test_fold_stack_root_first(self):
+        def inner():
+            return fold_stack(sys._getframe())
+
+        def outer():
+            return inner()
+
+        folded = outer()
+        frames = folded.split(";")
+        # leaf is LAST (collapsed-format convention), caller before it
+        assert frames[-1].endswith(".inner")
+        assert frames[-2].endswith(".outer")
+        assert all("." in f for f in frames)
+
+
+# ----------------------------------------------------------------------
+# StackSampler units (in-process, no runtime)
+# ----------------------------------------------------------------------
+
+class TestStackSampler:
+    def test_samples_main_thread_with_task_label(self):
+        got = []
+        s = StackSampler(hz=250.0, flush=lambda p: got.append(p),
+                         label_fn=lambda: "mytask:abcd1234",
+                         flush_interval_s=0.1)
+        s.start()
+        try:
+            _burn(0.6)
+        finally:
+            s.stop()
+            s._thread.join(timeout=5)
+        assert s.samples_taken > 0
+        samples = [t for p in got for t in p["samples"]]
+        assert samples, got
+        assert {lbl for lbl, _, _ in samples} == {"mytask:abcd1234"}
+        # the sampled stack is the main thread's — i.e. THIS test
+        assert any("_burn" in stack for _, stack, _ in samples)
+
+    def test_all_threads_mode_labels_by_thread_name(self):
+        got = []
+        stop = threading.Event()
+        t = threading.Thread(target=stop.wait, name="park_me",
+                             daemon=True)
+        t.start()
+        s = StackSampler(hz=250.0, flush=lambda p: got.append(p),
+                         all_threads=True, flush_interval_s=0.1)
+        s.start()
+        try:
+            _burn(0.5)
+        finally:
+            s.stop()
+            s._thread.join(timeout=5)
+            stop.set()
+        labels = {lbl for p in got for lbl, _, _ in p["samples"]}
+        # a blocked thread still has frames — it shows up by name
+        assert "park_me" in labels, labels
+        assert "MainThread" in labels, labels
+
+    def test_declined_flush_rebuffers_and_retries(self):
+        calls = []
+
+        def flaky(payload):
+            calls.append(payload)
+            return len(calls) > 1  # decline the first flush
+
+        s = StackSampler(hz=0, flush=flaky)
+        s._buf = {("a", "x;y"): 3}
+        assert s._try_flush() is False
+        assert s._buf == {("a", "x;y"): 3}  # counts intact
+        s._buf[("a", "x;y")] += 2
+        assert s._try_flush() is True
+        assert s._buf == {}
+        # nothing lost across the retry: 3 declined + 2 new = 5
+        assert calls[-1]["samples"] == [("a", "x;y", 5)]
+
+    def test_bounded_buffer_counts_overflow(self):
+        s = StackSampler(hz=0, flush=lambda p: False, max_keys=1)
+        s._buf = {("a", "x"): 1, ("b", "y"): 2}
+        assert s._try_flush() is False
+        # only one key fits back; the other is counted, not kept
+        assert len(s._buf) == 1
+        assert s._dropped >= 1
+        got = []
+        s._flush = lambda p: got.append(p)
+        assert s._try_flush() is True
+        assert got[0]["dropped"] >= 1
+
+    def test_hz_zero_never_starts_a_thread(self):
+        s = StackSampler(hz=0, flush=lambda p: None).start()
+        assert not s._thread.is_alive()
+
+
+# ----------------------------------------------------------------------
+# ResourceSampler + UtilizationRing units
+# ----------------------------------------------------------------------
+
+class TestResourceSampler:
+    def test_sample_payload_shape_and_gauges(self):
+        s = ResourceSampler(0, sink=lambda p: None,
+                            gauges={"queue": lambda: 7,
+                                    "broken": lambda: 1 / 0})
+        p = s.sample()
+        assert set(p) == {"ts", "cpu_percent", "rss_bytes",
+                          "mem_used_bytes", "queue", "broken"}
+        assert p["queue"] == 7
+        assert p["broken"] == 0  # failing gauge reports 0, loop lives
+        assert abs(p["ts"] - time.time()) < 5.0
+
+    def test_interval_zero_never_starts_a_thread(self):
+        s = ResourceSampler(0, sink=lambda p: None).start()
+        assert not s._thread.is_alive()
+
+
+class TestUtilizationRing:
+    def test_downsample_replaces_within_interval(self):
+        ring = UtilizationRing(interval_s=1.0, maxlen=8)
+        ring.record(0, "cpu", 100.0, 10.0)
+        ring.record(0, "cpu", 100.5, 20.0)  # < 0.8*interval later
+        (row,) = ring.rows()
+        assert row["points"] == [[100.0, 20.0]]  # latest value wins
+        assert ring.points_downsampled == 1
+        ring.record(0, "cpu", 101.0, 30.0)
+        (row,) = ring.rows()
+        assert len(row["points"]) == 2
+        assert ring.points_recorded == 2
+
+    def test_maxlen_bounds_each_series(self):
+        ring = UtilizationRing(interval_s=1.0, maxlen=4)
+        for i in range(10):
+            ring.record(1, "rss", 100.0 + 2 * i, float(i))
+        (row,) = ring.rows()
+        assert len(row["points"]) == 4
+        assert row["points"][-1] == [118.0, 9.0]  # newest kept
+
+    def test_rows_filter_and_latest(self):
+        ring = UtilizationRing(interval_s=1.0, maxlen=8)
+        ring.record(0, "cpu", 100.0, 1.0)
+        ring.record(1, "cpu", 100.0, 2.0)
+        ring.record(1, "rss", 100.0, 3.0)
+        assert len(ring.rows()) == 3
+        assert [r["node"] for r in ring.rows(node=1)] == [1, 1]
+        assert [r["series"] for r in ring.rows(series="cpu")] \
+            == ["cpu", "cpu"]
+        assert ring.latest() == {0: {"cpu": 1.0},
+                                 1: {"cpu": 2.0, "rss": 3.0}}
+
+
+# ----------------------------------------------------------------------
+# ProfilePlane aggregation units (explicit args, no runtime)
+# ----------------------------------------------------------------------
+
+class TestProfilePlane:
+    def _plane(self, **kw):
+        kw.setdefault("hz", 100.0)
+        kw.setdefault("interval_s", 1.0)
+        kw.setdefault("util_maxlen", 16)
+        kw.setdefault("max_stacks", 1000)
+        return ProfilePlane(**kw)
+
+    def test_record_batch_merges_counts(self):
+        pp = self._plane()
+        pp.record_batch(1, {"samples": [("t1", "a;b", 3)], "dropped": 0})
+        pp.record_batch(1, {"samples": [("t1", "a;b", 2),
+                                        ("t2", "a;c", 1)], "dropped": 4})
+        rows = pp.profile_stacks()
+        assert rows[0] == {"node": 1, "task": "t1", "stack": "a;b",
+                           "count": 5}
+        assert rows[1]["count"] == 1
+        summ = pp.summary()
+        assert summ["samples_recorded"] == 6
+        assert summ["samples_dropped"] == 4
+        assert summ["stacks_resident"] == 2
+
+    def test_stack_table_evicts_oldest(self):
+        pp = self._plane(max_stacks=2)
+        pp.record_batch(0, {"samples": [("a", "s1", 1)]})
+        pp.record_batch(0, {"samples": [("b", "s2", 1)]})
+        pp.record_batch(0, {"samples": [("a", "s1", 1)]})  # bump a
+        pp.record_batch(0, {"samples": [("c", "s3", 1)]})  # evicts b
+        tasks = {r["task"] for r in pp.profile_stacks()}
+        assert tasks == {"a", "c"}
+        assert pp.summary()["stacks_evicted"] == 1
+
+    def test_record_util_applies_clock_offset(self):
+        pp = self._plane()
+        pp.record_util(2, {"ts": 100.0, "cpu_percent": 50.0,
+                           "rss_bytes": 1024}, offset=7.5)
+        rows = pp.list_utilization(node=2, series="cpu_percent")
+        assert rows[0]["points"] == [[107.5, 50.0]]
+        # "ts" never becomes a series; junk values are skipped
+        pp.record_util(2, {"ts": 110.0, "weird": "NaN-ish-object",
+                           "ok": 1})
+        names = {r["series"] for r in pp.list_utilization(node=2)}
+        assert "ts" not in names
+        assert "ok" in names
+
+    def test_head_samplers_record_locally_and_shutdown(self):
+        pp = self._plane(hz=200.0, interval_s=0.05)
+        pp.start_head_samplers(gauges={"g": lambda: 42.0})
+        try:
+            _poll(lambda: pp.summary()["samples_recorded"] > 0,
+                  timeout=10)
+            _poll(lambda: pp.utilization_latest().get(0, {}).get("g"),
+                  timeout=10)
+        finally:
+            pp.shutdown()
+        assert pp.summary()["samples_recorded"] > 0
+        assert pp.utilization_latest()[0]["g"] == 42.0
+        assert pp._samplers == []
+
+
+# ----------------------------------------------------------------------
+# export formats
+# ----------------------------------------------------------------------
+
+class TestExports:
+    ROWS = [
+        {"node": 0, "task": "idle", "stack": "a;b", "count": 10},
+        {"node": 1, "task": "f:12ab34cd", "stack": "a;c", "count": 30},
+        {"node": 1, "task": "f:12ab34cd", "stack": "a;c;d", "count": 60},
+    ]
+
+    def test_collapsed_lines(self):
+        text = collapsed(self.ROWS)
+        assert "node1;f:12ab34cd;a;c;d 60\n" in text
+        assert text.endswith("\n")
+        assert collapsed([]) == ""
+
+    def test_top_tasks_aggregates_by_label(self):
+        table = top_tasks(self.ROWS)
+        assert table[0] == {"node": 1, "task": "f:12ab34cd",
+                            "samples": 90, "cpu_pct": 90.0}
+        assert table[1]["cpu_pct"] == 10.0
+
+    def test_speedscope_document(self):
+        doc = speedscope(self.ROWS)
+        prof = doc["profiles"][0]
+        assert prof["type"] == "sampled"
+        assert len(prof["samples"]) == len(prof["weights"]) == 3
+        assert prof["endValue"] == 100
+        frames = [f["name"] for f in doc["shared"]["frames"]]
+        # node + task become the outermost frames, deduped
+        assert frames.count("node1") == 1
+        first = prof["samples"][0]
+        assert frames[first[0]] == "node0"
+        assert frames[first[1]] == "idle"
+
+    def test_flamegraph_report_shape(self):
+        rep = flamegraph_report(self.ROWS)
+        assert set(rep) == {"samples", "top_tasks", "collapsed",
+                            "speedscope"}
+        assert rep["samples"] == 100
+
+
+# ----------------------------------------------------------------------
+# integration: cross-node attribution on one clock (shared runtime)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="class")
+def profile_ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=2,
+                 _system_config={"worker_mode": "process",
+                                 "profile_hz": 100.0,
+                                 "utilization_interval_s": 0.2})
+    w = worker_mod.get_worker()
+    w.add_remote_cluster_node(num_cpus=2.0, num_workers=1,
+                              resources={"alpha": 2})
+    w.add_remote_cluster_node(num_cpus=2.0, num_workers=1,
+                              resources={"beta": 2})
+    yield w
+    ray_tpu.shutdown()
+
+
+class TestClusterFlightRecorder:
+    def test_remote_task_attribution_and_aligned_utilization(
+            self, profile_ray):
+        """The acceptance workload: CPU burns pinned to BOTH remote
+        nodes must surface in profile_stacks() as named off-head rows,
+        and list_utilization() must carry a head-clock-aligned series
+        for every node in the cluster."""
+        @ray_tpu.remote(resources={"alpha": 1})
+        def burn_alpha(s):
+            return _burn(s)
+
+        @ray_tpu.remote(resources={"beta": 1})
+        def burn_beta(s):
+            return _burn(s)
+
+        t_start = time.time()
+        out = ray_tpu.get([burn_alpha.remote(1.2),
+                           burn_beta.remote(1.2)], timeout=120)
+        assert all(x > 0 for x in out)
+
+        def named_offhead():
+            rows = [r for r in state.profile_stacks()
+                    if r["node"] != 0 and "burn_" in r["task"]]
+            return rows or None
+        rows = _poll(named_offhead, timeout=30)
+        assert rows, "no off-head stack attributed to a named task"
+        by_task = {r["task"].split(":")[0].split(".")[-1] for r in rows}
+        assert by_task >= {"burn_alpha", "burn_beta"}, by_task
+        for r in rows:
+            # label carries the task id suffix and node_id resolves
+            assert re.search(r"burn_(alpha|beta):[0-9a-f]{8}$",
+                             r["task"]), r
+            assert r["node_id"], r
+        # the dominant stacks walk from the worker's dispatch frame
+        # down into the user function (a rare boundary tick may catch
+        # the frame between transitions, so any-not-all)
+        assert any(r["stack"].split(";")[-1].endswith("._burn")
+                   for r in rows), rows
+        assert any("_run_payload" in r["stack"] for r in rows), rows
+
+        # every node (head + both remotes) reports utilization, with
+        # every point on the head's clock axis despite remote senders
+        def all_nodes_report():
+            nodes = {r["node"] for r in state.list_utilization(
+                series="cpu_percent")}
+            return nodes if nodes >= {0, 1, 2} else None
+        assert _poll(all_nodes_report, timeout=30), \
+            state.list_utilization()
+        t_end = time.time()
+        for r in state.list_utilization():
+            assert r["node_id"]
+            for ts, _v in r["points"]:
+                assert t_start - 10.0 <= ts <= t_end + 10.0, \
+                    f"timestamp off the head clock axis: {r}"
+
+        # the head's internal gauges ride the same ring
+        head = {r["series"] for r in state.list_utilization()
+                if r["node"] == 0}
+        assert {"cpu_percent", "rss_bytes", "arena_used_bytes",
+                "sched_ready_queue", "inflight_tasks"} <= head, head
+
+        # filters: series selects one series; node_id prefix-filters
+        assert all(r["series"] == "rss_bytes"
+                   for r in state.list_utilization(series="rss_bytes"))
+        nid = next(r["node_id"] for r in state.list_utilization()
+                   if r["node"] == 1)
+        assert {r["node"] for r in
+                state.list_utilization(node_id=nid[:12])} == {1}
+
+    def test_profile_api_exports_and_metrics(self, profile_ray,
+                                             tmp_path):
+        @ray_tpu.remote
+        def busy(s):
+            return _burn(s)
+
+        refs = [busy.remote(1.5) for _ in range(2)]
+        report = ray_tpu.profile(1.0)
+        assert ray_tpu.get(refs, timeout=120)
+        # the windowed diff catches the in-flight burn
+        assert report["samples"] > 0
+        assert report["top_tasks"]
+        assert report["collapsed"].strip()
+        assert report["speedscope"]["profiles"][0]["weights"]
+
+        path = ray_tpu.profile(0.2, filename=str(tmp_path / "p.folded"))
+        assert path.endswith("p.folded")
+        text = open(path).read()
+        assert text == "" or " " in text.splitlines()[0]
+        path = ray_tpu.profile(0.2, filename=str(tmp_path / "p.json"))
+        doc = json.load(open(path))
+        assert doc["$schema"].startswith("https://www.speedscope.app")
+
+        from ray_tpu._private import metrics
+        text = metrics.render_all(profile_ray)
+        assert "# TYPE ray_tpu_profile_samples_recorded_total counter" \
+            in text
+        assert "# TYPE ray_tpu_node_cpu_percent gauge" in text
+        m = re.search(r"ray_tpu_profile_samples_recorded_total (\d+)",
+                      text)
+        assert m and int(m.group(1)) > 0
+        # per-node labeled gauges for every reporting node
+        assert re.search(r'ray_tpu_node_rss_bytes\{node="0"\} \d', text)
+        assert re.search(r'ray_tpu_node_rss_bytes\{node="[12]"\} \d',
+                         text)
+
+
+# ----------------------------------------------------------------------
+# ray:// + CLI (subprocess head, like the other observability planes)
+# ----------------------------------------------------------------------
+
+def test_profile_over_ray_client_and_cli(tmp_path, capsys):
+    """Acceptance: the SAME evidence — a named off-head stack row and
+    aligned utilization for every node — must be reachable over a thin
+    ray:// session AND via the CLI verbs (`profile`, `status
+    --address`) against a head subprocess running with profile_hz>0."""
+    from ray_tpu._private import spawn_env
+
+    ray_tpu.shutdown()
+    env = spawn_env.child_env(
+        repo_path=REPO,
+        extra={"RAY_TPU_PROFILE_HZ": "100",
+               "RAY_TPU_UTILIZATION_INTERVAL_S": "0.2"})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "4", "--num-workers", "2",
+         "--worker-mode", "process"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        address = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                time.sleep(0.05)
+                continue
+            m = re.search(r"address='(ray://[^']+)'", line)
+            if m:
+                address = m.group(1)
+                break
+        assert address, "head did not print a connect string"
+
+        ray_tpu.init(address=address)
+
+        @ray_tpu.remote
+        def client_burn(s):
+            end = time.time() + s
+            x = 0
+            while time.time() < end:
+                x += 1
+            return x
+
+        assert ray_tpu.get(client_burn.remote(1.2), timeout=60) > 0
+
+        def named_row():
+            return [r for r in state.profile_stacks()
+                    if "client_burn" in r["task"]] or None
+        rows = _poll(named_row, timeout=30)
+        assert rows, "no named stack row visible over ray://"
+        util = _poll(lambda: state.list_utilization(
+            series="cpu_percent"), timeout=30)
+        assert util, "no utilization visible over ray://"
+        now = time.time()
+        assert all(abs(now - r["points"][-1][0]) < 60 for r in util)
+        ray_tpu.shutdown()
+
+        # CLI: status --address renders the utilization snapshot...
+        from ray_tpu.__main__ import _cmd_profile, _cmd_status
+        rc = _cmd_status(SimpleNamespace(metrics_port=0,
+                                         address=address))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "nodes (" in out
+        assert "utilization (latest sample per node):" in out
+        assert "cpu_percent=" in out
+
+        # ...and profile exports a flamegraph over the same address
+        fg = tmp_path / "cluster.folded"
+        rc = _cmd_profile(SimpleNamespace(address=address,
+                                          duration=1.0,
+                                          output=str(fg)))
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "samples over" in out
+        assert fg.exists() and fg.read_text().strip()
+    finally:
+        ray_tpu.shutdown()
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+# ----------------------------------------------------------------------
+# disabled plane: zero cost, schema-stable surfaces
+# ----------------------------------------------------------------------
+
+def test_disabled_plane_is_absent_everywhere():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=1,
+                 _system_config={"worker_mode": "process"})
+    try:
+        w = worker_mod.get_worker()
+        # profile_hz=0 is the default: no plane object, no threads
+        assert w.profile_plane is None
+        names = {t.name for t in threading.enumerate()}
+        assert not any(n.startswith(("ray_tpu_profile",
+                                     "ray_tpu_util")) for n in names)
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get(f.remote(3), timeout=60) == 6
+        assert state.profile_stacks() == []
+        assert state.list_utilization() == []
+        # metrics stay schema-stable, zero-valued
+        from ray_tpu._private import metrics
+        text = metrics.render_all(w)
+        assert "ray_tpu_profile_samples_recorded_total 0" in text
+        assert "ray_tpu_profile_samples_dropped_total 0" in text
+        assert "ray_tpu_node_cpu_percent 0" in text
+        assert "ray_tpu_node_rss_bytes 0" in text
+        assert "ray_tpu_node_arena_used_bytes 0" in text
+    finally:
+        ray_tpu.shutdown()
+
+
+# ----------------------------------------------------------------------
+# overhead guard (bench satellite): 100 Hz within ~10% of unprofiled
+# ----------------------------------------------------------------------
+
+def test_profile_overhead_within_10_percent():
+    from ray_tpu._private import perf
+
+    def run(profile_on: bool) -> float:
+        # the plane is OFF by default, so (unlike the other planes) the
+        # env override arms the instrumented lane rather than the bare;
+        # 100 Hz matches bench.py's profile_overhead lane
+        if profile_on:
+            os.environ["RAY_TPU_PROFILE_HZ"] = "100"
+        try:
+            return perf.e2e_task_throughput(
+                n_tasks=800, mode="process", num_workers=2,
+                batched=True, best_of=3)["tasks_per_sec"]
+        finally:
+            os.environ.pop("RAY_TPU_PROFILE_HZ", None)
+
+    # shared-VM noise between trials can exceed the margin under test —
+    # each retry re-measures a fresh off/on PAIR under the same machine
+    # conditions; a real systematic >10% overhead fails every pair
+    for attempt in range(3):
+        off = run(profile_on=False)
+        on = run(profile_on=True)
+        if on >= 0.9 * off:
+            break
+    assert on >= 0.9 * off, (
+        f"profiled throughput {on:.0f} tasks/s fell more than 10% "
+        f"below unprofiled {off:.0f} tasks/s")
+    ray_tpu.shutdown()
