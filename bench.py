@@ -166,6 +166,32 @@ def _locality_subprocess(locality: bool, n: int, arg_mb: float) -> dict:
         f"locality child produced no result: {out.stderr[-2000:]}")
 
 
+_HEAD_BYPASS_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from ray_tpu._private import perf
+r = perf.head_bypass_ab({p2p}, n_calls={n_calls}, n_submit={n_submit})
+print("HB_JSON:" + json.dumps(r))
+"""
+
+
+def _head_bypass_subprocess(p2p: bool, n_calls: int,
+                            n_submit: int) -> dict:
+    """One head-bypass A/B arm in a fresh interpreter (the cluster
+    spawns node daemons; a clean process keeps the arms independent)."""
+    env = spawn_env.child_env()
+    code = _HEAD_BYPASS_CHILD.format(repo=REPO, p2p=p2p, n_calls=n_calls,
+                                     n_submit=n_submit)
+    timeout = max(60.0, min(300.0, _remaining() - 10.0))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    for line in out.stdout.splitlines():
+        if line.startswith("HB_JSON:"):
+            return json.loads(line[len("HB_JSON:"):])
+    raise RuntimeError(
+        f"head_bypass child produced no result: {out.stderr[-2000:]}")
+
+
 _FAILOVER_CHILD = """
 import json, os, re, signal, subprocess, sys, time
 sys.path.insert(0, {repo!r})
@@ -731,6 +757,47 @@ def main() -> int:
         except Exception:
             traceback.print_exc()
         OUT["locality"] = loc or None
+        _emit()
+
+    # --- two-level scheduling: head off the data path ------------------
+    # 2-remote-node cluster, actor on node B, caller task on node A.
+    # ON (actor_p2p + local_dispatch): calls ship worker -> peer daemon
+    # over the peer lane with only completion receipts to the head, and
+    # nested submissions admit on the node's LocalScheduler; the
+    # sustained-submit lane runs against a chaos-slowed head tick, so
+    # local dispatch shows up as immunity to head latency. OFF is the
+    # pre-PR everything-through-the-head path. Claims under test: ON is
+    # never slower, >=90% of steady-state actor calls skip the head,
+    # and both arms produce equal results.
+    if section("head_bypass", 45):
+        hb = {}
+        n_calls, n_submit = (12, 8) if smoke else (40, 24)
+        try:
+            on = _head_bypass_subprocess(True, n_calls, n_submit)
+            off = _head_bypass_subprocess(False, n_calls, n_submit)
+            hb["on"] = on
+            hb["off"] = off
+            hb["equal_results"] = (on["total"] == off["total"]
+                                   and on["n_submit"] == off["n_submit"])
+            hb["p2p_fraction"] = round(
+                on["calls_p2p"] / max(n_calls, 1), 3)
+            hb["actor_speedup"] = round(
+                off["actor_seconds"] / max(on["actor_seconds"], 1e-9), 2)
+            hb["slowed_head_submit_speedup"] = round(
+                off["submit_seconds"] / max(on["submit_seconds"], 1e-9),
+                2)
+            print(f"  head_bypass: {on['calls_p2p']}/{n_calls} actor "
+                  f"calls p2p ({hb['p2p_fraction']:.0%}), "
+                  f"{on['head_fallback']} fallbacks; actor lane "
+                  f"{on['actor_seconds']}s vs {off['actor_seconds']}s "
+                  f"({hb['actor_speedup']}x); slowed-head submit "
+                  f"{on['submit_seconds']}s vs {off['submit_seconds']}s "
+                  f"({hb['slowed_head_submit_speedup']}x, "
+                  f"{on['local_dispatch']} local / {on['spillback']} "
+                  f"spilled)", file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+        OUT["head_bypass"] = hb or None
         _emit()
 
     # --- model perf: step time / tokens/s / MFU ------------------------

@@ -122,6 +122,10 @@ DEFAULT_CHANNELS: List[ChannelSpec] = [
         # "reply" is also DISPATCHED by the worker's rpc() wait loop —
         # arity there is checked like any branch; node_daemon relays
         # head payloads through _to_worker opaquely (dynamic msg)
+        # ("p2p", local, p2p) two-level adverts are injected by the
+        # daemon's _intercept/_apply_resview through _to_worker
+        # (dynamic msg var, not a literal send site)
+        assume_sent={"p2p"},
     ),
     ChannelSpec(
         name="worker_to_owner",
@@ -143,6 +147,25 @@ DEFAULT_CHANNELS: List[ChannelSpec] = [
         ],
         # the daemon's _intercept peeks at done/err tails in transit
         # but the authoritative dispatcher is the owner pool
+    ),
+    ChannelSpec(
+        name="peer_actor_lane",
+        # daemon<->daemon actor-call lane riding the peer object plane:
+        # _lane_send is the single framed-send point for both the
+        # caller side (("acall", envelope)) and the executing side
+        # (("ares", tid, status, data, timing))
+        sends=[SendSpec("_private/runtime/node_daemon.py",
+                        "_lane_send")],
+        recvs=[RecvSpec("_private/runtime/node_daemon.py",
+                        "NodeDaemon._peer_serve"),
+               RecvSpec("_private/runtime/node_daemon.py",
+                        "NodeDaemon._lane_reader")],
+        # "get" belongs to the byte-oriented peer-pull subprotocol
+        # (chunked conn.send frames, out of scope per module docstring);
+        # "ares" is validated inline by _lane_reader's guard clause and
+        # unpacked in _on_ares, which the branch collector cannot see
+        assume_sent={"get"},
+        assume_handled={"ares"},
     ),
 ]
 

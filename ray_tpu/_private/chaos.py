@@ -36,6 +36,18 @@ head       kill, restart, flap  GcsService health loop: ``flap``
                                 external harnesses (bench/soak
                                 drivers kill + relaunch the head
                                 subprocess at the seeded arrival)
+peer_link  delay, drop, sever   NodeDaemon peer actor lane (p2p
+                                actor calls): ``delay`` stalls the
+                                frame, ``drop`` loses the call
+                                (immediate head fallback), ``sever``
+                                kills the lane socket mid-flight so
+                                every in-flight call on it falls
+                                back to the head path. Polled on
+                                DAEMON processes: the head mirrors
+                                its armed plan to daemons through
+                                the resview push, and daemon-fired
+                                injections ride the outbox back as
+                                ("fault", entry) reports
 ========== ==================== =====================================
 
 The public surface is :mod:`ray_tpu.chaos`; ``state.list_faults()``
@@ -51,7 +63,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 SITES: Tuple[str, ...] = (
     "task", "worker", "link", "transfer", "sched_tick", "heartbeat",
-    "head")
+    "head", "peer_link")
 
 _SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "task": ("exception", "hang"),
@@ -61,6 +73,7 @@ _SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "sched_tick": ("slow",),
     "heartbeat": ("drop",),
     "head": ("kill", "restart", "flap"),
+    "peer_link": ("delay", "drop", "sever"),
 }
 
 # default parameters for kinds that need one; overridable per plan entry
@@ -141,6 +154,43 @@ class FaultController:
             else:
                 self._probs[site] = (float(prob), params)
             self._armed = bool(self._plan or self._probs)
+
+    def plan_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Picklable image of the armed schedule, or None when
+        disarmed. Daemons mirror the head's plan from this (resview
+        push) so seeded faults fire at deterministic arrivals on the
+        process that actually hosts the site (e.g. ``peer_link``)."""
+        with self._lock:
+            if not self._armed:
+                return None
+            return {
+                "seed": self._seed,
+                "faults": [(s, w, k, dict(p))
+                           for (s, w), (k, p) in sorted(self._plan.items())],
+                "probs": {s: (p, dict(params))
+                          for s, (p, params) in self._probs.items()},
+            }
+
+    def arm_snapshot(self, snap: Optional[Dict[str, Any]]) -> None:
+        """Install (or, with None, disarm) a schedule mirrored from
+        another process's :meth:`plan_snapshot`."""
+        if snap is None:
+            self.disarm()
+            return
+        self.arm(FaultPlan(snap.get("seed", 0), snap.get("faults", ())))
+        for site, (p, params) in (snap.get("probs") or {}).items():
+            self.set_probability(site, p, **params)
+
+    def note_remote(self, entry: Dict[str, Any]) -> None:
+        """Record an injection that FIRED on another process (a daemon
+        reported it over the outbox): it joins this controller's log
+        and counters so ``list_faults()``/metrics stay cluster-wide."""
+        with self._lock:
+            site = entry.get("site", "?")
+            self._injected[site] = self._injected.get(site, 0) + 1
+            e = dict(entry)
+            e["seq"] = len(self._log)
+            self._log.append(e)
 
     def disarm(self) -> None:
         """Stop injecting; the log and counters survive for inspection."""

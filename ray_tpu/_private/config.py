@@ -233,6 +233,28 @@ _d("locality_spillback_queue_depth", int, 4,
    "fewer than this many leases outstanding; beyond it the task "
    "spills to the normal least-loaded choice so a hot node never "
    "serializes the cluster")
+_d("local_dispatch", bool, False,
+   "bottom-up two-level scheduling (reference: Ray OSDI '18): a remote "
+   "node's daemon admits worker-submitted tasks from a bounded local "
+   "queue against a head-refreshed resource view, leases them to its "
+   "own workers without a head round-trip, and reports lease + "
+   "completion through the sequenced outbox (exactly-once across head "
+   "restarts). Tasks that do not fit — ref args, custom resources, "
+   "placement groups, full queue — spill upward to the head scheduler, "
+   "which stays the single placement authority. Off = every submission "
+   "goes through the head, byte-for-byte pre-two-level behavior")
+_d("local_queue_depth", int, 16,
+   "bound on locally-admitted leases in flight per node daemon; at the "
+   "bound new submissions spill upward to the head scheduler")
+_d("actor_p2p", bool, False,
+   "peer-to-peer actor calls: once the head publishes an actor's "
+   "(node, worker) route, worker-originated calls ship the call "
+   "envelope caller-daemon -> peer-daemon over the peer link and only "
+   "a sequenced completion receipt flows to the head for lineage/ref-"
+   "counting; peer-link failure or actor restart falls back to the "
+   "head path with the same attempt token (retries stay exactly-"
+   "once). Off = every actor call routes through the head, byte-for-"
+   "byte pre-p2p behavior")
 
 # -- fault tolerance -------------------------------------------------------
 _d("task_max_retries", int, 3, "default retries for tasks on worker failure")

@@ -235,6 +235,22 @@ def _render_core(worker) -> List[str]:
          "(transfers avoided by locality-aware placement)",
          ts.get("bytes_saved", 0))
 
+    # two-level scheduling + p2p actor plane (worker.two_level_stats;
+    # schema-stable zeros while local_dispatch/actor_p2p are off)
+    tl = getattr(worker, "two_level_stats", None) or {}
+    emit("ray_tpu_sched_local_dispatch_total", "counter",
+         "worker-submitted tasks admitted by a node's LocalScheduler "
+         "without a head round-trip", tl.get("local_dispatch", 0))
+    emit("ray_tpu_sched_spillback_total", "counter",
+         "local submissions a node declined (queue full / unfit) that "
+         "spilled up to the head scheduler", tl.get("spillback", 0))
+    emit("ray_tpu_actor_calls_p2p_total", "counter",
+         "actor calls executed worker-to-peer over the daemon lane "
+         "(head saw only the completion receipt)", tl.get("p2p", 0))
+    emit("ray_tpu_actor_calls_head_fallback_total", "counter",
+         "p2p actor calls re-routed through the head path after a "
+         "peer-lane drop/sever/timeout", tl.get("head_fallback", 0))
+
     # task event plane: latency-breakdown histograms + failure counters
     from ray_tpu._private import task_events
     lines.extend(task_events.render_prometheus(

@@ -201,6 +201,120 @@ def locality_ab(locality: bool, n_consumers: int = 8,
         c.shutdown()
 
 
+def head_bypass_ab(p2p: bool, n_calls: int = 40, n_submit: int = 24,
+                   head_tick_delay_s: float = 0.02) -> Dict[str, Any]:
+    """One arm of the two-level/head-bypass A/B: a 2-remote-node
+    cluster, an actor resident on node B, a caller task on node A
+    issuing ``n_calls`` sequential actor calls.
+
+    With ``p2p=True`` (``actor_p2p`` + ``local_dispatch`` on) the calls
+    ship worker -> caller daemon -> peer daemon once the route
+    resolves; only sequenced completion receipts reach the head. With
+    ``p2p=False`` every call round-trips the head (the pre-PR path).
+
+    The sustained-submit lane then arms a chaos ``sched_tick slow``
+    plan (every head scheduler tick delayed by ``head_tick_delay_s``)
+    and has a node-A task submit+get ``n_submit`` nested no-ops: with
+    local dispatch on, the node's LocalScheduler admits them without
+    waiting out the slowed head tick.
+
+    Returns {p2p, n_calls, total, actor_seconds, calls_p2p,
+    head_fallback, submit_seconds, local_dispatch, spillback}.
+    ``total`` must match between arms (equal call results)."""
+    import ray_tpu
+    from ray_tpu import chaos
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster(initialize_head=True,
+                head_node_args=dict(
+                    num_cpus=2, num_workers=2, scheduler="tensor",
+                    _system_config={
+                        "local_dispatch": bool(p2p),
+                        "actor_p2p": bool(p2p)}))
+    try:
+        c.add_node(num_cpus=2, remote=True, resources={"a": 100.0})
+        c.add_node(num_cpus=2, remote=True, resources={"b": 100.0})
+        c.wait_for_nodes()
+        w = worker_mod.get_worker()
+
+        @ray_tpu.remote(resources={"b": 1.0})
+        class _Acc:
+            def __init__(self):
+                self.total = 0
+
+            def bump(self, x):
+                self.total += x
+                return self.total
+
+        actor = _Acc.remote()
+        ray_tpu.get(actor.bump.remote(0), timeout=60.0)  # placed + live
+
+        @ray_tpu.remote(resources={"a": 1.0})
+        def caller(h, n):
+            import ray_tpu
+            out = 0
+            for _ in range(n):
+                out = ray_tpu.get(h.bump.remote(1), timeout=60.0)
+            return out
+
+        t0 = time.perf_counter()
+        total = ray_tpu.get(caller.remote(actor, n_calls),
+                            timeout=300.0)
+        actor_dt = time.perf_counter() - t0
+        # sequenced p2p_done receipts ride the outbox; give the last
+        # few a beat to land before reading the counters
+        deadline = time.monotonic() + 10.0
+        while (p2p and time.monotonic() < deadline
+               and (w.two_level_stats["p2p"]
+                    + w.two_level_stats["head_fallback"]) < n_calls - 1):
+            time.sleep(0.05)
+        stats = dict(w.two_level_stats)
+
+        # the admissible shape: default resources and no retries.
+        # Custom-resource demands (head knows the cluster-wide supply)
+        # and retry-carrying tasks (retries are owner-driven) are
+        # exactly what the LocalScheduler spills upward, so the lane
+        # measures the locally-dispatchable path
+        @ray_tpu.remote(max_retries=0)
+        def _nested_noop():
+            return 1
+
+        @ray_tpu.remote(resources={"a": 1.0})
+        def submitter(n):
+            import ray_tpu
+            return sum(ray_tpu.get(
+                [_nested_noop.remote() for _ in range(n)],
+                timeout=120.0))
+
+        chaos.arm(chaos.FaultPlan(7))
+        chaos.set_probability("sched_tick", 1.0,
+                              delay_s=head_tick_delay_s)
+        try:
+            t0 = time.perf_counter()
+            n_done = ray_tpu.get(submitter.remote(n_submit),
+                                 timeout=300.0)
+            submit_dt = time.perf_counter() - t0
+        finally:
+            chaos.disarm()
+        stats_after = dict(w.two_level_stats)
+        return {
+            "p2p": bool(p2p),
+            "n_calls": n_calls,
+            "total": int(total),
+            "actor_seconds": round(actor_dt, 3),
+            "calls_p2p": int(stats["p2p"]),
+            "head_fallback": int(stats["head_fallback"]),
+            "n_submit": int(n_done),
+            "submit_seconds": round(submit_dt, 3),
+            "local_dispatch": int(stats_after["local_dispatch"]),
+            "spillback": int(stats_after["spillback"]),
+        }
+    finally:
+        c.shutdown()
+
+
 def rl_rollout_throughput(iters: int = 4) -> Dict[str, Any]:
     """IMPALA's async pipeline under load: env-steps/s streamed from
     runner actors through the object store into the V-trace learner

@@ -47,6 +47,14 @@ Head -> daemon messages:
   ("log_list", rid)           -> ("log_listed", rid, rows)
   ("log_read", rid, filename, tail)
                               -> ("log_data", rid, ok, text_or_error)
+  ("resview", view)           two-level dispatch push: {accept, p2p,
+                              cap, job, chaos} — refreshed view gating
+                              the daemon's LOCAL submission queue and
+                              advertising the p2p actor lane (plus a
+                              mirror of the head's armed chaos plan)
+  ("aroute", aid_bin, route)  actor-route reply for an ("aresolve",
+                              aid_bin) request: (node_index, address,
+                              worker_num) or None
   ("exit",)                   kill workers and exit
 
 Daemon -> head messages:
@@ -65,8 +73,28 @@ Daemon -> head messages:
                               object directory
   ("log_listed", rid, rows)   log_list reply
   ("log_data", rid, ok, text) log_read reply
+  ("local_lease", tid, info)  the LocalScheduler admitted a worker
+                              submission against the head-pushed
+                              resource view and leased it to a sibling
+                              worker; info carries everything the head
+                              needs to journal the lease (fn/args
+                              blobs, return ids, attempt)
+  ("p2p_done", tid, info)     completion receipt for a peer-dispatched
+                              actor call EXECUTED on this node: result
+                              entries + timing for lineage/ref-counts
+                              (the only head traffic a p2p call costs)
+  ("p2p_fallback", tid, info) a p2p call this node ORIGINATED could
+                              not complete over the peer lane; the
+                              head re-runs it with the same task id +
+                              attempt token (worker-side dedup makes
+                              the retry exactly-once)
+  ("aresolve", aid_bin)       actor-route request -> ("aroute", ...)
+  ("fault", entry)            a mirrored chaos injection fired on this
+                              daemon (e.g. peer_link); joins the
+                              head's injection log/counters
 
-Report-class messages (w / worker_died / pulled / log — anything the
+Report-class messages (w / worker_died / pulled / log / local_lease /
+p2p_done / p2p_fallback / fault — anything the
 head must not lose across a blackout) don't travel bare: they ride a
 sequence-numbered outbox envelope ("seq", n, depth, is_replay, inner)
 and are buffered until the head acknowledges them with ("ack", n)
@@ -91,7 +119,7 @@ from typing import Any, Dict, Optional
 
 from ray_tpu._private.analysis import runtime_sanitizer
 from ray_tpu._private.analysis.runtime_checks import assert_holds
-from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 
 
 class _Outbox:
@@ -150,8 +178,14 @@ class _Outbox:
 # daemon->head tags that ride the outbox (report-class: the head must
 # not lose them across a blackout); everything else is sent bare.
 # "util" = resource samples for the utilization ring; "w"-wrapped
-# worker "prof" batches are covered by "w" itself
-_OUTBOX_TAGS = frozenset(("w", "worker_died", "pulled", "log", "util"))
+# worker "prof" batches are covered by "w" itself. The two-level
+# dispatch reports (local leases, p2p completion receipts, fallbacks,
+# mirrored chaos injections) are report-class BY CONSTRUCTION: the
+# exactly-once story for decentralized dispatch is the outbox replay +
+# head-side sequence dedup, nothing new
+_OUTBOX_TAGS = frozenset((
+    "w", "worker_died", "pulled", "log", "util",
+    "local_lease", "p2p_done", "p2p_fallback", "fault"))
 
 
 class _WorkerSlot:
@@ -521,6 +555,25 @@ class NodeDaemon:
         threading.Thread(target=self._peer_accept_loop, daemon=True,
                          name="ray_tpu_node_peer_accept").start()
 
+        # two-level dispatch state (bottom-up scheduler + p2p actors).
+        # Everything defaults OFF: until the head pushes a resview the
+        # daemon is a pure forwarder, byte-for-byte pre-two-level.
+        self._resview: Dict[str, Any] = {}
+        self._resview_lock = threading.Lock()
+        self._chaos_snapshot: Optional[dict] = None
+        self._local_tids: set = set()      # locally-admitted, in flight
+        self._local_dispatched = 0
+        # p2p actor plane: head-resolved routes, per-actor task-id
+        # minting salts, A-side in-flight calls, per-peer actor lanes,
+        # and B-side pending executions awaiting their result send
+        self._actor_routes: Dict[bytes, tuple] = {}
+        self._aresolve_last: Dict[bytes, float] = {}
+        self._actor_salts: Dict[bytes, list] = {}
+        self._p2p_calls: Dict[bytes, dict] = {}
+        self._p2p_lanes: Dict[tuple, dict] = {}
+        self._p2p_pending: Dict[bytes, tuple] = {}
+        self._p2p_lock = threading.Lock()
+
         # report-class messages are sequenced through the outbox so a
         # head blackout loses nothing (see module docstring)
         self._outbox = _Outbox()
@@ -726,24 +779,57 @@ class NodeDaemon:
                     locs.append(("shm", loc[0], loc[1]))
                 self._to_worker(slot, ("reply", req_id, True, locs))
                 return None
+            if op == "submit":
+                return self._maybe_local_submit(slot, req_id, args)
+            if op == "actor_call":
+                return self._maybe_p2p_call(slot, req_id, args)
+            return msg
+        if kind == "ready":
+            # late-attaching worker: advertise the currently-enabled
+            # two-level lanes (workers alive at resview time get the
+            # advert through _apply_resview's broadcast instead)
+            with self._resview_lock:
+                accept = bool(self._resview.get("accept"))
+                p2p = bool(self._resview.get("p2p"))
+            if accept or p2p:
+                self._to_worker(slot, ("p2p", accept, p2p))
             return msg
         if kind in ("done",):
             task_id_bin, entries = msg[1], msg[2]
+            with self._p2p_lock:
+                p2p = self._p2p_pending.pop(task_id_bin, None)
+            if p2p is not None:
+                self._finish_p2p_exec(slot, task_id_bin, p2p, msg)
+                return None
             return_bins = slot.returns.pop(task_id_bin, [])
             slot.attempts.pop(task_id_bin, None)
             out = []
             for i, entry in enumerate(entries):
                 if entry[0] == "shm" and i < len(return_bins):
-                    self.store.seal(ObjectID(return_bins[i]))
+                    rid = ObjectID(return_bins[i])
+                    if self.store.locate(rid) is None:
+                        # a dedup re-emission (p2p attempt already ran
+                        # here) replays already-sealed entries; sealing
+                        # twice would corrupt the arena accounting
+                        self.store.seal(rid)
                     out.append(("remote_shm", entry[2]))
                 else:
                     out.append(entry)
+            with self._resview_lock:
+                self._local_tids.discard(task_id_bin)
             # preserve any trailing fields (e.g. the execution-window
             # timing tuple the task event plane rides on)
             return (msg[0], task_id_bin, out) + tuple(msg[3:])
         if kind == "err":
+            with self._p2p_lock:
+                p2p = self._p2p_pending.pop(msg[1], None)
+            if p2p is not None:
+                self._finish_p2p_exec(slot, msg[1], p2p, msg)
+                return None
             slot.returns.pop(msg[1], None)
             slot.attempts.pop(msg[1], None)
+            with self._resview_lock:
+                self._local_tids.discard(msg[1])
         return msg
 
     def _serve_fetch(self, fid: int, oid_bin: bytes) -> None:
@@ -892,15 +978,30 @@ class NodeDaemon:
                 conn.send(("ok",))
             except (OSError, ValueError):
                 return
+            # one send lock per serving connection: chunked object
+            # streams (this thread) and async ("ares", ...) result
+            # frames (worker-reader threads, p2p exec) share the pipe,
+            # and interleaved frames would desynchronize the protocol
+            send_lock = threading.Lock()
+            hdr_cache: Dict[int, tuple] = {}
             while not self._shutdown:
                 try:
                     msg = conn.recv()
                 except (EOFError, OSError):
                     return
-                if not (isinstance(msg, tuple) and msg
-                        and msg[0] == "get"):
+                if not (isinstance(msg, tuple) and msg):
                     return
-                if not self._peer_send_object(conn, ObjectID(msg[1])):
+                if msg[0] == "get":
+                    with send_lock:
+                        ok = self._peer_send_object(conn, ObjectID(msg[1]))
+                    if not ok:
+                        return
+                elif msg[0] == "acall":
+                    # p2p actor-call frame: lease-envelope encoded
+                    # payloads dispatched straight to the resident
+                    # actor worker; results return on THIS connection
+                    self._serve_acall(conn, send_lock, hdr_cache, msg[1])
+                else:
                     return
         finally:
             try:
@@ -1085,6 +1186,533 @@ class NodeDaemon:
             pass
 
     # ------------------------------------------------------------------
+    # two-level dispatch: node-local submission queue (tentpole a)
+    # ------------------------------------------------------------------
+    def _apply_resview(self, view: dict) -> None:
+        """Head-pushed resource view: gates local admission
+        (accept/cap), advertises the p2p actor lane to this node's
+        workers, and mirrors the head's armed chaos plan so
+        daemon-hosted sites (peer_link) fire at their seeded arrivals
+        on the process that actually owns them."""
+        with self._resview_lock:
+            prev = (bool(self._resview.get("accept")),
+                    bool(self._resview.get("p2p")))
+            self._resview = dict(view)
+            snap = view.get("chaos")
+            chaos_changed = snap != self._chaos_snapshot
+            if chaos_changed:
+                self._chaos_snapshot = snap
+        if chaos_changed:
+            from ray_tpu._private.chaos import get_controller
+            try:
+                get_controller().arm_snapshot(snap)
+            except Exception:
+                pass
+        cur = (bool(view.get("accept")), bool(view.get("p2p")))
+        if cur != prev:
+            with self._lock:
+                slots = [s for s in self._slots.values()
+                         if s.conn is not None]
+            for s in slots:
+                self._to_worker(s, ("p2p", cur[0], cur[1]))
+
+    def _pick_local_slot(self, submitter: _WorkerSlot):
+        """Least-loaded live non-actor worker; the submitter itself
+        only as a last resort (it is busy running the submitting task,
+        though its nested-execution loop would still make progress)."""
+        with self._lock:
+            cands = [s for s in self._slots.values()
+                     if s.conn is not None and s.actor_bin is None
+                     and s.proc is not None and s.proc.poll() is None]
+        if not cands:
+            return None
+        cands.sort(key=lambda s: (s.num == submitter.num,
+                                  len(s.returns)))
+        return cands[0]
+
+    def _maybe_local_submit(self, slot: _WorkerSlot, req_id: int,
+                            args: tuple) -> Optional[tuple]:
+        """LocalScheduler admission: a worker-originated nested
+        submission whose demand fits this node is leased HERE — ids
+        minted locally, the lease journaled at the head through the
+        report-class outbox (so head-restart reconciliation and
+        exactly-once dedup come for free), the payload dispatched to a
+        sibling worker without any head round-trip. Everything else
+        spills upward, flagged so the head can count the spillback:
+        the head scheduler stays the single placement authority for
+        cross-node balancing, placement groups, ref-carrying args and
+        retry-carrying tasks."""
+        import cloudpickle
+
+        fwd = ("rpc", req_id, "submit", args)
+        with self._resview_lock:
+            view = self._resview
+            accept = bool(view.get("accept"))
+            cap = int(view.get("cap") or 0)
+            job_bin = view.get("job")
+            depth = len(self._local_tids)
+        if not accept or job_bin is None:
+            return fwd
+        spill = ("rpc", req_id, "submit", (args[0], True))
+        if depth >= cap:
+            return spill  # bounded local queue: overflow goes upward
+        try:
+            d = cloudpickle.loads(args[0])
+        except Exception:
+            return fwd
+        res = d.get("resources") or {}
+        if (d.get("has_refs") is not False      # refs resolve owner-side
+                or d.get("pg_id") is not None   # placement is the head's
+                or d.get("max_retries")         # retries are owner-driven
+                or (res and res != {"CPU": 1} and res != {"CPU": 1.0})):
+            return spill
+        target = self._pick_local_slot(slot)
+        if target is None:
+            return spill
+        from ray_tpu._private.runtime.worker_process import fn_id_of
+
+        tid = TaskID.of(JobID(job_bin))
+        tid_bin = tid.binary()
+        rids = [ObjectID.for_task_return(tid, i).binary()
+                for i in range(d["num_returns"])]
+        fn_blob = d["func_blob"]
+        payload = {
+            "task_id": tid_bin, "name": d.get("name"),
+            "fn_id": fn_id_of(fn_blob), "fn_blob": fn_blob,
+            "args_blob": d["args_blob"],
+            "num_returns": d["num_returns"],
+            "return_ids": rids, "attempt": 0,
+        }
+        parent = d.get("trace")
+        if parent is not None and parent[3]:
+            payload["trace"] = (parent[0], os.urandom(8).hex(),
+                                parent[1], True)
+        info = {
+            "name": d.get("name"), "fn_blob": fn_blob,
+            "args_blob": d["args_blob"],
+            "num_returns": d["num_returns"], "returns": rids,
+            "resources": dict(res), "worker_num": target.num,
+            "submitter": slot.num, "trace": payload.get("trace"),
+            "t": time.time(),
+        }
+        with self._resview_lock:
+            self._local_tids.add(tid_bin)
+            self._local_dispatched += 1
+        target.returns[tid_bin] = list(rids)
+        target.attempts[tid_bin] = 0
+        # lease report FIRST: outbox FIFO means the head always sees
+        # the lease before the completion the target worker produces
+        self._send_head(("local_lease", tid_bin, info))
+        self._to_worker(target, ("task", payload))
+        self._to_worker(slot, ("reply", req_id, True, rids))
+        return None
+
+    # ------------------------------------------------------------------
+    # two-level dispatch: p2p actor plane (tentpole b)
+    # ------------------------------------------------------------------
+    def _poll_peer_link(self, **ctx):
+        """Chaos hook for the daemon-hosted peer_link site; fired
+        injections are reported upward (report-class) so the head's
+        injection log and counters stay cluster-wide."""
+        from ray_tpu._private.chaos import get_controller
+
+        ctrl = get_controller()
+        if not ctrl.armed():
+            return None
+        fault = ctrl.poll("peer_link", **ctx)
+        if fault is not None:
+            log = ctrl.list_faults()
+            entry = dict(log[-1]) if log else {
+                "site": "peer_link", "kind": fault.get("kind")}
+            self._send_head(("fault", entry))
+        return fault
+
+    def _request_route(self, aid_bin: bytes) -> None:
+        now = time.monotonic()
+        with self._p2p_lock:
+            if now - self._aresolve_last.get(aid_bin, 0.0) < 0.5:
+                return
+            self._aresolve_last[aid_bin] = now
+        self._send_head(("aresolve", aid_bin))
+
+    def _on_aroute(self, aid_bin: bytes, route) -> None:
+        with self._p2p_lock:
+            if route is None:
+                self._actor_routes.pop(aid_bin, None)
+            else:
+                self._actor_routes[aid_bin] = (
+                    route[0], tuple(route[1]), route[2])
+
+    def _mint_actor_task(self, aid_bin: bytes, num_returns: int):
+        """Mint a p2p actor-call task id with the ActorHandle
+        discipline (actor-id prefix + salted sequence) under a
+        per-daemon random salt, so ids minted here collide with
+        neither the head's handles nor another caller daemon's."""
+        with self._p2p_lock:
+            st = self._actor_salts.get(aid_bin)
+            if st is None:
+                st = self._actor_salts[aid_bin] = [
+                    int.from_bytes(os.urandom(2), "big"), 0]
+            st[1] += 1
+            if st[1] > 0xFFFF:
+                st[0] = int.from_bytes(os.urandom(2), "big")
+                st[1] = 1
+            seq = st[0] * 65536 + st[1]
+        tid = TaskID.for_actor_task(ActorID(aid_bin), seq)
+        rids = [ObjectID.for_task_return(tid, i).binary()
+                for i in range(num_returns)]
+        return tid.binary(), rids
+
+    def _maybe_p2p_call(self, slot: _WorkerSlot, req_id: int,
+                        args: tuple) -> Optional[tuple]:
+        """P2P actor plane, caller side: a worker's actor call whose
+        handle the head resolved to a peer (node, worker) address
+        ships the call envelope DIRECTLY to that node's daemon over
+        the peer link; the head sees only a sequenced completion
+        receipt. No route yet / refs in the args / lane trouble — the
+        unchanged head path."""
+        fwd = ("rpc", req_id, "actor_call", args)
+        if len(args) < 2 or args[1] is None:
+            return fwd
+        blob, meta = args[0], args[1]
+        aid_bin, method, num_returns, trace, p2p_ok = meta
+        with self._resview_lock:
+            enabled = bool(self._resview.get("p2p"))
+        if not enabled or not p2p_ok:
+            return fwd
+        with self._p2p_lock:
+            route = self._actor_routes.get(aid_bin)
+        if route is None:
+            self._request_route(aid_bin)
+            return fwd
+        tid_bin, rids = self._mint_actor_task(aid_bin, num_returns)
+        ctx = None
+        if trace is not None and trace[3]:
+            ctx = (trace[0], os.urandom(8).hex(), trace[1], True)
+        with self._resview_lock:
+            caller_node = self._resview.get("node")
+        info = {"actor": aid_bin, "method": method, "blob": blob,
+                "num_returns": num_returns, "returns": rids,
+                "caller": slot.num, "caller_node": caller_node,
+                "trace": ctx, "route": route,
+                "t": time.monotonic(), "attempt": 0}
+        with self._p2p_lock:
+            self._p2p_calls[tid_bin] = info
+        # the caller gets its return ids NOW: from here the call is
+        # committed to the p2p lane or its exactly-once head fallback
+        self._to_worker(slot, ("reply", req_id, True, rids))
+        fault = self._poll_peer_link(actor=aid_bin.hex())
+        if fault is not None:
+            k = fault.get("kind")
+            if k == "drop":
+                self._fallback_call(tid_bin, "chaos: dropped call frame")
+                return None
+            if k == "sever":
+                self._sever_lane(tuple(route[1]),
+                                 "chaos: severed peer lane")
+                return None
+            time.sleep(fault.get("delay_s", 0.05))
+        self._p2p_dispatch(tid_bin, info)
+        return None
+
+    def _p2p_dispatch(self, tid_bin: bytes, info: dict) -> None:
+        from ray_tpu._private.task_spec import (EMPTY_ARGS_BLOB,
+                                                encode_task_envelope)
+
+        lane = self._actor_lane(tuple(info["route"][1]))
+        if lane is None:
+            self._fallback_call(tid_bin, "peer lane dial failed")
+            return
+        payload = {
+            "task_id": tid_bin, "name": info["method"], "fn_id": None,
+            "fn_blob": None, "args_blob": EMPTY_ARGS_BLOB,
+            "num_returns": info["num_returns"],
+            "return_ids": info["returns"],
+            "attempt": info.get("attempt", 0),
+            # extras: the executing worker unpickles the CALLER's blob
+            # itself (only it has the user's modules); dedup marks the
+            # completion cacheable for the exactly-once fallback
+            "method": info["method"], "p2p_blob": info["blob"],
+            "actor": info["actor"], "caller": info["caller"],
+            "caller_node": info.get("caller_node"),
+            "dedup": True,
+        }
+        if info.get("trace") is not None:
+            payload["trace"] = info["trace"]
+        key = (None, info["method"], info["num_returns"])
+        with lane["lock"]:  # RLock: encode mutates the lane's caches
+            env = encode_task_envelope(
+                [(key, [payload])], lane["sent_fns"],
+                lane["sent_hdrs"], lane["hdr_blobs"])
+            if not self._lane_send(("acall", env), lane["conn"],
+                                   lane["lock"]):
+                self._drop_lane(lane, "peer lane send failed")
+
+    def _lane_send(self, msg: tuple, conn, lock) -> bool:
+        """The ONE send point for peer actor-lane frames (acall out,
+        ares back) — wire-lint collects the channel's send set here."""
+        try:
+            with lock:
+                conn.send(msg)
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def _actor_lane(self, address) -> Optional[dict]:
+        """Dial (or reuse) the dedicated actor-call lane to a peer
+        daemon. Deliberately separate from the cached pull
+        connections: chunked object streams and async call/result
+        frames must not interleave on one pipe."""
+        from multiprocessing import AuthenticationError
+
+        from ray_tpu._private import protocol
+
+        address = tuple(address)
+        with self._p2p_lock:
+            lane = self._p2p_lanes.get(address)
+        if lane is not None:
+            return lane
+        try:
+            conn = Client(address, authkey=self._peer_authkey)
+            conn.send(protocol.make_wire_hello("peer"))
+            if conn.recv() != ("ok",):
+                conn.close()
+                return None
+        except (OSError, EOFError, ValueError, AuthenticationError):
+            return None
+        lane = {"conn": conn, "lock": threading.RLock(),
+                "addr": address, "sent_fns": set(), "sent_hdrs": {},
+                "hdr_blobs": {}}
+        with self._p2p_lock:
+            ex = self._p2p_lanes.get(address)
+            if ex is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                return ex
+            self._p2p_lanes[address] = lane
+        threading.Thread(target=self._lane_reader, args=(lane,),
+                         daemon=True,
+                         name="ray_tpu_actor_lane").start()
+        return lane
+
+    def _lane_reader(self, lane: dict) -> None:
+        """Drain ("ares", ...) result frames off an actor lane; EOF
+        (peer died, chaos sever) sweeps every in-flight call routed
+        over it into the head-path fallback — same ids, exactly-once."""
+        conn = lane["conn"]
+        try:
+            while not self._shutdown:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    break
+                if not (isinstance(msg, tuple) and msg
+                        and msg[0] == "ares"):
+                    break
+                self._on_ares(msg)
+        finally:
+            # a raise out of _on_ares (short frame, prefetch error) must
+            # still tear the lane down — a reader-less lane would leave
+            # every later call on this route to the slow timeout sweep
+            self._drop_lane(lane, "peer lane lost")
+
+    def _drop_lane(self, lane: dict, reason: str) -> None:
+        addr = lane["addr"]
+        with self._p2p_lock:
+            if self._p2p_lanes.get(addr) is lane:
+                del self._p2p_lanes[addr]
+        try:
+            lane["conn"].close()
+        except Exception:
+            pass
+        self._sweep_route(addr, reason)
+
+    def _sever_lane(self, address: tuple, reason: str) -> None:
+        with self._p2p_lock:
+            lane = self._p2p_lanes.get(tuple(address))
+        if lane is not None:
+            self._drop_lane(lane, reason)
+        else:
+            self._sweep_route(tuple(address), reason)
+
+    def _sweep_route(self, address: tuple, reason: str) -> None:
+        with self._p2p_lock:
+            tids = [t for t, i in self._p2p_calls.items()
+                    if tuple(i["route"][1]) == address]
+        for t in tids:
+            self._fallback_call(t, reason)
+
+    def _on_ares(self, msg: tuple) -> None:
+        _, tid_bin, status, data, _timing = msg
+        with self._p2p_lock:
+            info = self._p2p_calls.pop(tid_bin, None)
+        if info is None:
+            return  # already fell back (sweep won the race) — ignore
+        if status == "miss":
+            # stale route: the actor moved or its worker died there
+            with self._p2p_lock:
+                self._actor_routes.pop(info["actor"], None)
+            self._fallback_call(tid_bin, "peer reported no such actor",
+                                info)
+            return
+        if status == "done":
+            peer = tuple(info["route"][1])
+            for i, entry in enumerate(data or []):
+                if not (isinstance(entry, tuple) and entry):
+                    continue
+                if (entry[0] == "remote_shm"
+                        and i < len(info["returns"])):
+                    # results return on the same link: pull the bytes
+                    # from the executing peer at task-arg priority
+                    self.pulls.prefetch(peer, info["returns"][i],
+                                        PullManager.PRIO_ARG)
+                elif entry[0] == "inline" and i < len(info["returns"]):
+                    self._adopt_inline(info["returns"][i], entry[1])
+        # err: nothing to localize — the head stores the exception
+        # from the completion receipt and the caller's get resolves it
+
+    def _adopt_inline(self, rid_bin: bytes, data: bytes) -> None:
+        """Adopt an inline result into the local store so the caller's
+        get is answered node-locally instead of via the head."""
+        oid = ObjectID(rid_bin)
+        if self.store.contains(oid):
+            return
+        try:
+            kind, target = self.store.begin_adopt(oid, len(data))
+        except Exception:
+            return
+        try:
+            if kind == "arena":
+                target[:len(data)] = data
+            else:
+                target.write(data)
+        except Exception:
+            if kind == "arena":
+                target.release()
+            self.store.abort_adopt(oid, kind,
+                                   None if kind == "arena" else target)
+            return
+        if kind == "arena":
+            target.release()
+        self.store.finish_adopt(oid, len(data), kind,
+                                None if kind == "arena" else target)
+
+    def _fallback_call(self, tid_bin: bytes, reason: str,
+                       info: Optional[dict] = None) -> None:
+        """Re-route an in-flight p2p call through the head with the
+        SAME task id / return ids / attempt token. The executing
+        worker's dedup cache (p2p payloads carry dedup=True) re-emits
+        the recorded completion if the peer actually ran the first
+        attempt — bit-correct exactly-once, whichever half of the
+        lane died."""
+        if info is None:
+            with self._p2p_lock:
+                info = self._p2p_calls.pop(tid_bin, None)
+        if info is None:
+            return
+        self._send_head(("p2p_fallback", tid_bin, {
+            "actor": info["actor"], "method": info["method"],
+            "blob": info["blob"], "num_returns": info["num_returns"],
+            "returns": info["returns"], "caller": info["caller"],
+            "trace": info["trace"], "attempt": info.get("attempt", 0),
+            "reason": reason,
+        }))
+
+    def _p2p_sweep_loop(self) -> None:
+        """Safety net under the lane-EOF sweep: a call whose result
+        frame never arrives (peer wedged, frame lost to a half-dead
+        socket) falls back through the head after a generous timeout."""
+        while not self._shutdown:
+            time.sleep(1.0)
+            now = time.monotonic()
+            with self._p2p_lock:
+                stale = [t for t, i in self._p2p_calls.items()
+                         if now - i["t"] > 15.0]
+            for t in stale:
+                self._fallback_call(t, "p2p result timed out")
+
+    def _serve_acall(self, conn, send_lock, hdr_cache: Dict[int, tuple],
+                     env_blob: bytes) -> None:
+        """Executing side of the p2p lane: decode the lease envelope,
+        dispatch each call to the resident dedicated actor worker, and
+        remember the lane so the completion goes back on it. A call
+        for an actor that does not live here (stale route) answers
+        ("ares", tid, "miss", ...) so the caller re-resolves."""
+        from ray_tpu._private.task_spec import decode_task_envelope
+
+        try:
+            payloads = decode_task_envelope(env_blob, hdr_cache)
+        except Exception:
+            return
+        for p in payloads:
+            tid_bin = p["task_id"]
+            aid_bin = p.get("actor")
+            with self._lock:
+                slot = next(
+                    (s for s in self._slots.values()
+                     if aid_bin is not None and s.actor_bin == aid_bin
+                     and s.conn is not None), None)
+            if slot is None or (slot.proc is not None
+                                and slot.proc.poll() is not None):
+                self._lane_send(("ares", tid_bin, "miss", None, None),
+                                conn, send_lock)
+                continue
+            info = {"actor": aid_bin, "caller": p.get("caller"),
+                    "caller_node": p.get("caller_node"),
+                    "method": p.get("method"), "name": p.get("name"),
+                    "trace": p.get("trace")}
+            slot.returns[tid_bin] = list(p["return_ids"])
+            slot.attempts[tid_bin] = p.get("attempt", 0)
+            with self._p2p_lock:
+                self._p2p_pending[tid_bin] = (conn, send_lock, info)
+            self._to_worker(slot, ("actor_call", p))
+
+    def _finish_p2p_exec(self, slot: _WorkerSlot, tid_bin: bytes,
+                         p2p: tuple, msg: tuple) -> None:
+        """A peer-dispatched call finished on THIS node: the head gets
+        its (report-class) completion receipt, then the result frames
+        go back over the lane the call arrived on. Receipt first and
+        always — a dead lane just means the caller's daemon falls
+        back, and the worker-side dedup cache keeps that retry
+        exactly-once."""
+        conn, send_lock, info = p2p
+        return_bins = slot.returns.pop(tid_bin, [])
+        slot.attempts.pop(tid_bin, None)
+        receipt = {"actor": info.get("actor"),
+                   "method": info.get("method"),
+                   "name": info.get("name"),
+                   "caller": info.get("caller"),
+                   "caller_node": info.get("caller_node"),
+                   "trace": info.get("trace"),
+                   "worker_num": slot.num, "returns": return_bins}
+        if msg[0] == "done":
+            out = []
+            for i, entry in enumerate(msg[2]):
+                if entry[0] == "shm" and i < len(return_bins):
+                    rid = ObjectID(return_bins[i])
+                    if self.store.locate(rid) is None:
+                        self.store.seal(rid)
+                    out.append(("remote_shm", entry[2]))
+                else:
+                    out.append(entry)
+            timing = msg[3] if len(msg) > 3 else None
+            receipt["entries"] = out
+            receipt["timing"] = timing
+            self._send_head(("p2p_done", tid_bin, receipt))
+            self._lane_send(("ares", tid_bin, "done", out, timing),
+                            conn, send_lock)
+        else:
+            timing = msg[4] if len(msg) > 4 else None
+            receipt["err"] = (msg[2], msg[3])
+            receipt["timing"] = timing
+            self._send_head(("p2p_done", tid_bin, receipt))
+            self._lane_send(("ares", tid_bin, "err",
+                             (msg[2], msg[3]), timing),
+                            conn, send_lock)
+
+    # ------------------------------------------------------------------
     # head -> daemon main loop
     # ------------------------------------------------------------------
     def run(self) -> None:
@@ -1092,6 +1720,8 @@ class NodeDaemon:
                          name="ray_tpu_node_accept").start()
         threading.Thread(target=self._log_tail_loop, daemon=True,
                          name="ray_tpu_node_log_tail").start()
+        threading.Thread(target=self._p2p_sweep_loop, daemon=True,
+                         name="ray_tpu_node_p2p_sweep").start()
         self._start_util_sampler()
         while not self._shutdown:
             try:
@@ -1206,6 +1836,10 @@ class NodeDaemon:
                 for oid_bin, address, _nbytes in msg[1]:
                     self.pulls.prefetch(address, oid_bin,
                                         PullManager.PRIO_ARG)
+            elif kind == "resview":
+                self._apply_resview(msg[1])
+            elif kind == "aroute":
+                self._on_aroute(msg[1], msg[2])
             elif kind == "free":
                 for b in msg[1]:
                     self.store.free_object(ObjectID(b))
@@ -1251,6 +1885,12 @@ class NodeDaemon:
             except Exception:  # conn refused / auth failure / reset
                 time.sleep(0.5)
                 continue
+            # p2p-pending executions are excluded from the in-flight
+            # report: their completion reaches the head as a
+            # self-contained ("p2p_done", ...) receipt, so the new head
+            # must not also adopt a lease it would wait on forever
+            with self._p2p_lock:
+                p2p_tids = set(self._p2p_pending)
             with self._lock:
                 workers = {
                     s.num: {"pid": s.pid,
@@ -1261,7 +1901,8 @@ class NodeDaemon:
                                     "returns": [b.hex() for b in rbins],
                                     "attempt": s.attempts.get(tid, 0),
                                 }
-                                for tid, rbins in s.returns.items()}}
+                                for tid, rbins in s.returns.items()
+                                if tid not in p2p_tids}}
                     for s in self._slots.values()
                     if s.proc is not None and s.proc.poll() is None}
             from ray_tpu._private.protocol import make_wire_hello
@@ -1325,6 +1966,13 @@ class NodeDaemon:
             try:
                 if entry[0] is not None:
                     entry[0].close()
+            except Exception:
+                pass
+        with self._p2p_lock:
+            lanes, self._p2p_lanes = list(self._p2p_lanes.values()), {}
+        for lane in lanes:
+            try:
+                lane["conn"].close()
             except Exception:
                 pass
         try:

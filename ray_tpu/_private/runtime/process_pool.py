@@ -1111,8 +1111,16 @@ class ProcessWorkerPool:
         if inf is None:
             return  # force-cancel/worker-failure claimed the task first
         if inf.pending is None:
-            # adopted failover lease: resolve the refs, free the worker
+            # adopted lease (failover re-attach or node-local
+            # dispatch): resolve the refs, free the worker. The trace
+            # plane may hold a live record for it (local-dispatch
+            # lane); unknown ids are a no-op pop there.
             self.store_result_entries(inf.return_ids, entries)
+            tp = self._worker.trace_plane
+            if tp is not None:
+                tp.record_finished_batch(
+                    ((task_id, timing, h.worker_id.hex(),
+                      self.node_index),), offset=self.clock_offset)
             self._lease_done(task_id)
             self._release_taken(h, inf)
             return
@@ -1158,11 +1166,17 @@ class ProcessWorkerPool:
         for h, task_id, entries, timing, inf in taken:
             self._lease_done(task_id)
             if inf.pending is None:
-                # adopted failover lease: store results only (no spec,
-                # no scheduler/task-manager state for this task here)
+                # adopted lease (failover re-attach or node-local
+                # dispatch): store results only (no spec, no
+                # scheduler/task-manager state for this task here)
                 try:
                     ready_oids.extend(
                         self._store_entries(inf.return_ids, entries))
+                    if tp is not None:
+                        tp.record_finished_batch(
+                            ((task_id, timing, h.worker_id.hex(),
+                              self.node_index),),
+                            offset=self.clock_offset)
                 except Exception:
                     logger.exception("adopted-lease completion failed")
                 continue
@@ -1222,6 +1236,9 @@ class ProcessWorkerPool:
             for oid in inf.return_ids:
                 self._worker.memory_store.put(oid, exc, is_exception=True)
                 self._worker.scheduler.notify_object_ready(oid)
+            tp = self._worker.trace_plane
+            if tp is not None:
+                tp.record_failed(task_id, type(exc).__name__)
             self._lease_done(task_id)
             self._release_taken(h, inf)
             return
@@ -1442,9 +1459,18 @@ class ProcessWorkerPool:
         ready = self._worker.memory_store.wait(oids, num_returns, timeout)
         return [o.binary() for o in oids if o in ready]
 
-    def _rpc_submit(self, h: _Handle, blob: bytes) -> list:
+    def _rpc_submit(self, h: _Handle, blob: bytes,
+                    spilled: bool = False) -> list:
         from ray_tpu._private.ids import PlacementGroupID
 
+        if spilled:
+            # the node's LocalScheduler declined this nested submission
+            # (queue at cap / ref args / special resources / retries):
+            # upward spillback — the head stays placement authority
+            self._worker.note_two_level("spillback")
+            note = getattr(self._worker.scheduler, "note_spillback", None)
+            if note is not None:
+                note()
         d = cloudpickle.loads(blob)
         func = cloudpickle.loads(d["func_blob"])
         args, kwargs = cloudpickle.loads(d["args_blob"])
@@ -1475,11 +1501,15 @@ class ProcessWorkerPool:
             borrows.add(r.object_id())
         return [r.object_id().binary() for r in refs]
 
-    def _rpc_actor_call(self, h: _Handle, blob: bytes) -> list:
+    def _rpc_actor_call(self, h: _Handle, blob: bytes,
+                        meta: Optional[tuple] = None) -> list:
         """Actor method submitted from INSIDE a worker-process task
         (reference: core-worker actor task submission from any worker).
         Runs the normal head-side submission path; the caller's task
-        borrows the return refs until it completes."""
+        borrows the return refs until it completes. ``meta`` is the
+        p2p routing hint the node daemon intercepts — by the time the
+        call reaches the head it has already chosen the head path, so
+        the hint is ignored here."""
         from ray_tpu._private.ids import ActorID
         from ray_tpu.actor import ActorHandle
 

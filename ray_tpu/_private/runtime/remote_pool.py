@@ -107,6 +107,12 @@ class RemoteNodePool(ProcessWorkerPool):
         self.outbox_replayed = 0
         self.node_id = node_id
         self._daemon_proc = daemon_proc
+        # two-level dispatch observability: task-id binaries of leases
+        # the node's LocalScheduler admitted that are still in flight
+        # (their completions resolve through the adopted-lease path),
+        # and a lifetime counter — both surfaced by state.list_nodes
+        self._local_tids: set = set()
+        self.local_dispatched = 0
         self._hqueues: Dict[int, queue.Queue] = {}
         self._fetches: Dict[int, Tuple[threading.Event, list]] = {}
         self._pings: Dict[int, Tuple[threading.Event, list]] = {}
@@ -242,6 +248,35 @@ class RemoteNodePool(ProcessWorkerPool):
             if pp is not None:
                 pp.record_util(self.node_index, msg[1],
                                offset=self.clock_offset)
+        elif kind == "local_lease":
+            # the node's LocalScheduler admitted a worker-submitted
+            # task without a head round-trip: journal + adopt it so
+            # failover reconciliation and ref bookkeeping see it as if
+            # the head had placed it (outbox FIFO guarantees this
+            # arrives before the lease's own done/err)
+            with self._seq_lock:
+                self._local_tids.add(msg[1])
+                self.local_dispatched += 1
+            self._worker.on_local_lease(self, msg[1], msg[2])
+        elif kind == "p2p_done":
+            # sequenced completion receipt for a peer-to-peer actor
+            # call: results already flowed peer→peer; the head only
+            # stores lineage/ownership (exactly-once vs any fallback)
+            self._worker.on_p2p_done(self, msg[1], msg[2])
+        elif kind == "p2p_fallback":
+            # a peer lane died/dropped/timed out mid-call: re-execute
+            # through the head path with the same attempt token; the
+            # worker-side dedup cache makes the retry exactly-once
+            self._worker.on_p2p_fallback(self, msg[1], msg[2])
+        elif kind == "aresolve":
+            # daemon asks where an actor lives (first p2p call to it)
+            route = self._worker.resolve_actor_address(msg[1])
+            self._send_daemon(("aroute", msg[1], route))
+        elif kind == "fault":
+            # a chaos injection fired on the daemon (peer_link site):
+            # merge into the head controller's log and counters
+            from ray_tpu._private.chaos import get_controller
+            get_controller().note_remote(msg[1])
         else:
             # exhaustive dispatch: an unknown daemon tag means the
             # wire protocol drifted (raylint pass 3 checks this
@@ -432,6 +467,17 @@ class RemoteNodePool(ProcessWorkerPool):
             h.inflight[task_id] = inf
             self._by_task[task_id] = h
 
+    def send_resview(self, view: dict) -> None:
+        """Push the head's resource/knob view to the node daemon: the
+        LocalScheduler admits against this (accept gate, queue cap,
+        p2p flag, job binary, mirrored chaos plan). Sent only while a
+        two-level knob is on — both off means zero wire delta."""
+        self._send_daemon(("resview", view))
+
+    def local_queue_depth(self) -> int:
+        with self._seq_lock:
+            return len(self._local_tids)
+
     # -- failover lease journal ----------------------------------------
     def _journal_lease(self, spec, payload: dict) -> None:
         """Mirror this dispatch into the GCS WAL so a restarted head
@@ -477,6 +523,8 @@ class RemoteNodePool(ProcessWorkerPool):
         super()._finish_task(pending, exec_task_id, retry)
 
     def _lease_done(self, task_id: TaskID) -> None:
+        with self._seq_lock:
+            self._local_tids.discard(task_id.binary())
         self._worker.gcs.journal_lease_done(task_id.binary())
 
     def _queue_loop(self, h: _Handle, q: queue.Queue) -> None:

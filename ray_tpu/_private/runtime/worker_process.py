@@ -26,6 +26,7 @@ import os
 import threading
 import time
 import traceback
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -139,7 +140,11 @@ class ProcessWorkerContext:
     def submit_task(self, spec) -> list:
         from ray_tpu._private.object_ref import ObjectRef
 
-        blob = _dump_spec(spec, trace=self._runner.current_trace)
+        # mark_refs only when the node daemon advertised local dispatch:
+        # the extra has_refs key changes the submit blob, and with the
+        # knob off the wire must stay byte-for-byte pre-two-level
+        blob = _dump_spec(spec, trace=self._runner.current_trace,
+                          mark_refs=self._runner.two_level[0])
         return_bins = self._runner.rpc("submit", (blob,))
         return [ObjectRef(ObjectID(b), None) for b in return_bins]
 
@@ -155,11 +160,24 @@ class ProcessWorkerContext:
         runtime tables) over the pipe RPC."""
         from ray_tpu._private.object_ref import ObjectRef
 
-        blob = cloudpickle.dumps(
-            (actor_id.binary(), method_name, args, kwargs, num_returns,
-             self._runner.current_trace),
-            protocol=5)
-        ret_bins = self._runner.rpc("actor_call", (blob,))
+        if self._runner.two_level[1]:
+            # p2p lane advertised by the node daemon: ship routing meta
+            # alongside the (unchanged) call blob so the daemon can
+            # dispatch straight to the actor's peer without unpickling
+            # user args. Ref-carrying calls stay head-routed (the owner
+            # resolves/borrow-tracks refs).
+            blob, has_refs = _dumps_mark_refs(
+                (actor_id.binary(), method_name, args, kwargs,
+                 num_returns, self._runner.current_trace))
+            meta = (actor_id.binary(), method_name, num_returns,
+                    self._runner.current_trace, not has_refs)
+            ret_bins = self._runner.rpc("actor_call", (blob, meta))
+        else:
+            blob = cloudpickle.dumps(
+                (actor_id.binary(), method_name, args, kwargs, num_returns,
+                 self._runner.current_trace),
+                protocol=5)
+            ret_bins = self._runner.rpc("actor_call", (blob,))
         refs = [ObjectRef(ObjectID(b), None) for b in ret_bins]
         return refs[0] if num_returns == 1 else refs
 
@@ -181,21 +199,56 @@ class ProcessWorkerContext:
             "futures/await on refs are driver-side APIs")
 
 
-def _dump_spec(spec, trace=None) -> bytes:
+def _dumps_mark_refs(value) -> Tuple[bytes, bool]:
+    """cloudpickle.dumps plus "did any ObjectRef ride inside" — one
+    pass, same bytes. The two-level dispatch paths need the answer
+    (ref-carrying payloads must stay head-routed, where deps resolve),
+    and a second scan pass over large args would double serialization
+    cost on the hot path."""
+    import io
+
+    from ray_tpu._private.object_ref import ObjectRef
+
+    seen: list = []
+
+    class _P(cloudpickle.Pickler):
+        def reducer_override(self, obj):
+            if isinstance(obj, ObjectRef):
+                seen.append(obj)
+            # chain to cloudpickle's reducer (lambdas, closures,
+            # __main__ classes pickle by value) — see _RefCollectPickler
+            return super().reducer_override(obj)
+
+    buf = io.BytesIO()
+    _P(buf, protocol=5).dump(value)
+    return buf.getvalue(), bool(seen)
+
+
+def _dump_spec(spec, trace=None, mark_refs=False) -> bytes:
     """Ship a TaskSpec for owner-side admission (func by value).
     ``trace`` is the SUBMITTING task's trace context: the owner restores
     it as the ambient parent around admission so the nested task's own
-    context is stamped as its child."""
+    context is stamped as its child. ``mark_refs`` adds a has_refs key
+    (for the daemon's local-dispatch eligibility check) — only set when
+    the daemon advertised two-level dispatch, so the knobs-off blob is
+    unchanged."""
+    if mark_refs:
+        args_blob, has_refs = _dumps_mark_refs((spec.args, spec.kwargs))
+    else:
+        args_blob = cloudpickle.dumps((spec.args, spec.kwargs))
+        has_refs = None
     d = dict(
         name=spec.name,
         func_blob=spec.serialized_func or cloudpickle.dumps(spec.func),
         func_descriptor=spec.func_descriptor,
-        args_blob=cloudpickle.dumps((spec.args, spec.kwargs)),
+        args_blob=args_blob,
         num_returns=spec.num_returns,
         resources=spec.resources,
         max_retries=spec.max_retries,
         retry_exceptions=spec.retry_exceptions,
     )
+    if has_refs is not None:
+        d["has_refs"] = has_refs
     if trace is not None:
         d["trace"] = trace
     if spec.placement_group_id is not None:
@@ -236,6 +289,16 @@ class _WorkerRunner:
         # parentage crosses the process boundary
         self.current_trace = None
         self.put_counter = 0
+        # (local_dispatch, actor_p2p) as advertised by the spawning node
+        # daemon's ("p2p", local, p2p) broadcast; both stay False under
+        # head-spawned workers and when the knobs are off, keeping the
+        # submit/actor-call wire bytes identical to pre-two-level
+        self.two_level: Tuple[bool, bool] = (False, False)
+        # exactly-once guard for p2p->head fallback retries: payloads
+        # marked dedup=True cache their completion message by task id so
+        # a re-delivered attempt re-emits the SAME result bytes instead
+        # of re-executing the method (bounded; fallbacks are rare)
+        self._dedup_done: "OrderedDict[bytes, tuple]" = OrderedDict()
         self.cancelled: set = set()  # task_id binaries
         self._rpc_seq = 0
         self._rpc_lock = threading.RLock()
@@ -296,6 +359,21 @@ class _WorkerRunner:
     def rpc(self, op: str, args: tuple):
         blocking = op in ("get", "wait")
         with self._rpc_lock:
+            if blocking:
+                # tasks dispatched to THIS slot mid-rpc (the daemon's
+                # local scheduler may pick the submitter as a last
+                # resort) queue in the inbox; the outer task is about
+                # to block — possibly on those very results — so run
+                # them now, same reasoning as the pipelined-pipe case
+                # below
+                while True:
+                    m = next((x for x in self._inbox
+                              if x[0] in ("task", "tasks", "env",
+                                          "ring")), None)
+                    if m is None:
+                        break
+                    self._inbox.remove(m)
+                    self._run_nested(m)
             # owner-side borrow bookkeeping attributes this rpc to the
             # OLDEST unfinished lease: completions buffered for batch
             # send must reach the owner first
@@ -330,6 +408,10 @@ class _WorkerRunner:
                 if msg[0] in ("actor_create", "actor_call", "exit"):
                     # queue for the main loop (arrival order preserved)
                     self._inbox.append(msg)
+                    continue
+                if msg[0] == "p2p":
+                    # daemon two-level advertisement — may land mid-rpc
+                    self.two_level = (bool(msg[1]), bool(msg[2]))
                     continue
                 # protocol violation — only replies may arrive mid-task
                 raise RuntimeError(f"unexpected message during rpc: {msg[0]}")
@@ -476,8 +558,20 @@ class _WorkerRunner:
         self._run_payload(payload, run)
 
     def actor_call(self, payload: dict) -> None:
+        # peer-dispatched calls carry the CALLER's pickled call tuple
+        # (the daemon lane never unpickles user args — only this
+        # dedicated actor process has the user's modules); eligibility
+        # guaranteed it holds no ObjectRefs, so no _resolve pass needed
+        pb = payload.get("p2p_blob")
+
         def run(args, kwargs):
             import inspect
+            if pb is not None:
+                # decode inside the guarded path: a blob that fails to
+                # unpickle (caller-only module, corrupt frame) must error
+                # THIS call, not crash the dedicated actor process
+                t = cloudpickle.loads(pb)
+                args, kwargs = t[2], t[3]
             method = getattr(self.actor_instance, payload["method"])
             result = method(*args, **kwargs)
             if inspect.isgenerator(result):
@@ -490,6 +584,14 @@ class _WorkerRunner:
         from ray_tpu import exceptions as rex
 
         task_id = TaskID(payload["task_id"])
+        if payload.get("dedup"):
+            cached = self._dedup_done.get(payload["task_id"])
+            if cached is not None:
+                # a p2p attempt of this call already completed here and
+                # the head is retrying after a severed peer lane: re-emit
+                # the recorded result, bit for bit, without re-executing
+                self._emit(cached)
+                return
         # save/restore: a task may execute NESTED inside another task's
         # blocking get (see _run_nested)
         prev_task_id = self.current_task_id
@@ -578,6 +680,13 @@ class _WorkerRunner:
             return_ids = [ObjectID(b) for b in payload["return_ids"]]
             entries = [self.store_value(oid, v)
                        for oid, v in zip(return_ids, values)]
+            # record BEFORE emit (a retry after an emit-then-crash must
+            # replay, not re-execute); the completion frame itself stays
+            # a literal tuple at the _emit site for the wire-lint pass
+            if payload.get("dedup"):
+                self._dedup_record(payload["task_id"],
+                                   ("done", payload["task_id"], entries,
+                                    (t0, t1)))
             self._emit(("done", payload["task_id"], entries, (t0, t1)))
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
@@ -586,8 +695,12 @@ class _WorkerRunner:
             except Exception:
                 blob = cloudpickle.dumps(
                     RuntimeError(f"[unpicklable {type(e).__name__}] {e}"))
-            self._emit(("err", payload["task_id"], blob, tb,
-                        (t0, time.time())))
+            t_err = time.time()
+            if payload.get("dedup"):
+                self._dedup_record(payload["task_id"],
+                                   ("err", payload["task_id"], blob, tb,
+                                    (t0, t_err)))
+            self._emit(("err", payload["task_id"], blob, tb, (t0, t_err)))
         finally:
             if env_ctx is not None:
                 env_ctx.__exit__(None, None, None)
@@ -608,6 +721,11 @@ class _WorkerRunner:
             self.current_trace = prev_trace
             self.current_task_name = prev_task_name
             self.put_counter = prev_put_counter
+
+    def _dedup_record(self, tid_bin: bytes, msg: tuple) -> None:
+        self._dedup_done[tid_bin] = msg
+        while len(self._dedup_done) > 256:
+            self._dedup_done.popitem(last=False)
 
     def _resolve(self, v: Any) -> Any:
         if isinstance(v, _ShmValue):
@@ -699,6 +817,11 @@ class _WorkerRunner:
                 self.actor_create(msg[1])
             elif kind == "actor_call":
                 self.actor_call(msg[1])
+            elif kind == "p2p":
+                # same guard as the mid-rpc arrival path: the advert is
+                # an atomic tuple rebind, but readers sit on rpc threads
+                with self._rpc_lock:
+                    self.two_level = (bool(msg[1]), bool(msg[2]))
             elif kind == "exit":
                 self._stop = True
             else:

@@ -35,6 +35,27 @@ class SchedulerBase:
     # column; None (or an empty list per oid) disables it.
     locations_of = None
 
+    # Two-level scheduling counters: node-local admissions this
+    # scheduler never placed, and their upward spillbacks that landed
+    # back on its queue. Bare class attrs so both implementations (and
+    # tests' stubs) inherit the zero without extra __init__ plumbing;
+    # the notes below rebind instance attrs, and the only writers are
+    # the head's daemon-demux/rpc threads, which bump under the GIL
+    # at report granularity (exactness is not load-bearing — the
+    # authoritative counts live in worker.two_level_stats).
+    _num_local_dispatch = 0
+    _num_spillback = 0
+
+    def note_local_dispatch(self) -> None:
+        """A node's LocalScheduler admitted a worker-submitted task
+        without this (head) scheduler ever seeing it."""
+        self._num_local_dispatch += 1
+
+    def note_spillback(self) -> None:
+        """A node declined a local submission (queue full / unfit) and
+        spilled it up to this scheduler's normal path."""
+        self._num_spillback += 1
+
     def submit(self, task: PendingTask) -> None:
         raise NotImplementedError
 

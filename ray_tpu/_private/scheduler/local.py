@@ -219,6 +219,8 @@ class EventScheduler(SchedulerBase):
                 "submitted": self._num_submitted,
                 "dispatched": self._num_dispatched,
                 "finished": self._num_finished,
+                "local_dispatch": self._num_local_dispatch,
+                "spillback": self._num_spillback,
                 "waiting_deps": len(self._dep_count),
                 "ready_queue": len(self._ready),
                 "infeasible": len(self._infeasible),

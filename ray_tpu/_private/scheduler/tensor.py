@@ -222,6 +222,8 @@ class TensorScheduler(SchedulerBase):
                 "submitted": self._num_submitted,
                 "dispatched": self._num_dispatched,
                 "finished": self._num_finished,
+                "local_dispatch": self._num_local_dispatch,
+                "spillback": self._num_spillback,
                 "ticks": self._num_ticks,
                 "waiting_deps": int(dep_blocked.sum()),
                 "ready_queue": int(ready_mask.sum()) - infeasible,

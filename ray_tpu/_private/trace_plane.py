@@ -66,6 +66,7 @@ END = 13
 STATE = 14      # "LIVE" | "FINISHED" | "FAILED"
 ERROR = 15      # error type name for failed attempts
 RETRIED = 16    # failed attempt that was retried (not terminal)
+LANE = 17       # dispatch lane: None (head) | "local" | "p2p"
 
 _LIVE, _FINISHED, _FAILED = "LIVE", "FINISHED", "FAILED"
 
@@ -163,7 +164,7 @@ class TraceAggregator:
                  attempt: int, now: float) -> list:
         return [key, name, kind, ctx[0], ctx[1], ctx[2], attempt,
                 -1, None, now, None, None, None, None, _LIVE, None,
-                False]
+                False, None]
 
     def on_submit_batch(self, specs: Iterable[Any]) -> None:
         """Stamp unstamped specs with a context (child of the thread's
@@ -209,6 +210,57 @@ class TraceAggregator:
             self._live[call.task_id] = rec
             if len(self._live) > self._live_cap:
                 self._trim_live_locked()
+
+    def record_local_dispatch(self, task_id: Any, name: str,
+                              ctx: Optional[Tuple], node: int,
+                              now: Optional[float] = None) -> None:
+        """A node's LocalScheduler admitted a worker-submitted task
+        without a head round-trip: open the attempt record directly in
+        the dispatched state, flagged ``lane="local"`` so the export
+        draws its dispatch arrow from the NODE's lane, not the head
+        scheduler lane it never crossed."""
+        if ctx is None or not ctx[3]:
+            return
+        t = now if now is not None else time.time()
+        rec = self._new_rec(task_id, name, "task", ctx, 0, t)
+        rec[DISPATCHED] = t
+        rec[NODE] = node
+        rec[LANE] = "local"
+        with self._lock:
+            self._live[task_id] = rec
+            if len(self._live) > self._live_cap:
+                self._trim_live_locked()
+
+    def record_p2p_span(self, task_id: Any, name: str,
+                        ctx: Optional[Tuple], node: int,
+                        timing: Optional[Tuple[float, float]],
+                        worker: Optional[Any] = None,
+                        offset: float = 0.0,
+                        error_type: Optional[str] = None) -> None:
+        """A peer-to-peer actor call's completion receipt: the head
+        learns of the call only now, so the record opens and finalizes
+        together — ``lane="p2p"`` suppresses the head-side logical and
+        scheduler spans at export (the call never touched them) while
+        the exec span and the worker->peer flow arrow remain."""
+        if ctx is None or not ctx[3]:
+            return
+        now = time.time()
+        rec = self._new_rec(task_id, name, "actor", ctx, 0, now)
+        rec[NODE] = node
+        rec[LANE] = "p2p"
+        if worker is not None:
+            rec[WORKER] = worker
+        if timing is not None:
+            rec[START] = timing[0] + offset
+            rec[END] = timing[1] + offset
+            rec[SUBMITTED] = rec[START]
+        else:
+            rec[END] = now
+        if error_type is not None:
+            rec[ERROR] = error_type
+        with self._lock:
+            self._finalize_locked(rec,
+                                  _FAILED if error_type else _FINISHED)
 
     def record_dispatched_batch(
             self, rows: Iterable[Tuple[Any, int]]) -> None:
@@ -449,7 +501,7 @@ def _export(recs: List[list]) -> List[Dict[str, Any]]:
                            "tid": 1, "args": {"name": "scheduler"}})
             lanes_per_pid[0] = 1  # head exec lanes start at tid 2
 
-    def _lane(pid: int, worker: Any) -> int:
+    def _lane(pid: int, worker: Any, label: Optional[str] = None) -> int:
         key = (pid, worker)
         t = lanes.get(key)
         if t is None:
@@ -458,7 +510,8 @@ def _export(recs: List[list]) -> List[Dict[str, Any]]:
             lanes[key] = t
             events.append({"name": "thread_name", "ph": "M",
                            "pid": pid, "tid": t,
-                           "args": {"name": f"worker {worker}"}})
+                           "args": {"name": label
+                                    or f"worker {worker}"}})
         return t
 
     _pid_meta(0)
@@ -478,8 +531,12 @@ def _export(recs: List[list]) -> List[Dict[str, Any]]:
         t_lo = min(subs) if subs else None
         t_hi = (max(ends) if ends
                 else (time.time() if t_lo is not None else None))
-        if t_lo is not None and t_hi is not None:
-            # the logical span: driver submit -> resolve
+        span_p2p = all(r[LANE] == "p2p" for r in srecs)
+        if t_lo is not None and t_hi is not None and not span_p2p:
+            # the logical span: driver submit -> resolve. A purely
+            # peer-to-peer call never touched the head lane — emitting
+            # a head span for it would invent a round-trip that the
+            # whole p2p plane exists to remove.
             events.append({"name": r0[NAME], "cat": "span", "ph": "X",
                            "pid": 0, "tid": 0, "ts": t_lo * 1e6,
                            "dur": max(t_hi - t_lo, 0.0) * 1e6,
@@ -494,15 +551,31 @@ def _export(recs: List[list]) -> List[Dict[str, Any]]:
             sub, dsp = rec[SUBMITTED], rec[DISPATCHED]
             stg = rec[STAGED]
             node = rec[NODE]
+            lane = rec[LANE]
             pid = node if isinstance(node, int) and node >= 0 else 0
             _pid_meta(pid)
+            sched_src = None  # (pid, tid) the dispatch arrow leaves from
             if sub is not None and dsp is not None and dsp >= sub:
-                events.append({"name": f"sched:{rec[NAME]}",
-                               "cat": "sched", "ph": "X", "pid": 0,
-                               "tid": 1, "ts": sub * 1e6,
-                               "dur": (dsp - sub) * 1e6,
-                               "args": dict(args, node_chosen=node,
-                                            staged=stg is not None)})
+                if lane == "local":
+                    # admitted by the NODE's LocalScheduler: its
+                    # decision span lives on the node, not the head
+                    ltid = _lane(pid, "__lsched__", "local scheduler")
+                    sched_src = (pid, ltid)
+                    events.append({"name": f"lsched:{rec[NAME]}",
+                                   "cat": "sched", "ph": "X",
+                                   "pid": pid, "tid": ltid,
+                                   "ts": sub * 1e6,
+                                   "dur": (dsp - sub) * 1e6,
+                                   "args": dict(args, node_chosen=node,
+                                                lane="local")})
+                elif lane is None:
+                    sched_src = (0, 1)
+                    events.append({"name": f"sched:{rec[NAME]}",
+                                   "cat": "sched", "ph": "X", "pid": 0,
+                                   "tid": 1, "ts": sub * 1e6,
+                                   "dur": (dsp - sub) * 1e6,
+                                   "args": dict(args, node_chosen=node,
+                                                staged=stg is not None)})
             t0, t1 = rec[START], rec[END]
             if t0 is not None and t1 is not None:
                 wkr = rec[WORKER] if rec[WORKER] is not None else 0
@@ -512,18 +585,27 @@ def _export(recs: List[list]) -> List[Dict[str, Any]]:
                                "cat": "exec", "ph": "X", "pid": pid,
                                "tid": tid, "ts": t0 * 1e6,
                                "dur": max(t1 - t0, 0.0) * 1e6,
-                               "args": dict(args,
-                                            worker_id=str(wkr))})
+                               "args": dict(args, worker_id=str(wkr),
+                                            lane=lane or "head")})
                 anchor = dsp if dsp is not None else sub
-                if anchor is not None:
+                # p2p calls get their arrow from the CALLER's exec span
+                # (the spawn pass below, named "p2p"); the head never
+                # dispatched them, so no head-anchored arrow exists
+                if anchor is not None and lane != "p2p":
+                    src = sched_src if sched_src is not None else (0, 1)
                     fid = _flow_id(aspan + ":d")
                     events.append({"ph": "s", "cat": "flow",
-                                   "name": "dispatch", "id": fid,
-                                   "pid": 0, "tid": 1,
+                                   "name": ("local_dispatch"
+                                            if lane == "local"
+                                            else "dispatch"),
+                                   "id": fid,
+                                   "pid": src[0], "tid": src[1],
                                    "ts": anchor * 1e6})
                     events.append({"ph": "f", "bp": "e", "cat": "flow",
-                                   "name": "dispatch", "id": fid,
-                                   "pid": pid, "tid": tid,
+                                   "name": ("local_dispatch"
+                                            if lane == "local"
+                                            else "dispatch"),
+                                   "id": fid, "pid": pid, "tid": tid,
                                    "ts": t0 * 1e6})
             if rec[STATE] == _FAILED:
                 kind = "retry" if rec[RETRIED] else "failed"
@@ -552,10 +634,13 @@ def _export(recs: List[list]) -> List[Dict[str, Any]]:
             continue
         fid = _flow_id(span + ":p")
         cpid, ctid = placed[(span, child[ATTEMPT])]
-        events.append({"ph": "s", "cat": "flow", "name": "spawn",
+        # a p2p child's arrow IS its dispatch record: caller exec lane
+        # straight to the peer exec lane, no head hop in between
+        aname = "p2p" if child[LANE] == "p2p" else "spawn"
+        events.append({"ph": "s", "cat": "flow", "name": aname,
                        "id": fid, "pid": ppl[0], "tid": ppl[1],
                        "ts": child[SUBMITTED] * 1e6})
         events.append({"ph": "f", "bp": "e", "cat": "flow",
-                       "name": "spawn", "id": fid, "pid": cpid,
+                       "name": aname, "id": fid, "pid": cpid,
                        "tid": ctid, "ts": child[START] * 1e6})
     return events

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import collections
 import heapq
+from collections import OrderedDict
 import logging
 import os
 import threading
@@ -474,6 +475,23 @@ class Worker:
                                                "bytes_saved": 0}
         self._transfer_stats_lock = runtime_sanitizer.wrap_lock(
             threading.Lock(), "_private.worker.Worker._transfer_stats_lock")
+        # two-level scheduling / p2p actor plane accounting (zeros keep
+        # the metric families schema-stable while the knobs are off).
+        # Written from daemon demux threads and the head rpc pool at
+        # once — same locked-increment contract as transfer_stats.
+        self.two_level_stats: Dict[str, int] = {"local_dispatch": 0,
+                                                "spillback": 0,
+                                                "p2p": 0,
+                                                "head_fallback": 0}
+        # p2p exactly-once arbiter: first arrival (completion receipt
+        # OR head fallback) for a task id claims it, the loser no-ops.
+        # Bounded FIFO — duplicates race within seconds, not hours.
+        self._p2p_seen: "OrderedDict[bytes, bool]" = OrderedDict()
+        self._p2p_seen_lock = runtime_sanitizer.wrap_lock(
+            threading.Lock(), "_private.worker.Worker._p2p_seen_lock")
+        # resource-view push thread (started with the first remote
+        # node; sends only while a two-level knob is on)
+        self._resview_thread: Optional[threading.Thread] = None
         # single-flight head-side peer pulls (oid -> completion event)
         self._head_pull_lock = runtime_sanitizer.wrap_lock(
             threading.Lock(), "_private.worker.Worker._head_pull_lock")
@@ -827,6 +845,253 @@ class Worker:
         if pool is not None and getattr(pool, "is_remote", False):
             return getattr(pool, "peer_address", None)
         return None
+
+    # ------------------------------------------------------------------
+    # Two-level scheduling + p2p actor plane (bottom-up dispatch: the
+    # node daemons admit work and execute actor calls peer-to-peer; the
+    # head stays the single placement/bookkeeping authority and only
+    # sees sequenced reports)
+    # ------------------------------------------------------------------
+    def note_two_level(self, key: str, delta: int = 1) -> None:
+        with self._transfer_stats_lock:
+            self.two_level_stats[key] = \
+                self.two_level_stats.get(key, 0) + delta
+
+    def _p2p_claim(self, tid_bin: bytes) -> bool:
+        """First claim on a p2p call's completion wins: the completion
+        receipt and the head-fallback retry race for the same task id,
+        and exactly one of them may resolve/execute it."""
+        with self._p2p_seen_lock:
+            if tid_bin in self._p2p_seen:
+                return False
+            self._p2p_seen[tid_bin] = True
+            while len(self._p2p_seen) > 4096:
+                self._p2p_seen.popitem(last=False)
+            return True
+
+    def on_local_lease(self, pool, tid_bin: bytes, info: dict) -> None:
+        """A node's LocalScheduler admitted a worker-submitted task
+        from its bounded local queue without a head round-trip. Adopt
+        the lease head-side: own + journal it so failover
+        reconciliation and ref bookkeeping behave exactly as if the
+        head had placed it (outbox FIFO guarantees this report lands
+        before the lease's own done/err)."""
+        self.note_two_level("local_dispatch")
+        note = getattr(self.scheduler, "note_local_dispatch", None)
+        if note is not None:
+            note()
+        returns = list(info.get("returns") or ())
+        rids = [ObjectID(b) for b in returns]
+        for oid in rids:
+            self.reference_counter.add_owned_object(oid)
+        with pool._lock:
+            h = pool._by_num.get(info.get("worker_num"))
+            sub = pool._by_num.get(info.get("submitter"))
+        if h is not None:
+            pool.adopt_inflight(h, tid_bin, returns, 0)
+        if self.gcs.journal_enabled:
+            self.gcs.journal_lease(tid_bin, {
+                "name": info.get("name"),
+                "fn_blob": info.get("fn_blob"),
+                "args_blob": info.get("args_blob"),
+                "num_returns": int(info.get("num_returns", 1)),
+                "returns": returns,
+                "resources": dict(info.get("resources") or {}),
+                "attempt": 0,
+                "max_retries": 0,
+                "node_index": pool.node_index,
+            })
+        if sub is not None:
+            # the submitting task borrows its nested refs until it
+            # completes, mirroring the head-path _rpc_submit
+            borrows = pool._task_borrows(sub)
+            for oid in rids:
+                self.reference_counter.add_borrower(oid, sub.worker_id)
+                borrows.add(oid)
+        tp = self.trace_plane
+        if tp is not None:
+            ts = info.get("t")
+            tp.record_local_dispatch(
+                TaskID(tid_bin), info.get("name") or "?",
+                info.get("trace"), pool.node_index,
+                now=(ts + pool.clock_offset) if ts else None)
+
+    def on_p2p_done(self, pool, tid_bin: bytes, receipt: dict) -> None:
+        """Sequenced completion receipt for a peer-to-peer actor call:
+        the result bytes already moved worker -> peer daemon directly,
+        so this is lineage, ownership and observability only.
+        ``pool`` is the EXECUTING node's pool (its daemon reported)."""
+        if not self._p2p_claim(tid_bin):
+            return  # the head-fallback retry already resolved the call
+        self.note_two_level("p2p")
+        returns = list(receipt.get("returns") or ())
+        rids = [ObjectID(b) for b in returns]
+        for oid in rids:
+            self.reference_counter.add_owned_object(oid)
+        err = receipt.get("err")
+        if err is not None:
+            import cloudpickle
+            try:
+                exc = cloudpickle.loads(err[0])
+            except Exception:
+                exc = RuntimeError(
+                    "p2p actor call failed (exception undeserializable)")
+            if not isinstance(exc, (rex.TaskError, rex.ActorError)):
+                exc = rex.TaskError(
+                    f"{receipt.get('name')}.{receipt.get('method')}",
+                    exc, err[1] or "")
+            for oid in rids:
+                self.memory_store.put(oid, exc, is_exception=True)
+                self.scheduler.notify_object_ready(oid)
+        else:
+            pool.store_result_entries(rids,
+                                      list(receipt.get("entries") or ()))
+        # the calling task (on the CALLER's node) borrows the refs
+        # until it completes, mirroring the head-path _rpc_actor_call
+        cpool = self._node_pools.get(receipt.get("caller_node"))
+        if cpool is not None:
+            with cpool._lock:
+                ch = cpool._by_num.get(receipt.get("caller"))
+            if ch is not None:
+                borrows = cpool._task_borrows(ch)
+                for oid in rids:
+                    self.reference_counter.add_borrower(oid, ch.worker_id)
+                    borrows.add(oid)
+        tp = self.trace_plane
+        if tp is not None:
+            tp.record_p2p_span(
+                TaskID(tid_bin),
+                f"{receipt.get('name')}.{receipt.get('method')}",
+                receipt.get("trace"), pool.node_index,
+                receipt.get("timing"),
+                worker=receipt.get("worker_num"),
+                offset=pool.clock_offset,
+                error_type=(type(exc).__name__ if err is not None
+                            else None))
+
+    def on_p2p_fallback(self, pool, tid_bin: bytes, info: dict) -> None:
+        """A peer lane died/dropped/timed out mid-call: re-execute
+        through the normal head-side actor runtime with the SAME task
+        id / return ids / trace context. The executing worker's dedup
+        cache re-emits the recorded completion if the peer actually
+        ran the first attempt — exactly-once either way. ``pool`` is
+        the CALLER's pool (its daemon reported the fallback)."""
+        import cloudpickle
+
+        from ray_tpu.actor import ActorState, _Call
+
+        if not self._p2p_claim(tid_bin):
+            return  # the completion receipt beat the fallback report
+        # count only claimed fallbacks (mirrors on_p2p_done's 'p2p'
+        # accounting) — a lost race here was a fully-served p2p call
+        self.note_two_level("head_fallback")
+        self._chaos.note_recovery("peer_link")
+        returns = list(info.get("returns") or ())
+        rids = [ObjectID(b) for b in returns]
+        for oid in rids:
+            self.reference_counter.add_owned_object(oid)
+
+        def _fail(exc: BaseException) -> None:
+            for oid in rids:
+                self.memory_store.put(oid, exc, is_exception=True)
+                self.scheduler.notify_object_ready(oid)
+
+        try:
+            t = cloudpickle.loads(info["blob"])
+            args, kwargs = t[2], t[3]
+        except Exception as e:
+            _fail(rex.TaskError(str(info.get("method")), e, ""))
+            return
+        aid = ActorID(info["actor"])
+        with self._actors_lock:
+            rt = self.actors.get(aid)
+        if rt is None or rt.state == ActorState.DEAD:
+            _fail(rex.ActorDiedError(
+                f"p2p fallback: actor {aid.hex()[:16]} is gone "
+                f"({info.get('reason')})", actor_id=aid))
+            return
+        call = _Call(info["method"], args, kwargs, rids,
+                     int(info.get("num_returns", 1)), TaskID(tid_bin),
+                     trace_ctx=info.get("trace"), dedup=True)
+        tp = self.trace_plane
+        if tp is not None and call.trace_ctx is not None:
+            tp.on_actor_call(call, str(info.get("method")),
+                             rt._current_node_index)
+        with pool._lock:
+            ch = pool._by_num.get(info.get("caller"))
+        if ch is not None:
+            borrows = pool._task_borrows(ch)
+            for oid in rids:
+                self.reference_counter.add_borrower(oid, ch.worker_id)
+                borrows.add(oid)
+        try:
+            rt.submit(call)
+        except Exception as e:  # e.g. PendingCallsLimitExceeded
+            _fail(e if isinstance(e, rex.RayTpuError)
+                  else rex.TaskError(str(info.get("method")), e, ""))
+
+    def resolve_actor_address(self, aid_bin: bytes) -> Optional[tuple]:
+        """(node_index, peer_address, worker_num) of a live process
+        actor's dedicated worker, or None (thread-mode actor, not
+        alive, or node without a peer plane) — a None route keeps the
+        daemon on the head path. Knob-gated: with actor_p2p off no
+        route exists anywhere (``state.list_actors`` shows None and
+        aroute requests — which should not occur — resolve to the
+        head path)."""
+        from ray_tpu.actor import ActorState
+
+        if not GLOBAL_CONFIG.actor_p2p:
+            return None
+        with self._actors_lock:
+            rt = self.actors.get(ActorID(aid_bin))
+        if rt is None or rt.state != ActorState.ALIVE:
+            return None
+        h = getattr(rt, "_h", None)
+        rpool = getattr(rt, "_pool", None)
+        if h is None or rpool is None or h.dead \
+                or not getattr(rpool, "is_remote", False):
+            return None
+        peer = getattr(rpool, "peer_address", None)
+        if peer is None:
+            return None
+        return (rpool.node_index, tuple(peer), h.worker_num)
+
+    def _ensure_resview_push(self) -> None:
+        """Start the resource-view push loop with the first remote
+        node. The loop itself is knob-gated per tick, so toggling
+        local_dispatch/actor_p2p mid-session takes effect without a
+        restart; with both knobs off it sends NOTHING (wire bytes stay
+        byte-for-byte pre-two-level)."""
+        if self._resview_thread is not None:
+            return
+        t = threading.Thread(target=self._resview_push_loop, daemon=True,
+                             name="ray_tpu_resview_push")
+        self._resview_thread = t
+        t.start()
+
+    def _resview_push_loop(self) -> None:
+        while self.alive:
+            try:
+                if GLOBAL_CONFIG.local_dispatch or GLOBAL_CONFIG.actor_p2p:
+                    snap = self._chaos.plan_snapshot()
+                    for e in self.gcs.node_table():
+                        p = e.pool
+                        if p is None or not getattr(p, "is_remote", False):
+                            continue
+                        try:
+                            p.send_resview({
+                                "accept": bool(GLOBAL_CONFIG.local_dispatch),
+                                "p2p": bool(GLOBAL_CONFIG.actor_p2p),
+                                "cap": int(GLOBAL_CONFIG.local_queue_depth),
+                                "job": self.job_id.binary(),
+                                "node": p.node_index,
+                                "chaos": snap,
+                            })
+                        except Exception:
+                            pass  # a dying link re-syncs after rejoin
+            except Exception:
+                logger.exception("resview push tick failed")
+            time.sleep(0.5)
 
     def _head_util_gauges(self) -> dict:
         """Internal gauges the head's resource sampler folds into node
@@ -1603,6 +1868,7 @@ class Worker:
                            **(resources or {})},
             kind="remote", pool=pool)
         self.gcs.start_health_checks()
+        self._ensure_resview_push()
         return entry
 
     def enable_head_endpoint(self, host: str = "127.0.0.1", port: int = 0):
@@ -1716,6 +1982,7 @@ class Worker:
             node_id, row, {"CPU": num_cpus, "TPU": num_tpus, **resources},
             kind="remote", pool=pool)
         self.gcs.start_health_checks()
+        self._ensure_resview_push()
         logger.info("adopted remote node %s (row %d, arena %s)",
                     node_id.hex()[:16], row, arena_name)
         return entry
@@ -1810,6 +2077,7 @@ class Worker:
             kind="remote", pool=pool)
         self.gcs.start_health_checks()
         self.scheduler.poke()
+        self._ensure_resview_push()
         logger.info("re-adopted node %s (row %d): %d workers, %d actors, "
                     "%d in-flight leases", node_id.hex()[:16], row,
                     len(workers), adopted_actors, adopted_leases)

@@ -76,10 +76,10 @@ class ActorState(enum.Enum):
 
 class _Call:
     __slots__ = ("method_name", "args", "kwargs", "return_ids", "num_returns",
-                 "task_id", "trace_ctx")
+                 "task_id", "trace_ctx", "dedup")
 
     def __init__(self, method_name, args, kwargs, return_ids, num_returns,
-                 task_id, trace_ctx=None):
+                 task_id, trace_ctx=None, dedup=False):
         self.method_name = method_name
         self.args = args
         self.kwargs = kwargs
@@ -87,6 +87,9 @@ class _Call:
         self.num_returns = num_returns
         self.task_id = task_id
         self.trace_ctx = trace_ctx
+        # p2p head-fallback retries carry preset ids and dedup=True:
+        # the worker's completion cache makes the re-run exactly-once
+        self.dedup = dedup
 
 
 class _ActorRuntime:
@@ -745,6 +748,8 @@ class _ProcessActorRuntime(_ActorRuntime):
                     f"actor worker unavailable for {call.method_name}"))
                 return
             extra = dict(method=call.method_name)
+            if call.dedup:
+                extra["dedup"] = True
             if call.trace_ctx is not None and call.trace_ctx[3]:
                 # same payload-dict carriage as normal task leases
                 extra["trace"] = call.trace_ctx

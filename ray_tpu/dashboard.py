@@ -383,7 +383,12 @@ async function refresh() {
     document.getElementById("nodes").innerHTML = rows(nodes.map(n => ({
       node: (n.node_id || "").slice(0, 12), state: n.state || "ALIVE",
       kind: n.kind || "", resources: JSON.stringify(n.resources || {}),
-    })), ["node", "state", "kind", "resources"], ["state"]);
+      // two-level scheduling: tasks sitting admitted in the node's
+      // LocalScheduler right now / lifetime local admissions
+      localq: n.local_queue_depth ?? 0,
+      dispatched: n.local_dispatched ?? 0,
+    })), ["node", "state", "kind", "resources", "localq", "dispatched"],
+       ["state"]);
     document.getElementById("tasks").innerHTML = rows(
       Object.entries(t).map(([state, count]) => ({state, count})),
       ["state", "count"]);
@@ -413,7 +418,12 @@ async function refresh() {
     document.getElementById("actors").innerHTML = rows(actors.slice(0, 50).map(a => ({
       actor: (a.actor_id || "").slice(0, 12), name: a.name || "",
       state: a.state || "", node: a.node_index ?? "",
-    })), ["actor", "name", "state", "node"], ["state"]);
+      // peer route the p2p actor plane would ship calls to (blank for
+      // head-local actors or when actor_p2p routing is unavailable)
+      p2p: a.resolved_address ?
+        (a.resolved_address.peer || []).join(":") +
+          "#w" + a.resolved_address.worker_num : "",
+    })), ["actor", "name", "state", "node", "p2p"], ["state"]);
     const streams = s.data_streams || [];
     document.getElementById("streams").innerHTML = rows(streams.map(d => ({
       stream: d.stream_id, dataset: d.dataset, consumers: d.consumers,

@@ -68,12 +68,25 @@ def list_tasks(detail: bool = False,
 def list_actors() -> List[Dict[str, Any]]:
     """All actors from the GCS actor table (the registry of record)."""
     w = worker_mod.get_worker()
-    return [
-        {"actor_id": e.actor_id.hex(), "name": e.name,
-         "namespace": e.namespace, "class_name": e.class_name,
-         "state": e.state, "node_index": e.node_index}
-        for e in w.gcs.actor_table()
-    ]
+    rows = []
+    for e in w.gcs.actor_table():
+        row = {"actor_id": e.actor_id.hex(), "name": e.name,
+               "namespace": e.namespace, "class_name": e.class_name,
+               "state": e.state, "node_index": e.node_index}
+        # p2p routing identity: (node_index, (host, port), worker_num)
+        # when the actor is reachable over a peer daemon link, else None
+        # — the address worker-side .remote() calls ship envelopes to
+        # when actor_p2p is on.
+        resolve = getattr(w, "resolve_actor_address", None)
+        addr = resolve(e.actor_id.binary()) if resolve is not None else None
+        if addr is not None:
+            row["resolved_address"] = {"node_index": addr[0],
+                                       "peer": list(addr[1]),
+                                       "worker_num": addr[2]}
+        else:
+            row["resolved_address"] = None
+        rows.append(row)
+    return rows
 
 
 @_client_dispatch
@@ -128,6 +141,13 @@ def list_nodes() -> List[Dict[str, Any]]:
             # re-delivered after rejoins
             row["outbox_depth"] = getattr(pool, "outbox_depth", 0)
             row["outbox_replayed"] = getattr(pool, "outbox_replayed", 0)
+            # two-level scheduling telemetry: tasks currently admitted
+            # by the node's LocalScheduler but not yet completed, and
+            # the lifetime count of local admissions (same numbers the
+            # dashboard nodes panel shows)
+            depth_fn = getattr(pool, "local_queue_depth", None)
+            row["local_queue_depth"] = depth_fn() if depth_fn else 0
+            row["local_dispatched"] = getattr(pool, "local_dispatched", 0)
         rows.append(row)
     return rows
 

@@ -449,6 +449,62 @@ class TestWireProtocol:
         assert any(k.startswith("wire:sent-unhandled:") and "util" in k
                    for k in keys), keys
 
+    def test_peer_actor_lane_drift_caught(self, tmp_path):
+        """Two-level/p2p satellite: the peer actor lane's ("acall",
+        envelope) / ("ares", tid, status, data, timing) frames and the
+        daemon's local-dispatch report tags (local_lease / p2p_done /
+        p2p_fallback) flow through already-declared callees in the real
+        table. This fixture injects the drift that WOULD appear if the
+        two halves diverged: an acall whose executing side expects an
+        envelope field the caller never ships, a result status frame
+        sent with no dispatch branch, and a daemon report tag the head
+        demux never grew a branch for."""
+        _write(tmp_path, "caller.py", """
+            def ship(self, lane, env):
+                self._lane_send(("acall", env), lane)
+                self._lane_send(("acancel", b"tid"), lane)
+            """)
+        _write(tmp_path, "exec_side.py", """
+            def serve(conn):
+                msg = conn.recv()
+                kind = msg[0]
+                if kind == "acall":
+                    # expects a priority field the caller never ships
+                    return msg[2]
+                return None
+            """)
+        _write(tmp_path, "daemon.py", """
+            def report(self, tid, info):
+                self._send_head(("local_lease", tid, info))
+                self._send_head(("p2p_done", tid, info, "extra"))
+            """)
+        _write(tmp_path, "head.py", """
+            def dispatch(msg):
+                kind = msg[0]
+                if kind == "p2p_done":
+                    return msg[2]
+                return None
+            """)
+        channels = [
+            ChannelSpec(name="peer_lane",
+                        sends=[SendSpec("caller.py", "_lane_send")],
+                        recvs=[RecvSpec("exec_side.py", "serve")]),
+            ChannelSpec(name="d2h_two_level",
+                        sends=[SendSpec("daemon.py", "_send_head")],
+                        recvs=[RecvSpec("head.py", "dispatch")]),
+        ]
+        keys = _keys(wire_protocol.analyze(str(tmp_path), _mk,
+                                           channels=channels,
+                                           op_channels=[]))
+        assert any(k.startswith("wire:arity:") and "acall" in k
+                   for k in keys), keys
+        assert any(k.startswith("wire:sent-unhandled:")
+                   and "acancel" in k for k in keys), keys
+        assert any(k.startswith("wire:sent-unhandled:")
+                   and "local_lease" in k for k in keys), keys
+        # the conformant p2p_done tag raises nothing
+        assert not any(k.split(":")[-1] == "p2p_done" for k in keys), keys
+
     def test_real_channels_have_no_drift(self):
         # satellite (f): remote_pool<->node_daemon (and the other three
         # channels) must agree on tags and arities; the daemon/demux

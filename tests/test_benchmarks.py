@@ -93,3 +93,45 @@ def test_ring_on_never_slower_than_ring_off():
         f"ring-on {on:.0f} tasks/s vs ring-off {off:.0f} tasks/s: the "
         f"shm control ring is slower than the pipe path it replaces")
     ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# two-level scheduling: head-bypass must never be slower, and must
+# actually bypass
+# ---------------------------------------------------------------------------
+
+def test_head_bypass_on_never_slower_and_mostly_skips_head():
+    """The two-level tentpole's enforceable bound (bench.py's
+    head_bypass section records the full-size A/B; this is the tier-1
+    guard at smoke size): with actor_p2p + local_dispatch on, the
+    worker->actor call lane must not lose to the head round-trip it
+    replaces, >=90% of steady-state actor calls must skip the head
+    (only the pre-route-resolution call may head-route), and both arms
+    must produce identical results."""
+    import ray_tpu
+    from ray_tpu._private import perf
+
+    n_calls, n_submit = 12, 8
+    # fresh on/off PAIR per retry, same reasoning as the ring guard
+    for attempt in range(3):
+        on = perf.head_bypass_ab(True, n_calls=n_calls,
+                                 n_submit=n_submit)
+        off = perf.head_bypass_ab(False, n_calls=n_calls,
+                                  n_submit=n_submit)
+        if on["actor_seconds"] <= off["actor_seconds"] / 0.85:
+            break
+    # correctness is unconditional — no retry excuses a wrong result
+    assert on["total"] == off["total"] == n_calls
+    assert on["n_submit"] == off["n_submit"] == n_submit
+    # >=90% of steady-state calls skip the head, with no fallbacks
+    assert on["calls_p2p"] >= 0.9 * n_calls - 1, on
+    assert on["head_fallback"] == 0, on
+    # the off arm never bypasses (knobs-off is the pre-PR path)
+    assert off["calls_p2p"] == 0 and off["local_dispatch"] == 0, off
+    # the sustained-submit lane actually dispatched locally
+    assert on["local_dispatch"] >= n_submit, on
+    assert on["actor_seconds"] <= off["actor_seconds"] / 0.85, (
+        f"p2p-on {on['actor_seconds']}s vs head-routed "
+        f"{off['actor_seconds']}s: the peer actor lane is slower than "
+        f"the head round-trip it replaces")
+    ray_tpu.shutdown()
