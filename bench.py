@@ -175,7 +175,7 @@ print("HB_JSON:" + json.dumps(r))
 """
 
 
-def _head_bypass_subprocess(p2p: bool, n_calls: int,
+def _head_bypass_subprocess(p2p, n_calls: int,
                             n_submit: int) -> dict:
     """One head-bypass A/B arm in a fresh interpreter (the cluster
     spawns node daemons; a clean process keeps the arms independent)."""
@@ -769,14 +769,21 @@ def main() -> int:
     # pre-PR everything-through-the-head path. Claims under test: ON is
     # never slower, >=90% of steady-state actor calls skip the head,
     # and both arms produce equal results.
-    if section("head_bypass", 45):
+    if section("head_bypass", 65):
         hb = {}
         n_calls, n_submit = (12, 8) if smoke else (40, 24)
         try:
             on = _head_bypass_subprocess(True, n_calls, n_submit)
             off = _head_bypass_subprocess(False, n_calls, n_submit)
+            # the default-config arm: NO knob overrides (the flipped
+            # defaults) and a submit mix including retry-carrying and
+            # resident-ref-carrying tasks — the acceptance bar is
+            # head_skip >= 0.9 on exactly this arm
+            dflt = _head_bypass_subprocess(None, n_calls, n_submit)
             hb["on"] = on
             hb["off"] = off
+            hb["default"] = dflt
+            hb["default_head_skip"] = dflt.get("head_skip")
             hb["equal_results"] = (on["total"] == off["total"]
                                    and on["n_submit"] == off["n_submit"])
             hb["p2p_fraction"] = round(
@@ -794,7 +801,10 @@ def main() -> int:
                   f"{on['submit_seconds']}s vs {off['submit_seconds']}s "
                   f"({hb['slowed_head_submit_speedup']}x, "
                   f"{on['local_dispatch']} local / {on['spillback']} "
-                  f"spilled)", file=sys.stderr)
+                  f"spilled); default-config arm head_skip "
+                  f"{dflt['head_skip']} ({dflt['local_dispatch']} "
+                  f"local / {dflt['spillback']} spilled, mixed "
+                  "retry+ref lane)", file=sys.stderr)
         except Exception:
             traceback.print_exc()
         OUT["head_bypass"] = hb or None

@@ -118,6 +118,13 @@ DEFAULT_CHANNELS: List[ChannelSpec] = [
                      "_WorkerRunner._ctrl_loop"),
             RecvSpec("_private/runtime/worker_process.py",
                      "_WorkerRunner._run_nested"),
+            # the node daemon decodes a bookkeeping copy of every lease
+            # frame it relays head->worker — including the remote lease
+            # envelope ("env", blob), which extends the PR-11 batched
+            # path to remote pools — so its dispatcher is a second recv
+            # of this channel and drifts are caught on both decoders
+            RecvSpec("_private/runtime/node_daemon.py",
+                     "NodeDaemon._register_lease_msg"),
         ],
         # "reply" is also DISPATCHED by the worker's rpc() wait loop —
         # arity there is checked like any branch; node_daemon relays
@@ -151,9 +158,12 @@ DEFAULT_CHANNELS: List[ChannelSpec] = [
     ChannelSpec(
         name="peer_actor_lane",
         # daemon<->daemon actor-call lane riding the peer object plane:
-        # _lane_send is the single framed-send point for both the
-        # caller side (("acall", envelope)) and the executing side
-        # (("ares", tid, status, data, timing))
+        # _lane_send is the single framed-send point for the caller
+        # side (("acall", envelope)), the executing side (("ares", tid,
+        # status, data, timing)), and the resource-view gossip frames
+        # (("rview", view) — tentpole d: daemons re-share the head's
+        # freshest view so local admission survives a slow/rejoining
+        # head; _peer_serve adopts on epoch match + strictly newer v)
         sends=[SendSpec("_private/runtime/node_daemon.py",
                         "_lane_send")],
         recvs=[RecvSpec("_private/runtime/node_daemon.py",

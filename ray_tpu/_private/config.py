@@ -233,20 +233,25 @@ _d("locality_spillback_queue_depth", int, 4,
    "fewer than this many leases outstanding; beyond it the task "
    "spills to the normal least-loaded choice so a hot node never "
    "serializes the cluster")
-_d("local_dispatch", bool, False,
+_d("local_dispatch", bool, True,
    "bottom-up two-level scheduling (reference: Ray OSDI '18): a remote "
    "node's daemon admits worker-submitted tasks from a bounded local "
    "queue against a head-refreshed resource view, leases them to its "
-   "own workers without a head round-trip, and reports lease + "
-   "completion through the sequenced outbox (exactly-once across head "
-   "restarts). Tasks that do not fit — ref args, custom resources, "
-   "placement groups, full queue — spill upward to the head scheduler, "
-   "which stays the single placement authority. Off = every submission "
-   "goes through the head, byte-for-byte pre-two-level behavior")
+   "own workers without a head round-trip (retries included: the "
+   "daemon re-leases a failed attempt locally up to task_max_retries "
+   "with per-attempt accounting), and reports lease + completion "
+   "through the sequenced outbox (exactly-once across head restarts). "
+   "Ref-carrying args admit when the bytes are resident on the node; "
+   "tasks that still do not fit — non-resident refs, custom "
+   "resources, placement groups, full queue — spill upward to the "
+   "head scheduler, which stays the single placement authority "
+   "(per-reason counters: ray_tpu_sched_spillback_total{reason=...}). "
+   "Off = every submission goes through the head, byte-for-byte "
+   "pre-two-level behavior")
 _d("local_queue_depth", int, 16,
    "bound on locally-admitted leases in flight per node daemon; at the "
    "bound new submissions spill upward to the head scheduler")
-_d("actor_p2p", bool, False,
+_d("actor_p2p", bool, True,
    "peer-to-peer actor calls: once the head publishes an actor's "
    "(node, worker) route, worker-originated calls ship the call "
    "envelope caller-daemon -> peer-daemon over the peer link and only "
@@ -255,6 +260,14 @@ _d("actor_p2p", bool, False,
    "head path with the same attempt token (retries stay exactly-"
    "once). Off = every actor call routes through the head, byte-for-"
    "byte pre-p2p behavior")
+_d("resview_gossip_s", float, 1.0,
+   "period of daemon-to-daemon resource-view gossip over the peer "
+   "lanes: each daemon re-shares the freshest (highest-version) view "
+   "it holds so local admission stays current when the head is slow "
+   "or rejoining; the head's direct push remains the authoritative "
+   "tiebreaker (equal versions never overwrite a head-pushed view). "
+   "0 disables gossip; gossip also requires local_dispatch or "
+   "actor_p2p to be on")
 
 # -- fault tolerance -------------------------------------------------------
 _d("task_max_retries", int, 3, "default retries for tasks on worker failure")

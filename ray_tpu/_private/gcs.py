@@ -383,6 +383,13 @@ class GcsService:
             self._leases[task_id_bin] = record
             self._log(("lease", task_id_bin, record))
 
+    def journal_get(self, task_id_bin: bytes) -> Optional[Dict[str, Any]]:
+        """Read an in-flight lease record (the local-retry attempt
+        bump re-journals the record through journal_lease so failover
+        replay sees the live attempt token)."""
+        with self._lock:
+            return self._leases.get(task_id_bin)
+
     def journal_lease_done(self, task_id_bin: bytes) -> None:
         """Terminal completion of a remote lease (done OR failed):
         removes it from the reconciliation set."""
@@ -558,6 +565,15 @@ class GcsService:
         with self._lock:
             return [oid for oid, locs in self._object_locations.items()
                     if locs and locs[0] == index]
+
+    def objects_resident(self, index: int) -> List[ObjectID]:
+        """Objects with ANY copy on the node (primary or secondary) —
+        feeds the residency digest in the resource-view push, so the
+        LocalScheduler can admit ref-carrying tasks whose arg bytes
+        are provably on-node."""
+        with self._lock:
+            return [oid for oid, locs in self._object_locations.items()
+                    if index in locs]
 
     def drop_node_locations(self, index: int):
         """Node-death invalidation: remove ``index`` from every location

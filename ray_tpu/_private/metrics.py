@@ -167,6 +167,13 @@ class Histogram(_Metric):
 
 # -- the endpoint -------------------------------------------------------
 
+# fixed spill-reason label set: one per LocalScheduler admission check
+# (see node_daemon._maybe_local_submit) plus "other" for daemons
+# predating per-reason reporting
+SPILL_REASONS = ("queue_full", "pg", "resources", "refs", "no_slot",
+                 "other")
+
+
 def _render_core(worker) -> List[str]:
     """Core runtime metrics (reference: metric_defs.cc's task/object/
     scheduler families)."""
@@ -241,9 +248,20 @@ def _render_core(worker) -> List[str]:
     emit("ray_tpu_sched_local_dispatch_total", "counter",
          "worker-submitted tasks admitted by a node's LocalScheduler "
          "without a head round-trip", tl.get("local_dispatch", 0))
-    emit("ray_tpu_sched_spillback_total", "counter",
-         "local submissions a node declined (queue full / unfit) that "
-         "spilled up to the head scheduler", tl.get("spillback", 0))
+    # spillback: bare total plus one labeled series per fixed reason
+    # ("why does my task still spill?" — the README Scheduling section
+    # maps each reason to its admission check). Reasons count on lazy
+    # "spillback:<reason>" keys so the base stats schema is unchanged
+    # while everything admits locally.
+    lines.append("# HELP ray_tpu_sched_spillback_total local "
+                 "submissions a node declined that spilled up to the "
+                 "head scheduler, by admission-check reason")
+    lines.append("# TYPE ray_tpu_sched_spillback_total counter")
+    lines.append(f"ray_tpu_sched_spillback_total {tl.get('spillback', 0)}")
+    for reason in SPILL_REASONS:
+        lines.append(
+            f'ray_tpu_sched_spillback_total{{reason="{reason}"}} '
+            f"{tl.get('spillback:' + reason, 0)}")
     emit("ray_tpu_actor_calls_p2p_total", "counter",
          "actor calls executed worker-to-peer over the daemon lane "
          "(head saw only the completion receipt)", tl.get("p2p", 0))

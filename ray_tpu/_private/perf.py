@@ -201,7 +201,8 @@ def locality_ab(locality: bool, n_consumers: int = 8,
         c.shutdown()
 
 
-def head_bypass_ab(p2p: bool, n_calls: int = 40, n_submit: int = 24,
+def head_bypass_ab(p2p: Optional[bool], n_calls: int = 40,
+                   n_submit: int = 24,
                    head_tick_delay_s: float = 0.02) -> Dict[str, Any]:
     """One arm of the two-level/head-bypass A/B: a 2-remote-node
     cluster, an actor resident on node B, a caller task on node A
@@ -210,29 +211,35 @@ def head_bypass_ab(p2p: bool, n_calls: int = 40, n_submit: int = 24,
     With ``p2p=True`` (``actor_p2p`` + ``local_dispatch`` on) the calls
     ship worker -> caller daemon -> peer daemon once the route
     resolves; only sequenced completion receipts reach the head. With
-    ``p2p=False`` every call round-trips the head (the pre-PR path).
+    ``p2p=False`` every call round-trips the head (the escape hatch,
+    byte-for-byte the pre-two-level wire). With ``p2p=None`` the arm
+    runs the DEFAULT config — no knob overrides at all — and widens
+    the submit lane to the shapes that used to spill before the
+    defaults flipped: retry-carrying tasks and ref-carrying args
+    resident on the submitting node.
 
     The sustained-submit lane then arms a chaos ``sched_tick slow``
     plan (every head scheduler tick delayed by ``head_tick_delay_s``)
-    and has a node-A task submit+get ``n_submit`` nested no-ops: with
+    and has a node-A task submit+get ``n_submit`` nested tasks: with
     local dispatch on, the node's LocalScheduler admits them without
     waiting out the slowed head tick.
 
-    Returns {p2p, n_calls, total, actor_seconds, calls_p2p,
-    head_fallback, submit_seconds, local_dispatch, spillback}.
-    ``total`` must match between arms (equal call results)."""
+    Returns {mode, p2p, n_calls, total, actor_seconds, calls_p2p,
+    head_fallback, submit_seconds, local_dispatch, spillback,
+    head_skip}. ``total`` must match between arms (equal results)."""
     import ray_tpu
     from ray_tpu import chaos
     from ray_tpu._private import worker as worker_mod
     from ray_tpu.cluster_utils import Cluster
 
+    overrides = ({} if p2p is None else
+                 {"local_dispatch": bool(p2p), "actor_p2p": bool(p2p)})
+    two_level_on = p2p is None or bool(p2p)
     ray_tpu.shutdown()
     c = Cluster(initialize_head=True,
                 head_node_args=dict(
                     num_cpus=2, num_workers=2, scheduler="tensor",
-                    _system_config={
-                        "local_dispatch": bool(p2p),
-                        "actor_p2p": bool(p2p)}))
+                    _system_config=overrides))
     try:
         c.add_node(num_cpus=2, remote=True, resources={"a": 100.0})
         c.add_node(num_cpus=2, remote=True, resources={"b": 100.0})
@@ -266,41 +273,67 @@ def head_bypass_ab(p2p: bool, n_calls: int = 40, n_submit: int = 24,
         # sequenced p2p_done receipts ride the outbox; give the last
         # few a beat to land before reading the counters
         deadline = time.monotonic() + 10.0
-        while (p2p and time.monotonic() < deadline
+        while (two_level_on and time.monotonic() < deadline
                and (w.two_level_stats["p2p"]
                     + w.two_level_stats["head_fallback"]) < n_calls - 1):
             time.sleep(0.05)
         stats = dict(w.two_level_stats)
 
-        # the admissible shape: default resources and no retries.
-        # Custom-resource demands (head knows the cluster-wide supply)
-        # and retry-carrying tasks (retries are owner-driven) are
-        # exactly what the LocalScheduler spills upward, so the lane
-        # measures the locally-dispatchable path
+        # the on/off A/B keeps the historical admissible shape (default
+        # resources, no retries) so arms stay comparable release to
+        # release; the default-config arm mixes in the shapes the
+        # LocalScheduler used to spill and now admits — retry-carrying
+        # tasks and ref-carrying args resident on the node
         @ray_tpu.remote(max_retries=0)
         def _nested_noop():
             return 1
 
+        @ray_tpu.remote  # default task_max_retries: retry-carrying
+        def _nested_retry():
+            return 1
+
+        @ray_tpu.remote(max_retries=0)
+        def _nested_ref(blob):
+            return 1 if blob else 0
+
         @ray_tpu.remote(resources={"a": 1.0})
-        def submitter(n):
+        def submitter(n, mixed):
             import ray_tpu
-            return sum(ray_tpu.get(
-                [_nested_noop.remote() for _ in range(n)],
-                timeout=120.0))
+            if not mixed:
+                return sum(ray_tpu.get(
+                    [_nested_noop.remote() for _ in range(n)],
+                    timeout=120.0))
+            # over inline_object_max_bytes -> sealed in node A's arena,
+            # the shape the residency check admits locally
+            data = ray_tpu.put(b"x" * (256 * 1024))
+            refs = []
+            for i in range(n):
+                kind = i % 3
+                if kind == 0:
+                    refs.append(_nested_noop.remote())
+                elif kind == 1:
+                    refs.append(_nested_retry.remote())
+                else:
+                    refs.append(_nested_ref.remote(data))
+            return sum(ray_tpu.get(refs, timeout=120.0))
 
         chaos.arm(chaos.FaultPlan(7))
         chaos.set_probability("sched_tick", 1.0,
                               delay_s=head_tick_delay_s)
         try:
             t0 = time.perf_counter()
-            n_done = ray_tpu.get(submitter.remote(n_submit),
-                                 timeout=300.0)
+            n_done = ray_tpu.get(
+                submitter.remote(n_submit, p2p is None), timeout=300.0)
             submit_dt = time.perf_counter() - t0
         finally:
             chaos.disarm()
         stats_after = dict(w.two_level_stats)
+        ld = int(stats_after["local_dispatch"])
+        sb = int(stats_after["spillback"])
         return {
-            "p2p": bool(p2p),
+            "mode": "default" if p2p is None else
+                    ("on" if p2p else "off"),
+            "p2p": two_level_on,
             "n_calls": n_calls,
             "total": int(total),
             "actor_seconds": round(actor_dt, 3),
@@ -308,8 +341,9 @@ def head_bypass_ab(p2p: bool, n_calls: int = 40, n_submit: int = 24,
             "head_fallback": int(stats["head_fallback"]),
             "n_submit": int(n_done),
             "submit_seconds": round(submit_dt, 3),
-            "local_dispatch": int(stats_after["local_dispatch"]),
-            "spillback": int(stats_after["spillback"]),
+            "local_dispatch": ld,
+            "spillback": sb,
+            "head_skip": (round(ld / (ld + sb), 3) if ld + sb else None),
         }
     finally:
         c.shutdown()

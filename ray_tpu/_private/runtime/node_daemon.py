@@ -48,10 +48,17 @@ Head -> daemon messages:
   ("log_read", rid, filename, tail)
                               -> ("log_data", rid, ok, text_or_error)
   ("resview", view)           two-level dispatch push: {accept, p2p,
-                              cap, job, chaos} — refreshed view gating
-                              the daemon's LOCAL submission queue and
-                              advertising the p2p actor lane (plus a
-                              mirror of the head's armed chaos plan)
+                              cap, job, chaos, v, peers, resident} —
+                              refreshed view gating the daemon's LOCAL
+                              submission queue and advertising the p2p
+                              actor lane (plus a mirror of the head's
+                              armed chaos plan). `v` is a monotonic
+                              version for peer gossip tiebreaks,
+                              `peers` the other nodes' peer addresses,
+                              `resident` a digest (8-byte oid
+                              prefixes) of this node's object-
+                              directory residency so ref-carrying
+                              submissions can admit locally
   ("aroute", aid_bin, route)  actor-route reply for an ("aresolve",
                               aid_bin) request: (node_index, address,
                               worker_num) or None
@@ -78,7 +85,17 @@ Daemon -> head messages:
                               resource view and leased it to a sibling
                               worker; info carries everything the head
                               needs to journal the lease (fn/args
-                              blobs, return ids, attempt)
+                              blobs, return ids, attempt, max_retries)
+  ("local_retry", tid, info)  a locally-dispatched lease's worker died
+                              and the daemon re-leased the SAME task
+                              (same return oids, attempt+1) to a
+                              sibling worker without a head
+                              round-trip; the head moves its adopted
+                              in-flight entry to the new worker and
+                              re-journals the bumped attempt token
+                              (FIFO-ordered before the worker_died
+                              report, which then skips the moved
+                              lease)
   ("p2p_done", tid, info)     completion receipt for a peer-dispatched
                               actor call EXECUTED on this node: result
                               entries + timing for lineage/ref-counts
@@ -185,12 +202,13 @@ class _Outbox:
 # head-side sequence dedup, nothing new
 _OUTBOX_TAGS = frozenset((
     "w", "worker_died", "pulled", "log", "util",
-    "local_lease", "p2p_done", "p2p_fallback", "fault"))
+    "local_lease", "local_retry", "p2p_done", "p2p_fallback", "fault"))
 
 
 class _WorkerSlot:
     __slots__ = ("num", "proc", "conn", "ctrl", "pid", "returns",
-                 "attempts", "gets", "actor_bin", "send_lock", "err_path")
+                 "attempts", "gets", "actor_bin", "send_lock", "err_path",
+                 "hdr_cache", "reader_done")
 
     def __init__(self, num: int):
         self.num = num
@@ -220,6 +238,15 @@ class _WorkerSlot:
         # path of this worker's .err capture file (log plane), so a
         # crash tail can ride the worker_died report to the head
         self.err_path: Optional[str] = None
+        # lease-envelope header cache: the daemon decodes ("env", ...)
+        # payloads for its own returns/attempts bookkeeping while
+        # forwarding the blob verbatim — both caches evolve in
+        # lockstep because both sides decode the same ordered stream
+        self.hdr_cache: Dict[int, tuple] = {}
+        # set when the worker-reader thread hits EOF with its buffered
+        # messages drained; _monitor waits on it so a completion the
+        # worker emitted just before dying is never retried
+        self.reader_done = threading.Event()
 
 
 PEER_CHUNK = 1 << 20  # ~1 MB frames (reference: ObjectBufferPool)
@@ -560,9 +587,17 @@ class NodeDaemon:
         # daemon is a pure forwarder, byte-for-byte pre-two-level.
         self._resview: Dict[str, Any] = {}
         self._resview_lock = threading.Lock()
+        self._resview_v = 0                # adopted view version
+        self._resident_digest: frozenset = frozenset()
         self._chaos_snapshot: Optional[dict] = None
         self._local_tids: set = set()      # locally-admitted, in flight
         self._local_dispatched = 0
+        # locally-admitted lease bodies retained for LOCAL retries:
+        # tid -> {payload, info, attempt, max_retries, arg_refs}. A
+        # worker death re-leases an unfinished entry to a sibling
+        # worker (attempt+1) up to max_retries; the entry dies with
+        # the task's done/err
+        self._local_leases: Dict[bytes, dict] = {}
         # p2p actor plane: head-resolved routes, per-actor task-id
         # minting salts, A-side in-flight calls, per-peer actor lanes,
         # and B-side pending executions awaiting their result send
@@ -674,14 +709,63 @@ class NodeDaemon:
 
     def _monitor(self, slot: _WorkerSlot) -> None:
         slot.proc.wait()
+        if slot.conn is not None:
+            # completions the worker emitted just before dying may
+            # still sit buffered on its pipe: wait for the reader to
+            # drain to EOF so a finished task is never retried
+            slot.reader_done.wait(1.0)
         with self._lock:
             gone = self._slots.pop(slot.num, None)
         if gone is not None and not self._shutdown:
             from ray_tpu._private import log_plane
 
+            # local retries FIRST: the outbox FIFO lands each
+            # ("local_retry", ...) before the worker_died report, so
+            # the head re-homes those adopted leases instead of
+            # failing them with the rest of the dead worker's inflight
+            self._retry_local_leases(slot)
             tail = log_plane.err_tail_message(slot.err_path)
             self._send_head(("worker_died", slot.num,
                              slot.proc.returncode, tail))
+
+    def _retry_local_leases(self, slot: _WorkerSlot) -> None:
+        """Per-attempt accounting for locally-dispatched leases
+        (tentpole: retry-carrying tasks dispatch locally): every
+        unfinished local lease on a dead worker re-leases to a sibling
+        worker with attempt+1, as long as admission still holds
+        (attempts left, arg bytes still resident, a live slot exists).
+        Anything else falls through to the head's worker_died handling
+        — the head owns terminal failure and lineage reconstruction."""
+        with self._resview_lock:
+            accept = bool(self._resview.get("accept"))
+        for tid_bin in list(slot.returns):
+            lease = self._local_leases.get(tid_bin)
+            if lease is None:
+                continue  # head-placed: the head's retry policy runs
+            slot.returns.pop(tid_bin, None)
+            slot.attempts.pop(tid_bin, None)
+            attempt = int(lease.get("attempt", 0)) + 1
+            target = None
+            if (accept and attempt <= int(lease.get("max_retries", 0))
+                    and self._refs_resident(lease.get("arg_refs"))):
+                target = self._pick_local_slot(slot)
+            if target is None:
+                # exhausted / args gone / no slot: release the lease;
+                # the worker_died report reaches the head with this
+                # tid still adopted and the head fails or rebuilds it
+                self._local_leases.pop(tid_bin, None)
+                with self._resview_lock:
+                    self._local_tids.discard(tid_bin)
+                continue
+            lease["attempt"] = attempt
+            payload = dict(lease["payload"], attempt=attempt)
+            info = dict(lease["info"], worker_num=target.num,
+                        attempt=attempt, t=time.time())
+            lease["info"] = info
+            target.returns[tid_bin] = list(payload["return_ids"])
+            target.attempts[tid_bin] = attempt
+            self._send_head(("local_retry", tid_bin, info))
+            self._to_worker(target, ("task", payload))
 
     def _accept_loop(self) -> None:
         from multiprocessing import AuthenticationError
@@ -729,14 +813,17 @@ class NodeDaemon:
     # worker -> head forwarding, with node-local interception
     # ------------------------------------------------------------------
     def _worker_reader(self, slot: _WorkerSlot) -> None:
-        while True:
-            try:
-                msg = slot.conn.recv()
-            except (EOFError, OSError):
-                return  # _monitor reports the death
-            out = self._intercept(slot, msg)
-            if out is not None:
-                self._send_head(("w", slot.num, out))
+        try:
+            while True:
+                try:
+                    msg = slot.conn.recv()
+                except (EOFError, OSError):
+                    return  # _monitor reports the death
+                out = self._intercept(slot, msg)
+                if out is not None:
+                    self._send_head(("w", slot.num, out))
+        finally:
+            slot.reader_done.set()  # buffered completions all drained
 
     def _intercept(self, slot: _WorkerSlot, msg: tuple) -> Optional[tuple]:
         """Serve node-local object-plane ops; rewrite sealed returns.
@@ -817,6 +904,7 @@ class NodeDaemon:
                     out.append(entry)
             with self._resview_lock:
                 self._local_tids.discard(task_id_bin)
+            self._local_leases.pop(task_id_bin, None)
             # preserve any trailing fields (e.g. the execution-window
             # timing tuple the task event plane rides on)
             return (msg[0], task_id_bin, out) + tuple(msg[3:])
@@ -830,6 +918,7 @@ class NodeDaemon:
             slot.attempts.pop(msg[1], None)
             with self._resview_lock:
                 self._local_tids.discard(msg[1])
+            self._local_leases.pop(msg[1], None)
         return msg
 
     def _serve_fetch(self, fid: int, oid_bin: bytes) -> None:
@@ -1001,6 +1090,11 @@ class NodeDaemon:
                     # payloads dispatched straight to the resident
                     # actor worker; results return on THIS connection
                     self._serve_acall(conn, send_lock, hdr_cache, msg[1])
+                elif msg[0] == "rview":
+                    # peer-gossiped resource view: adopt if strictly
+                    # fresher (same head epoch) so local admission
+                    # stays current through a slow/rejoining head
+                    self._apply_resview(msg[1], from_peer=True)
                 else:
                     return
         finally:
@@ -1188,16 +1282,36 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     # two-level dispatch: node-local submission queue (tentpole a)
     # ------------------------------------------------------------------
-    def _apply_resview(self, view: dict) -> None:
-        """Head-pushed resource view: gates local admission
-        (accept/cap), advertises the p2p actor lane to this node's
-        workers, and mirrors the head's armed chaos plan so
+    def _apply_resview(self, view: dict, from_peer: bool = False) -> None:
+        """Head-pushed (or peer-gossiped) resource view: gates local
+        admission (accept/cap), records the residency digest for
+        ref-arg admission, advertises the p2p actor lane to this
+        node's workers, and mirrors the head's armed chaos plan so
         daemon-hosted sites (peer_link) fire at their seeded arrivals
-        on the process that actually owns them."""
+        on the process that actually owns them.
+
+        Gossiped views adopt only on a STRICTLY newer version — the
+        head's direct push stays the authoritative tiebreaker — and
+        keep this node's own node-scoped fields (node index, residency
+        digest): a peer's digest describes the peer's arena."""
         with self._resview_lock:
+            if from_peer:
+                # same head instance (epoch) and strictly newer only:
+                # a restarted head's fresh v=1 push must never lose to
+                # a peer still gossiping the dead head's high-v view
+                if (view.get("e") != self._resview.get("e")
+                        or int(view.get("v") or 0) <= self._resview_v):
+                    return
+                view = dict(view,
+                            node=self._resview.get("node"),
+                            resident=self._resview.get("resident"))
             prev = (bool(self._resview.get("accept")),
                     bool(self._resview.get("p2p")))
             self._resview = dict(view)
+            self._resview_v = int(view.get("v") or 0)
+            digest = view.get("resident")
+            self._resident_digest = (frozenset(digest) if digest
+                                     else frozenset())
             snap = view.get("chaos")
             chaos_changed = snap != self._chaos_snapshot
             if chaos_changed:
@@ -1230,6 +1344,23 @@ class NodeDaemon:
                                   len(s.returns)))
         return cands[0]
 
+    def _refs_resident(self, refs) -> bool:
+        """Every arg ObjectRef's bytes provably on this node: sealed
+        in the local arena, or listed in the head-pushed object-
+        directory residency digest (8-byte oid prefixes; a prefix
+        false-positive just costs one head-served get at exec time)."""
+        if not refs:
+            return True
+        with self._resview_lock:
+            digest = self._resident_digest
+        for b in refs:
+            if self.store.contains(ObjectID(b)):
+                continue
+            if digest and bytes(b)[:8] in digest:
+                continue
+            return False
+        return True
+
     def _maybe_local_submit(self, slot: _WorkerSlot, req_id: int,
                             args: tuple) -> Optional[tuple]:
         """LocalScheduler admission: a worker-originated nested
@@ -1237,11 +1368,14 @@ class NodeDaemon:
         minted locally, the lease journaled at the head through the
         report-class outbox (so head-restart reconciliation and
         exactly-once dedup come for free), the payload dispatched to a
-        sibling worker without any head round-trip. Everything else
-        spills upward, flagged so the head can count the spillback:
-        the head scheduler stays the single placement authority for
-        cross-node balancing, placement groups, ref-carrying args and
-        retry-carrying tasks."""
+        sibling worker without any head round-trip. Retry-carrying
+        tasks admit (the daemon re-leases failed attempts locally, see
+        _retry_local_leases) and ref-carrying args admit when the
+        bytes are provably on-node. Everything else spills upward,
+        flagged with the REASON so the head counts per-reason
+        spillback: the head scheduler stays the single placement
+        authority for cross-node balancing, placement groups and
+        non-resident deps."""
         import cloudpickle
 
         fwd = ("rpc", req_id, "submit", args)
@@ -1253,22 +1387,32 @@ class NodeDaemon:
             depth = len(self._local_tids)
         if not accept or job_bin is None:
             return fwd
-        spill = ("rpc", req_id, "submit", (args[0], True))
+
+        def spill(reason: str) -> tuple:
+            return ("rpc", req_id, "submit", (args[0], reason))
+
         if depth >= cap:
-            return spill  # bounded local queue: overflow goes upward
+            # bounded local queue: overflow goes upward
+            return spill("queue_full")
         try:
             d = cloudpickle.loads(args[0])
         except Exception:
             return fwd
         res = d.get("resources") or {}
-        if (d.get("has_refs") is not False      # refs resolve owner-side
-                or d.get("pg_id") is not None   # placement is the head's
-                or d.get("max_retries")         # retries are owner-driven
-                or (res and res != {"CPU": 1} and res != {"CPU": 1.0})):
-            return spill
+        if d.get("pg_id") is not None:      # placement is the head's
+            return spill("pg")
+        if res and res != {"CPU": 1} and res != {"CPU": 1.0}:
+            return spill("resources")
+        arg_refs = list(d.get("arg_refs") or ())
+        if d.get("has_refs") is not False:
+            # ref-carrying args admit only when every dep's bytes are
+            # provably resident (a pre-digest submitter advertises
+            # has_refs without the ref list: spill, owner resolves)
+            if not arg_refs or not self._refs_resident(arg_refs):
+                return spill("refs")
         target = self._pick_local_slot(slot)
         if target is None:
-            return spill
+            return spill("no_slot")
         from ray_tpu._private.runtime.worker_process import fn_id_of
 
         tid = TaskID.of(JobID(job_bin))
@@ -1276,6 +1420,7 @@ class NodeDaemon:
         rids = [ObjectID.for_task_return(tid, i).binary()
                 for i in range(d["num_returns"])]
         fn_blob = d["func_blob"]
+        max_retries = int(d.get("max_retries") or 0)
         payload = {
             "task_id": tid_bin, "name": d.get("name"),
             "fn_id": fn_id_of(fn_blob), "fn_blob": fn_blob,
@@ -1293,11 +1438,19 @@ class NodeDaemon:
             "num_returns": d["num_returns"], "returns": rids,
             "resources": dict(res), "worker_num": target.num,
             "submitter": slot.num, "trace": payload.get("trace"),
-            "t": time.time(),
+            "attempt": 0, "max_retries": max_retries,
+            "arg_refs": arg_refs, "t": time.time(),
         }
         with self._resview_lock:
             self._local_tids.add(tid_bin)
             self._local_dispatched += 1
+        if max_retries > 0:
+            # retain the lease body so a worker death can re-lease the
+            # attempt locally instead of consulting the head
+            self._local_leases[tid_bin] = {
+                "payload": payload, "info": info, "attempt": 0,
+                "max_retries": max_retries, "arg_refs": arg_refs,
+            }
         target.returns[tid_bin] = list(rids)
         target.attempts[tid_bin] = 0
         # lease report FIRST: outbox FIFO means the head always sees
@@ -1620,6 +1773,46 @@ class NodeDaemon:
             "reason": reason,
         }))
 
+    def _gossip_loop(self) -> None:
+        """Tentpole (d): re-share the freshest resource view this
+        daemon holds with its peers over the existing actor lanes, so
+        every node's local admission stays current when the head is
+        slow, blacked out, or mid-rejoin. Versioned adoption (epoch +
+        strictly-newer v, see _apply_resview) keeps the head the
+        authoritative tiebreaker."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        while not self._shutdown:
+            period = float(GLOBAL_CONFIG.resview_gossip_s)
+            time.sleep(period if period > 0 else 1.0)
+            if period <= 0:
+                continue
+            with self._resview_lock:
+                view = dict(self._resview)
+            if not (view.get("accept") or view.get("p2p")):
+                continue  # knobs off: the peer wire stays silent
+            for addr in view.get("peers") or ():
+                # the gossip frames ride the same peer lanes as p2p
+                # calls, so the peer_link chaos site covers them too:
+                # a severed/dropped lane must cost only freshness (the
+                # next tick redials), never correctness
+                fault = self._poll_peer_link(frame="rview")
+                if fault is not None:
+                    k = fault.get("kind")
+                    if k == "sever":
+                        self._sever_lane(tuple(addr),
+                                         "chaos: severed gossip lane")
+                        continue
+                    if k == "drop":
+                        continue
+                    time.sleep(fault.get("delay_s", 0.05))
+                lane = self._actor_lane(addr)
+                if lane is None:
+                    continue
+                if not self._lane_send(("rview", view), lane["conn"],
+                                       lane["lock"]):
+                    self._drop_lane(lane, "peer lane send failed")
+
     def _p2p_sweep_loop(self) -> None:
         """Safety net under the lane-EOF sweep: a call whose result
         frame never arrives (peer wedged, frame lost to a half-dead
@@ -1712,6 +1905,40 @@ class NodeDaemon:
                              (msg[2], msg[3]), timing),
                             conn, send_lock)
 
+    def _register_lease_msg(self, slot: _WorkerSlot, msg: tuple) -> None:
+        """Bookkeeping copy of a head->worker lease in transit: record
+        return ids + attempt tokens per worker so a rejoin hello can
+        report exactly what is still running here. Registered as an
+        extra recv of the raylint owner_to_worker channel — the daemon
+        decodes the SAME frames the worker does, including the remote
+        lease envelope (tentpole c), so schema drift on the relayed
+        channel is caught here too."""
+        if msg[0] in ("task", "actor_create", "actor_call"):
+            p = msg[1]
+            rids = p.get("return_ids")
+            if rids:
+                slot.returns[p["task_id"]] = list(rids)
+                slot.attempts[p["task_id"]] = p.get("attempt", 0)
+            if msg[0] == "actor_create":
+                slot.actor_bin = p.get("actor_bin")
+        elif msg[0] == "tasks":
+            for p in msg[1]:
+                rids = p.get("return_ids")
+                if rids:
+                    slot.returns[p["task_id"]] = list(rids)
+                    slot.attempts[p["task_id"]] = p.get("attempt", 0)
+        elif msg[0] == "env":
+            # remote lease envelope: decode a copy for the per-worker
+            # bookkeeping, forward the blob verbatim — the worker's own
+            # header cache evolves in lockstep off the same stream
+            from ray_tpu._private.task_spec import decode_task_envelope
+
+            for p in decode_task_envelope(msg[1], slot.hdr_cache):
+                rids = p.get("return_ids")
+                if rids:
+                    slot.returns[p["task_id"]] = list(rids)
+                    slot.attempts[p["task_id"]] = p.get("attempt", 0)
+
     # ------------------------------------------------------------------
     # head -> daemon main loop
     # ------------------------------------------------------------------
@@ -1722,6 +1949,8 @@ class NodeDaemon:
                          name="ray_tpu_node_log_tail").start()
         threading.Thread(target=self._p2p_sweep_loop, daemon=True,
                          name="ray_tpu_node_p2p_sweep").start()
+        threading.Thread(target=self._gossip_loop, daemon=True,
+                         name="ray_tpu_node_resview_gossip").start()
         self._start_util_sampler()
         while not self._shutdown:
             try:
@@ -1756,24 +1985,9 @@ class NodeDaemon:
                 with self._lock:
                     slot = self._slots.get(num)
                 if slot is not None and slot.conn is not None:
-                    if payload[0] in ("task", "actor_create", "actor_call"):
-                        p = payload[1]
-                        rids = p.get("return_ids")
-                        if rids:
-                            slot.returns[p["task_id"]] = list(rids)
-                            slot.attempts[p["task_id"]] = p.get(
-                                "attempt", 0)
-                        if payload[0] == "actor_create":
-                            slot.actor_bin = p.get("actor_bin")
-                    elif payload[0] == "tasks":
-                        for p in payload[1]:
-                            rids = p.get("return_ids")
-                            if rids:
-                                slot.returns[p["task_id"]] = list(rids)
-                                slot.attempts[p["task_id"]] = p.get(
-                                    "attempt", 0)
-                    elif (payload[0] == "reply"
-                          and payload[1] in slot.gets):
+                    self._register_lease_msg(slot, payload)
+                    if (payload[0] == "reply"
+                            and payload[1] in slot.gets):
                         purpose = slot.gets.pop(payload[1])
                         if payload[2]:
                             prio = (PullManager.PRIO_ARG

@@ -197,9 +197,10 @@ class ProcessWorkerPool:
         # probability set after pool construction was never observed)
         from ray_tpu._private.chaos import get_controller
         self._chaos = get_controller()
-        # shared-memory control ring (local pools only: remote pools'
-        # daemon inspects the pipe's "tasks" payloads for lease
-        # journaling, so their transport stays framed messages)
+        # shared-memory control ring (local pools only; remote pools
+        # get the same batched-envelope trims over their framed daemon
+        # link — the daemon decodes a bookkeeping copy — via
+        # RemoteNodePool._assign_many's ("env", ...) path)
         self._ring_on = bool(GLOBAL_CONFIG.control_ring) \
             and not self.is_remote
         self._ring_slots = int(GLOBAL_CONFIG.control_ring_slots)
@@ -211,6 +212,10 @@ class ProcessWorkerPool:
         # the ring is off
         self.ring_stats = {"msgs": 0, "bytes": 0, "fallback": 0,
                            "full_waits": 0}
+        # per-reason spillback counters (LocalScheduler declines routed
+        # through _rpc_submit); keyed by the daemon's reason string,
+        # surfaced per node by state.list_nodes
+        self.spill_reasons: Dict[str, int] = {}
         # pool-level pickle cache for envelope invariant headers
         self._hdr_blobs: Dict[tuple, bytes] = {}
         # lease pipelining (reference: NormalTaskSubmitter
@@ -1169,6 +1174,7 @@ class ProcessWorkerPool:
                 # adopted lease (failover re-attach or node-local
                 # dispatch): store results only (no spec, no
                 # scheduler/task-manager state for this task here)
+                self._worker.release_local_lease_pins(task_id.binary())
                 try:
                     ready_oids.extend(
                         self._store_entries(inf.return_ids, entries))
@@ -1227,6 +1233,7 @@ class ProcessWorkerPool:
         if inf.pending is None:
             # adopted failover lease: no spec survives the restart, so
             # fail the refs terminally instead of consulting retry policy
+            self._worker.release_local_lease_pins(task_id.binary())
             try:
                 exc = cloudpickle.loads(exc_blob)
             except Exception:
@@ -1307,12 +1314,19 @@ class ProcessWorkerPool:
             # innocent pipelined neighbors fail retriably
             for exec_id, inf in inflight:
                 if inf.pending is None:
-                    # adopted failover lease: the spec died with the old
-                    # head, so the refs fail terminally here
+                    # adopted lease (locally dispatched or re-attached
+                    # across head failover) with no spec to retry from
+                    # — the daemon already re-leased anything with
+                    # attempts left (its local_retry report moved the
+                    # entry off this handle first), so what remains
+                    # fails terminally here
+                    self._worker.release_local_lease_pins(
+                        exec_id.binary())
                     err = rex.WorkerCrashedError(
-                        f"worker process {h.pid} died while running a "
-                        f"lease adopted across head failover: {cause}"
-                        + self._err_tail(h))
+                        f"worker process {h.pid} died while running an "
+                        f"adopted lease (locally dispatched with retries "
+                        f"exhausted, or re-attached across head "
+                        f"failover): {cause}" + self._err_tail(h))
                     for oid in inf.return_ids:
                         self._worker.memory_store.put(
                             oid, err, is_exception=True)
@@ -1460,14 +1474,21 @@ class ProcessWorkerPool:
         return [o.binary() for o in oids if o in ready]
 
     def _rpc_submit(self, h: _Handle, blob: bytes,
-                    spilled: bool = False) -> list:
+                    spilled=False) -> list:
         from ray_tpu._private.ids import PlacementGroupID
 
         if spilled:
-            # the node's LocalScheduler declined this nested submission
-            # (queue at cap / ref args / special resources / retries):
-            # upward spillback — the head stays placement authority
+            # the node's LocalScheduler declined this nested submission:
+            # upward spillback — the head stays placement authority.
+            # `spilled` carries the daemon's reason string (queue_full /
+            # pg / resources / refs / no_slot); per-reason counters ride
+            # lazily-created "spillback:<reason>" keys so the base
+            # stats schema is unchanged with reasons at zero
+            reason = spilled if isinstance(spilled, str) else "other"
             self._worker.note_two_level("spillback")
+            self._worker.note_two_level("spillback:" + reason)
+            self.spill_reasons[reason] = \
+                self.spill_reasons.get(reason, 0) + 1
             note = getattr(self._worker.scheduler, "note_spillback", None)
             if note is not None:
                 note()

@@ -113,6 +113,9 @@ class RemoteNodePool(ProcessWorkerPool):
         # and a lifetime counter — both surfaced by state.list_nodes
         self._local_tids: set = set()
         self.local_dispatched = 0
+        # monotonic timestamp of the last resview push to this node's
+        # daemon (state.list_nodes surfaces it as resview_age_s)
+        self._resview_t: Optional[float] = None
         self._hqueues: Dict[int, queue.Queue] = {}
         self._fetches: Dict[int, Tuple[threading.Event, list]] = {}
         self._pings: Dict[int, Tuple[threading.Event, list]] = {}
@@ -258,6 +261,14 @@ class RemoteNodePool(ProcessWorkerPool):
                 self._local_tids.add(msg[1])
                 self.local_dispatched += 1
             self._worker.on_local_lease(self, msg[1], msg[2])
+        elif kind == "local_retry":
+            # the daemon re-leased a locally-dispatched task's failed
+            # attempt to a sibling worker (per-attempt accounting, no
+            # head round-trip): move the adopted inflight entry to the
+            # new worker and re-journal the bumped attempt token. FIFO
+            # puts this BEFORE the worker_died report, which then no
+            # longer finds the lease on the dead handle
+            self._worker.on_local_retry(self, msg[1], msg[2])
         elif kind == "p2p_done":
             # sequenced completion receipt for a peer-to-peer actor
             # call: results already flowed peer→peer; the head only
@@ -470,9 +481,12 @@ class RemoteNodePool(ProcessWorkerPool):
     def send_resview(self, view: dict) -> None:
         """Push the head's resource/knob view to the node daemon: the
         LocalScheduler admits against this (accept gate, queue cap,
-        p2p flag, job binary, mirrored chaos plan). Sent only while a
-        two-level knob is on — both off means zero wire delta."""
+        p2p flag, job binary, residency digest, peer list, mirrored
+        chaos plan). Sent only while a two-level knob is on — both off
+        means zero wire delta. The push timestamp feeds
+        state.list_nodes' resview_age_s freshness column."""
         self._send_daemon(("resview", view))
+        self._resview_t = time.monotonic()
 
     def local_queue_depth(self) -> int:
         with self._seq_lock:
@@ -513,7 +527,25 @@ class RemoteNodePool(ProcessWorkerPool):
         if self._worker.gcs.journal_enabled:
             for pending, payload in items:
                 self._journal_lease(pending.spec, payload)
+        if self._envelope_on():
+            # tentpole (c): the PR-11 batched lease envelope extends to
+            # remote pools — one ("env", blob) frame rides the daemon
+            # link with the same invariant-header/fn-blob trims as the
+            # local shm ring. _assign_many_ring's pipe fallback is the
+            # sender (remote handles have no ring), the daemon decodes
+            # a bookkeeping copy and forwards the blob verbatim to the
+            # worker's existing "env" pipe branch
+            self._assign_many_ring(h, items)
+            return
         super()._assign_many(h, items)
+
+    def _envelope_on(self) -> bool:
+        # rides the local_dispatch escape hatch: knobs off keeps the
+        # head->daemon wire byte-for-byte pre-two-level ("tasks" lists)
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        return bool(GLOBAL_CONFIG.local_dispatch
+                    and GLOBAL_CONFIG.control_ring)
 
     def _finish_task(self, pending, exec_task_id: TaskID, retry) -> None:
         # terminal for THIS remote attempt (a retry re-journals at its

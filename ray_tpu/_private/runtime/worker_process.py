@@ -166,11 +166,11 @@ class ProcessWorkerContext:
             # dispatch straight to the actor's peer without unpickling
             # user args. Ref-carrying calls stay head-routed (the owner
             # resolves/borrow-tracks refs).
-            blob, has_refs = _dumps_mark_refs(
+            blob, refs = _dumps_mark_refs(
                 (actor_id.binary(), method_name, args, kwargs,
                  num_returns, self._runner.current_trace))
             meta = (actor_id.binary(), method_name, num_returns,
-                    self._runner.current_trace, not has_refs)
+                    self._runner.current_trace, not refs)
             ret_bins = self._runner.rpc("actor_call", (blob, meta))
         else:
             blob = cloudpickle.dumps(
@@ -199,12 +199,13 @@ class ProcessWorkerContext:
             "futures/await on refs are driver-side APIs")
 
 
-def _dumps_mark_refs(value) -> Tuple[bytes, bool]:
-    """cloudpickle.dumps plus "did any ObjectRef ride inside" — one
+def _dumps_mark_refs(value) -> Tuple[bytes, list]:
+    """cloudpickle.dumps plus "which ObjectRefs rode inside" — one
     pass, same bytes. The two-level dispatch paths need the answer
-    (ref-carrying payloads must stay head-routed, where deps resolve),
-    and a second scan pass over large args would double serialization
-    cost on the hot path."""
+    (ref-carrying payloads only admit locally when every arg is
+    provably node-resident, so the daemon needs the ids to check its
+    residency digest), and a second scan pass over large args would
+    double serialization cost on the hot path."""
     import io
 
     from ray_tpu._private.object_ref import ObjectRef
@@ -221,19 +222,23 @@ def _dumps_mark_refs(value) -> Tuple[bytes, bool]:
 
     buf = io.BytesIO()
     _P(buf, protocol=5).dump(value)
-    return buf.getvalue(), bool(seen)
+    return buf.getvalue(), seen
 
 
 def _dump_spec(spec, trace=None, mark_refs=False) -> bytes:
     """Ship a TaskSpec for owner-side admission (func by value).
     ``trace`` is the SUBMITTING task's trace context: the owner restores
     it as the ambient parent around admission so the nested task's own
-    context is stamped as its child. ``mark_refs`` adds a has_refs key
-    (for the daemon's local-dispatch eligibility check) — only set when
-    the daemon advertised two-level dispatch, so the knobs-off blob is
-    unchanged."""
+    context is stamped as its child. ``mark_refs`` adds has_refs / arg_refs
+    keys (for the daemon's local-dispatch eligibility and residency
+    checks) — only set when the daemon advertised two-level dispatch,
+    so the knobs-off blob is unchanged."""
+    arg_refs: Optional[list] = None
     if mark_refs:
-        args_blob, has_refs = _dumps_mark_refs((spec.args, spec.kwargs))
+        args_blob, refs = _dumps_mark_refs((spec.args, spec.kwargs))
+        has_refs = bool(refs)
+        if refs:
+            arg_refs = [r.object_id().binary() for r in refs]
     else:
         args_blob = cloudpickle.dumps((spec.args, spec.kwargs))
         has_refs = None
@@ -249,6 +254,8 @@ def _dump_spec(spec, trace=None, mark_refs=False) -> bytes:
     )
     if has_refs is not None:
         d["has_refs"] = has_refs
+    if arg_refs:
+        d["arg_refs"] = arg_refs
     if trace is not None:
         d["trace"] = trace
     if spec.placement_group_id is not None:
@@ -728,23 +735,34 @@ class _WorkerRunner:
             self._dedup_done.popitem(last=False)
 
     def _resolve(self, v: Any) -> Any:
+        from ray_tpu._private.object_ref import ObjectRef
+
         if isinstance(v, _ShmValue):
             view = self.arena.view(v.offset, v.nbytes)
             return deserialize(SerializedObject.from_bytes(view))
         if isinstance(v, _PullValue):
-            from ray_tpu import exceptions as rex
-
-            # purpose "arg": a task-argument prefetch — the daemon's
-            # pull manager ranks it below blocking user gets
-            locs = self.rpc("get", ([v.oid_bin], None, "arg"))
-            loc = locs[0]
-            if loc[0] == "exc":
-                exc = cloudpickle.loads(loc[1])
-                if isinstance(exc, rex.TaskError):
-                    raise exc.as_instanceof_cause()
-                raise exc
-            return self.load_location(loc)
+            return self._fetch_arg(v.oid_bin)
+        if isinstance(v, ObjectRef):
+            # a locally-dispatched lease ships its args blob verbatim,
+            # so top-level refs arrive unresolved; the daemon serves the
+            # get from its arena when resident (the admission check
+            # proved residency, so this normally never reaches the head)
+            return self._fetch_arg(v.object_id().binary())
         return v
+
+    def _fetch_arg(self, oid_bin: bytes) -> Any:
+        from ray_tpu import exceptions as rex
+
+        # purpose "arg": a task-argument prefetch — the daemon's
+        # pull manager ranks it below blocking user gets
+        locs = self.rpc("get", ([oid_bin], None, "arg"))
+        loc = locs[0]
+        if loc[0] == "exc":
+            exc = cloudpickle.loads(loc[1])
+            if isinstance(exc, rex.TaskError):
+                raise exc.as_instanceof_cause()
+            raise exc
+        return self.load_location(loc)
 
     def _run_batch(self, payloads) -> None:
         """A leased batch: execute in order, completions buffered and

@@ -489,9 +489,19 @@ class Worker:
         self._p2p_seen: "OrderedDict[bytes, bool]" = OrderedDict()
         self._p2p_seen_lock = runtime_sanitizer.wrap_lock(
             threading.Lock(), "_private.worker.Worker._p2p_seen_lock")
+        # arg-object pins for locally-dispatched ref-carrying leases
+        # (tid_bin -> [ObjectID]); released when the lease resolves
+        self._local_lease_pins: Dict[bytes, List[ObjectID]] = {}
+        self._local_pin_lock = runtime_sanitizer.wrap_lock(
+            threading.Lock(), "_private.worker.Worker._local_pin_lock")
         # resource-view push thread (started with the first remote
         # node; sends only while a two-level knob is on)
         self._resview_thread: Optional[threading.Thread] = None
+        # resview versioning: v is a monotonic per-push counter; e is a
+        # per-head-instance epoch so gossiped views from a dead head's
+        # era can never outrank a restarted head's fresh pushes
+        self._resview_push_v = 0
+        self._resview_epoch = os.urandom(8).hex()
         # single-flight head-side peer pulls (oid -> completion event)
         self._head_pull_lock = runtime_sanitizer.wrap_lock(
             threading.Lock(), "_private.worker.Worker._head_pull_lock")
@@ -887,8 +897,9 @@ class Worker:
         with pool._lock:
             h = pool._by_num.get(info.get("worker_num"))
             sub = pool._by_num.get(info.get("submitter"))
+        attempt = int(info.get("attempt", 0))
         if h is not None:
-            pool.adopt_inflight(h, tid_bin, returns, 0)
+            pool.adopt_inflight(h, tid_bin, returns, attempt)
         if self.gcs.journal_enabled:
             self.gcs.journal_lease(tid_bin, {
                 "name": info.get("name"),
@@ -897,10 +908,18 @@ class Worker:
                 "num_returns": int(info.get("num_returns", 1)),
                 "returns": returns,
                 "resources": dict(info.get("resources") or {}),
-                "attempt": 0,
-                "max_retries": 0,
+                "attempt": attempt,
+                "max_retries": int(info.get("max_retries", 0)),
                 "node_index": pool.node_index,
             })
+        arg_pin = [ObjectID(b) for b in info.get("arg_refs") or ()]
+        if arg_pin:
+            # pin the arg objects for the lease's lifetime, mirroring
+            # the head path's submitted-task references (released when
+            # the adopted lease resolves — see release_local_lease_pins)
+            self.reference_counter.add_submitted_task_references(arg_pin)
+            with self._local_pin_lock:
+                self._local_lease_pins[tid_bin] = arg_pin
         if sub is not None:
             # the submitting task borrows its nested refs until it
             # completes, mirroring the head-path _rpc_submit
@@ -915,6 +934,45 @@ class Worker:
                 TaskID(tid_bin), info.get("name") or "?",
                 info.get("trace"), pool.node_index,
                 now=(ts + pool.clock_offset) if ts else None)
+
+    def on_local_retry(self, pool, tid_bin: bytes, info: dict) -> None:
+        """The node daemon re-leased a locally-dispatched task to a
+        fresh local worker after its first worker died (per-attempt
+        accounting rides the journaled lease, so failover replay and
+        the real claimant agree on who owns the attempt). Move the
+        inflight entry off the dead handle and bump the journal's
+        attempt token — outbox FIFO guarantees this report lands
+        before the dead worker's worker_died, so the failure sweep
+        never sees the retried lease on the old handle."""
+        self.note_two_level("local_retry")
+        attempt = int(info.get("attempt", 1))
+        returns = list(info.get("returns") or ())
+        task_id = TaskID(tid_bin)
+        with pool._lock:
+            old = pool._by_task.pop(task_id, None)
+            h = pool._by_num.get(info.get("worker_num"))
+            if old is not None:
+                old.inflight.pop(task_id, None)
+        if h is not None:
+            pool.adopt_inflight(h, tid_bin, returns, attempt)
+        if self.gcs.journal_enabled:
+            lease = self.gcs.journal_get(tid_bin)
+            if lease is not None:
+                lease = dict(lease, attempt=attempt)
+                self.gcs.journal_lease(tid_bin, lease)
+        tp = self.trace_plane
+        if tp is not None:
+            tp.record_failed(TaskID(tid_bin),
+                             "worker died (local retry %d)" % attempt)
+
+    def release_local_lease_pins(self, tid_bin: bytes) -> None:
+        """Drop the arg-object pins taken at local-lease adoption.
+        No-op for tasks without pinned args (head-path tasks, failover
+        re-attached leases)."""
+        with self._local_pin_lock:
+            pins = self._local_lease_pins.pop(tid_bin, None)
+        if pins:
+            self.reference_counter.remove_submitted_task_references(pins)
 
     def on_p2p_done(self, pool, tid_bin: bytes, receipt: dict) -> None:
         """Sequenced completion receipt for a peer-to-peer actor call:
@@ -1069,15 +1127,23 @@ class Worker:
         self._resview_thread = t
         t.start()
 
+    # residency digests above this size stop being pushed (a node
+    # hoarding tens of thousands of objects gains little from local
+    # ref admission and the push would dominate the view payload)
+    _RESVIEW_DIGEST_CAP = 4096
+
     def _resview_push_loop(self) -> None:
         while self.alive:
             try:
                 if GLOBAL_CONFIG.local_dispatch or GLOBAL_CONFIG.actor_p2p:
                     snap = self._chaos.plan_snapshot()
-                    for e in self.gcs.node_table():
-                        p = e.pool
-                        if p is None or not getattr(p, "is_remote", False):
-                            continue
+                    self._resview_push_v += 1
+                    pools = [e.pool for e in self.gcs.node_table()
+                             if e.pool is not None
+                             and getattr(e.pool, "is_remote", False)]
+                    addrs = {p.node_index: getattr(p, "peer_address", None)
+                             for p in pools}
+                    for p in pools:
                         try:
                             p.send_resview({
                                 "accept": bool(GLOBAL_CONFIG.local_dispatch),
@@ -1086,12 +1152,30 @@ class Worker:
                                 "job": self.job_id.binary(),
                                 "node": p.node_index,
                                 "chaos": snap,
+                                "v": self._resview_push_v,
+                                "e": self._resview_epoch,
+                                "peers": [a for i, a in addrs.items()
+                                          if i != p.node_index
+                                          and a is not None],
+                                "resident": self._residency_digest(
+                                    p.node_index),
                             })
                         except Exception:
                             pass  # a dying link re-syncs after rejoin
             except Exception:
                 logger.exception("resview push tick failed")
             time.sleep(0.5)
+
+    def _residency_digest(self, node_index: int) -> Optional[list]:
+        """8-byte oid prefixes of every object copy on the node, for
+        the LocalScheduler's ref-carrying admission check. None when
+        the directory slice is too large to ship (the daemon then
+        falls back to its own arena residency, which it always checks
+        first anyway)."""
+        oids = self.gcs.objects_resident(node_index)
+        if len(oids) > self._RESVIEW_DIGEST_CAP:
+            return None
+        return [oid.binary()[:8] for oid in oids]
 
     def _head_util_gauges(self) -> dict:
         """Internal gauges the head's resource sampler folds into node

@@ -387,7 +387,14 @@ async function refresh() {
       // LocalScheduler right now / lifetime local admissions
       localq: n.local_queue_depth ?? 0,
       dispatched: n.local_dispatched ?? 0,
-    })), ["node", "state", "kind", "resources", "localq", "dispatched"],
+      // per-reason spillback ("reason:count ...") and resource-view
+      // freshness (age of the head's last resview push to the daemon)
+      spills: Object.entries(n.spill_reasons || {})
+        .map(([r, c]) => r + ":" + c).join(" ") || "–",
+      resview: n.resview_age_s == null ? "–"
+        : n.resview_age_s.toFixed(1) + "s",
+    })), ["node", "state", "kind", "resources", "localq", "dispatched",
+          "spills", "resview"],
        ["state"]);
     document.getElementById("tasks").innerHTML = rows(
       Object.entries(t).map(([state, count]) => ({state, count})),

@@ -148,6 +148,15 @@ def list_nodes() -> List[Dict[str, Any]]:
             depth_fn = getattr(pool, "local_queue_depth", None)
             row["local_queue_depth"] = depth_fn() if depth_fn else 0
             row["local_dispatched"] = getattr(pool, "local_dispatched", 0)
+            # per-reason spillback counters (why did submissions from
+            # this node consult the head?) and resource-view freshness:
+            # seconds since the head last pushed its view to the node's
+            # daemon — None when no push ever went out (knobs off)
+            row["spill_reasons"] = dict(
+                getattr(pool, "spill_reasons", None) or {})
+            t = getattr(pool, "_resview_t", None)
+            row["resview_age_s"] = (round(now - t, 3)
+                                    if t is not None else None)
         rows.append(row)
     return rows
 

@@ -505,6 +505,75 @@ class TestWireProtocol:
         # the conformant p2p_done tag raises nothing
         assert not any(k.split(":")[-1] == "p2p_done" for k in keys), keys
 
+    def test_gossip_and_remote_envelope_drift_caught(self, tmp_path):
+        """Head-bypass satellite: the resview gossip frames (("rview",
+        view) on the peer lane), the daemon's local-retry report
+        (("local_retry", tid, info)), and the remote lease envelope
+        (("env", blob) decoded by BOTH the worker and the daemon's
+        in-transit bookkeeping copy) all flow through already-declared
+        callees/recvs in the real table. This fixture injects the
+        drift that WOULD appear if the halves diverged: a gossip frame
+        whose receiver expects a delta field the sender never ships, a
+        retry report with no head demux branch, and a relay decoder
+        that unpacks an envelope shape no sender produces."""
+        _write(tmp_path, "gossiper.py", """
+            def tick(self, lane, view):
+                self._lane_send(("rview", view), lane)
+            """)
+        _write(tmp_path, "peer.py", """
+            def serve(conn):
+                msg = conn.recv()
+                kind = msg[0]
+                if kind == "rview":
+                    # expects a delta list the gossiper never ships
+                    return msg[2]
+                return None
+            """)
+        _write(tmp_path, "daemon.py", """
+            def retry(self, tid, info):
+                self._send_head(("local_retry", tid, info))
+            """)
+        _write(tmp_path, "head.py", """
+            def dispatch(msg):
+                kind = msg[0]
+                if kind == "local_lease":
+                    return msg[1]
+                return None
+            """)
+        _write(tmp_path, "pool.py", """
+            def pump(self, h):
+                self._ring_send(("env", b"blob"), h)
+            """)
+        _write(tmp_path, "relay.py", """
+            def bookkeep(msg):
+                kind = msg[0]
+                if kind == "env":
+                    tag, blob, extra = msg
+                    return extra
+                return None
+            """)
+        channels = [
+            ChannelSpec(name="gossip",
+                        sends=[SendSpec("gossiper.py", "_lane_send")],
+                        recvs=[RecvSpec("peer.py", "serve")]),
+            ChannelSpec(name="d2h_retry",
+                        sends=[SendSpec("daemon.py", "_send_head")],
+                        recvs=[RecvSpec("head.py", "dispatch")],
+                        assume_sent={"local_lease"}),
+            ChannelSpec(name="remote_env",
+                        sends=[SendSpec("pool.py", "_ring_send")],
+                        recvs=[RecvSpec("relay.py", "bookkeep")]),
+        ]
+        keys = _keys(wire_protocol.analyze(str(tmp_path), _mk,
+                                           channels=channels,
+                                           op_channels=[]))
+        assert any(k.startswith("wire:arity:") and "rview" in k
+                   for k in keys), keys
+        assert any(k.startswith("wire:sent-unhandled:")
+                   and "local_retry" in k for k in keys), keys
+        assert any(k.startswith("wire:arity:") and "env" in k
+                   and "unpack3" in k for k in keys), keys
+
     def test_real_channels_have_no_drift(self):
         # satellite (f): remote_pool<->node_daemon (and the other three
         # channels) must agree on tags and arities; the daemon/demux

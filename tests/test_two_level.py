@@ -250,9 +250,9 @@ class TestMarkRefsPickler:
             _dumps_mark_refs
 
         k = 41
-        blob, has_refs = _dumps_mark_refs(
+        blob, refs = _dumps_mark_refs(
             ((lambda: k + 1,), {"f": lambda v: v * 2}))
-        assert has_refs is False
+        assert refs == []
         args, kwargs = cp.loads(blob)
         assert args[0]() == 42
         assert kwargs["f"](3) == 6
@@ -264,8 +264,8 @@ class TestMarkRefsPickler:
             _dumps_mark_refs
 
         ref = ObjectRef(ObjectID(b"\x01" * 20), None, _register=False)
-        _, has_refs = _dumps_mark_refs(((ref,), {}))
-        assert has_refs is True
+        _, refs = _dumps_mark_refs(((ref,), {}))
+        assert [r.object_id().binary() for r in refs] == [b"\x01" * 20]
 
     def test_closure_args_over_both_two_level_lanes(self, two_level_ray):
         """E2E: a closure arg rides (a) the p2p actor-call blob and
@@ -321,14 +321,17 @@ class TestPoisonP2PBlob:
 
 
 class TestKnobsOff:
-    def test_defaults_emit_zero_two_level_traffic(self):
-        """local_dispatch=False + actor_p2p=False must be the pre-PR
-        wire: no resview push thread, no p2p adverts, zero two-level
-        counters after a workload that WOULD use both lanes, and the
-        four metric families rendered as schema-stable zeros."""
+    def test_knobs_off_emits_zero_two_level_traffic(self):
+        """local_dispatch=False + actor_p2p=False (the escape hatch —
+        no longer the default) must be the pre-two-level wire: no
+        resview pushes, no p2p adverts, zero two-level counters after
+        a workload that WOULD use both lanes, and the four metric
+        families rendered as schema-stable zeros."""
         ray_tpu.shutdown()
         ray_tpu.init(num_workers=2,
-                     _system_config={"worker_mode": "process"})
+                     _system_config={"worker_mode": "process",
+                                     "local_dispatch": False,
+                                     "actor_p2p": False})
         w = worker_mod.get_worker()
         w.add_remote_cluster_node(num_cpus=2.0, num_workers=1,
                                   resources={"a": 2})
