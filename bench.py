@@ -305,6 +305,93 @@ print("FAILOVER_JSON:" + json.dumps(r))
 """
 
 
+_NODE_LOSS_CHILD = """
+import json
+import sys
+import time
+
+sys.path.insert(0, {repo!r})
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+
+ray_tpu.init(num_workers=2,
+             _system_config={{"worker_mode": "process",
+                              "node_heartbeat_timeout_s": 20.0,
+                              "health_check_timeout_s": 5.0}})
+w = worker_mod.get_worker()
+ea = w.add_remote_cluster_node(num_cpus=4.0, num_workers=3,
+                               resources={{"a": 4}})
+
+# exec-loaded so cloudpickle ships the functions by value
+ns = {{}}
+exec("def nap(i):\\n    import time\\n    time.sleep(5.0)\\n    return i\\n"
+     "def produce():\\n    return bytes(range(256)) * 4096\\n", ns)
+ns["nap_r"] = ray_tpu.remote(ns["nap"]).options(max_retries=3)
+ns["prod_r"] = ray_tpu.remote(ns["produce"]).options(max_retries=2)
+exec("def spawn(m):\\n"
+     "    return [nap_r.remote(i) for i in range(m)]\\n"
+     "def make():\\n"
+     "    import ray_tpu\\n"
+     "    ref = prod_r.remote()\\n"
+     "    assert len(ray_tpu.get(ref, timeout=60.0)) == 1024 * 1024\\n"
+     "    return ref\\n", ns)
+spawn = ray_tpu.remote(ns["spawn"]).options(resources={{"a": 1.0}})
+make = ray_tpu.remote(ns["make"]).options(resources={{"a": 1.0}})
+
+# sole copy: a locally-dispatched nested producer fills 1 MiB into the
+# node's arena; only the ref escapes to the head
+inner = ray_tpu.get(make.remote(), timeout=120.0)
+
+# in-flight: locally-dispatched retry-carrying naps, refs held head-side
+refs = ray_tpu.get(spawn.remote(2), timeout=60.0)
+deadline = time.monotonic() + 30
+while w.two_level_stats["local_dispatch"] < 3 \\
+        and time.monotonic() < deadline:
+    time.sleep(0.05)
+
+t0 = time.monotonic()
+ea.pool.simulate_machine_death()
+ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=120.0)
+if not ready:
+    raise RuntimeError("no recovered result within 120s of node kill")
+blackout = time.monotonic() - t0
+vals = ray_tpu.get(refs, timeout=120.0)
+
+t1 = time.monotonic()
+blob = ray_tpu.get(inner, timeout=120.0)
+recon_s = time.monotonic() - t1
+
+s = w.two_level_stats
+r = {{"blackout_s": round(blackout, 3),
+     "recovered_ok": vals == [0, 1],
+     "reconstruct_s": round(recon_s, 3),
+     "reconstruct_mb": round(len(blob) / (1024.0 * 1024.0), 3),
+     "orphan_leases_retried": s.get("orphan_retried", 0),
+     "node_deaths": s.get("node_deaths", 0)}}
+ray_tpu.shutdown()
+print("NODE_LOSS_JSON:" + json.dumps(r))
+"""
+
+
+def _node_loss_subprocess() -> dict:
+    """Whole-node SIGKILL drill in a fresh interpreter: one remote
+    node with locally-dispatched retry-carrying leases mid-flight and
+    a sole-copy object in its arena; killpg the daemon tree and
+    measure kill -> first reconciler-recovered result (the blackout)
+    plus how many bytes lineage reconstruction re-derived."""
+    env = spawn_env.child_env()
+    code = _NODE_LOSS_CHILD.format(repo=REPO)
+    timeout = max(120.0, min(300.0, _remaining() - 10.0))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    for line in out.stdout.splitlines():
+        if line.startswith("NODE_LOSS_JSON:"):
+            return json.loads(line[len("NODE_LOSS_JSON:"):])
+    raise RuntimeError(
+        f"node_loss child produced no result: {out.stderr[-2000:]}")
+
+
 def _failover_subprocess() -> dict:
     """Head-kill blackout drill in a fresh interpreter: subprocess head
     on a journal + one remote node, SIGKILL the head mid-run, restart
@@ -973,6 +1060,24 @@ def main() -> int:
         except Exception:
             traceback.print_exc()
             OUT["failover"] = None
+        _emit()
+
+    # --- node loss: whole-node SIGKILL blackout + reconstruction -------
+    if section("node_loss", 45):
+        try:
+            r = _node_loss_subprocess()
+            OUT["node_loss"] = r
+            print(f"  node_loss: {r['blackout_s']:.2f}s blackout "
+                  f"(SIGKILL node -> first reconciler-recovered "
+                  f"result); {r['reconstruct_mb']:.1f} MiB "
+                  f"reconstructed in {r['reconstruct_s']:.2f}s, "
+                  f"{r['orphan_leases_retried']} orphan leases retried, "
+                  f"in-flight results "
+                  f"{'intact' if r['recovered_ok'] else 'LOST'}",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+            OUT["node_loss"] = None
         _emit()
 
     if section("rl_rollout", 45):

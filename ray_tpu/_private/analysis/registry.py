@@ -138,13 +138,20 @@ _CHAOS_HEADING_RE = re.compile(r"^#+\s.*chaos", re.IGNORECASE | re.MULTILINE)
 
 def collect_chaos_sites(tree: ast.Module,
                         var: str = "_SITE_KINDS") -> Dict[str, int]:
-    """site name -> lineno, from the ``_SITE_KINDS`` dict literal."""
+    """site name -> lineno, from the ``_SITE_KINDS`` dict literal.
+    Matches both plain and annotated assignments — the real registry
+    is annotated (``_SITE_KINDS: Dict[...] = {...}``), and an
+    Assign-only walk silently disabled this whole check."""
     out: Dict[str, int] = {}
     for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
             continue
         if not any(isinstance(t, ast.Name) and t.id == var
-                   for t in node.targets):
+                   for t in targets):
             continue
         if isinstance(node.value, ast.Dict):
             for k in node.value.keys:

@@ -76,6 +76,10 @@ DEFAULT_CHANNELS: List[ChannelSpec] = [
             SendSpec("_private/runtime/remote_pool.py", "_send_daemon"),
             SendSpec("_private/runtime/remote_pool.py", "_log_request",
                      delta=1),
+            # node-death control frames originate head-side: the fence
+            # on a rejoin-after-declared-dead readopt and the route
+            # invalidation broadcast to every surviving daemon
+            SendSpec("_private/worker.py", "_send_daemon"),
         ],
         recvs=[RecvSpec("_private/runtime/node_daemon.py",
                         "NodeDaemon.run")],

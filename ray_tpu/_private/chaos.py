@@ -36,6 +36,20 @@ head       kill, restart, flap  GcsService health loop: ``flap``
                                 external harnesses (bench/soak
                                 drivers kill + relaunch the head
                                 subprocess at the seeded arrival)
+node       kill, restart, flap  GcsService health loop: ``kill``
+                                SIGKILLs a remote node's daemon
+                                with its whole worker tree (the
+                                machine-death drill — the head-side
+                                node-death reconciler must retry or
+                                fail its adopted local leases, purge
+                                ghost gossip views, and broadcast
+                                route invalidation); ``flap`` severs
+                                just that node's daemon link;
+                                ``restart`` is a marker for external
+                                harnesses (kill + relaunch the node
+                                process). Params: ``node`` selects
+                                the victim scheduler row (default:
+                                the lowest-index alive remote node)
 peer_link  delay, drop, sever   NodeDaemon peer actor lane (p2p
                                 actor calls): ``delay`` stalls the
                                 frame, ``drop`` loses the call
@@ -63,7 +77,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 SITES: Tuple[str, ...] = (
     "task", "worker", "link", "transfer", "sched_tick", "heartbeat",
-    "head", "peer_link")
+    "head", "node", "peer_link")
 
 _SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "task": ("exception", "hang"),
@@ -73,6 +87,7 @@ _SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "sched_tick": ("slow",),
     "heartbeat": ("drop",),
     "head": ("kill", "restart", "flap"),
+    "node": ("kill", "restart", "flap"),
     "peer_link": ("delay", "drop", "sever"),
 }
 

@@ -765,6 +765,9 @@ class GcsService:
             fault = chaos.poll("head")
             if fault is not None:
                 self._inject_head_fault(fault)
+            nfault = chaos.poll("node")
+            if nfault is not None:
+                self._inject_node_fault(nfault)
             for e in self.alive_process_nodes():
                 pool = e.pool
                 if pool is None:
@@ -831,6 +834,51 @@ class GcsService:
             logger.warning("chaos[head]: SIGKILL self (pid %d)",
                            os.getpid())
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def _inject_node_fault(self, fault: Dict[str, Any]) -> None:
+        """``node`` chaos site, polled once per health tick. ``kill``
+        SIGKILLs the victim's daemon process WITH its whole worker
+        tree (machine death: nothing on the node survives to report
+        anything; the severed link / health probes must notice and the
+        head-side node-death reconciler must recover every adopted
+        lease, route, and sole-copy object); ``flap`` severs just that
+        node's daemon link (blackout + outbox replay without death);
+        ``restart`` is a marker kind for external harnesses (they kill
+        and relaunch the node process at the seeded arrival) and a
+        no-op in-core. The ``node`` param picks the victim scheduler
+        row; default is the lowest-index alive remote node."""
+        kind = fault.get("kind")
+        victims = [e for e in self.alive_process_nodes()
+                   if e.kind == "remote" and e.pool is not None]
+        if not victims:
+            return
+        want = fault.get("node")
+        victim = None
+        if want is not None:
+            for e in victims:
+                if e.index == int(want):
+                    victim = e
+                    break
+        if victim is None:
+            victim = min(victims, key=lambda e: e.index)
+        if kind == "kill":
+            logger.warning("chaos[node]: machine-death SIGKILL of node "
+                           "%s (row %d)", victim.node_id.hex()[:16],
+                           victim.index)
+            try:
+                victim.pool.simulate_machine_death()
+            except Exception:
+                logger.exception("chaos[node]: kill of node %s failed",
+                                 victim.node_id.hex()[:16])
+        elif kind == "flap":
+            logger.warning("chaos[node]: flapping daemon link of node "
+                           "%s (row %d)", victim.node_id.hex()[:16],
+                           victim.index)
+            try:
+                victim.pool.sever_link()
+            except Exception:
+                logger.exception("chaos[node]: flap of node %s failed",
+                                 victim.node_id.hex()[:16])
 
     def shutdown(self) -> None:
         self._shutdown = True

@@ -316,6 +316,24 @@ def _render_core(worker) -> List[str]:
          "buffered daemon messages re-sent after a link drop or head "
          "failover (summed over remote nodes)", outbox_replayed)
 
+    # node-loss fault domain: whole-node deaths handled by the head's
+    # node-death reconciler, and the fate of the adopted local leases
+    # each death orphaned (resubmitted under their original return
+    # oids vs dropped as fenced dead-era replays). Schema-stable zeros
+    # while no node has ever died.
+    emit("ray_tpu_node_deaths_total", "counter",
+         "whole-node failures the head reconciled (daemon SIGKILL, "
+         "lost link past the rejoin grace, stale heartbeat)",
+         tl.get("node_deaths", 0))
+    emit("ray_tpu_orphan_leases_retried_total", "counter",
+         "locally-dispatched leases orphaned by a node death and "
+         "resubmitted head-side under their original return oids",
+         tl.get("orphan_retried", 0))
+    emit("ray_tpu_orphan_leases_fenced_total", "counter",
+         "stale outbox replay envelopes dropped by the epoch fence "
+         "after a declared-dead node rejoined",
+         tl.get("orphan_fenced", 0))
+
     # shared-memory control ring (local process pools): envelope
     # traffic vs pipe fallback. Schema-stable zeros when the ring is
     # disabled or no process pool exists.

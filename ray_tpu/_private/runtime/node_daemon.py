@@ -62,6 +62,19 @@ Head -> daemon messages:
   ("aroute", aid_bin, route)  actor-route reply for an ("aresolve",
                               aid_bin) request: (node_index, address,
                               worker_num) or None
+  ("node_dead", info)         route invalidation: a PEER node died
+                              (info: {index, peer}); evict its gossip
+                              view, drop cached p2p actor routes to
+                              its address and sweep in-flight lane
+                              calls to the head path NOW instead of
+                              waiting out the p2p result timeout
+  ("fence", epoch)            this daemon rejoined AFTER the head
+                              declared its node dead: clear dead-era
+                              local-lease / in-flight-p2p / outbox
+                              state — the head already resubmitted or
+                              failed everything that era produced, so
+                              a zombie re-lease or stale fallback
+                              would double-execute
   ("exit",)                   kill workers and exit
 
 Daemon -> head messages:
@@ -603,6 +616,11 @@ class NodeDaemon:
         # and B-side pending executions awaiting their result send
         self._actor_routes: Dict[bytes, tuple] = {}
         self._aresolve_last: Dict[bytes, float] = {}
+        # peer addresses the head declared DEAD (("node_dead", info)
+        # broadcast): their gossiped views are ghosts — never adopt
+        # one, never gossip to them. Entries clear when a head-pushed
+        # view re-lists the address (the node rejoined).
+        self._dead_peers: set = set()
         self._actor_salts: Dict[bytes, list] = {}
         self._p2p_calls: Dict[bytes, dict] = {}
         self._p2p_lanes: Dict[tuple, dict] = {}
@@ -1294,6 +1312,22 @@ class NodeDaemon:
         head's direct push stays the authoritative tiebreaker — and
         keep this node's own node-scoped fields (node index, residency
         digest): a peer's digest describes the peer's arena."""
+        if from_peer:
+            # ghost-view eviction: the head declared the gossiping
+            # node dead — a view it shipped pre-death (arriving late
+            # over a still-draining lane) must never gate admission
+            origin = view.get("from")
+            with self._p2p_lock:
+                if origin is not None \
+                        and tuple(origin) in self._dead_peers:
+                    return
+        else:
+            # a head-pushed peers list re-listing an address clears
+            # its death mark (the node rejoined under a fresh daemon)
+            listed = {tuple(p) for p in view.get("peers") or ()}
+            if listed:
+                with self._p2p_lock:
+                    self._dead_peers -= listed
         with self._resview_lock:
             if from_peer:
                 # same head instance (epoch) and strictly newer only:
@@ -1773,6 +1807,59 @@ class NodeDaemon:
             "reason": reason,
         }))
 
+    def _on_peer_dead(self, info: dict) -> None:
+        """Head broadcast: a peer node died. Evict every trace of it
+        NOW — its gossip view (local admission must never trust a
+        ghost node's resource/residency claims), cached p2p actor
+        routes to its address, the lane itself, and every in-flight
+        call routed over it (swept straight to the head-path fallback
+        instead of waiting out the 15s p2p result timeout)."""
+        addr = info.get("peer")
+        addr = tuple(addr) if addr else None
+        dead_index = info.get("index")
+        if addr is not None:
+            with self._p2p_lock:
+                self._dead_peers.add(addr)
+            with self._resview_lock:
+                peers = self._resview.get("peers")
+                if peers:
+                    self._resview["peers"] = [
+                        p for p in peers if tuple(p) != addr]
+        with self._p2p_lock:
+            stale = [aid for aid, route in self._actor_routes.items()
+                     if (addr is not None and tuple(route[1]) == addr)
+                     or (dead_index is not None
+                         and route[0] == dead_index)]
+            for aid in stale:
+                del self._actor_routes[aid]
+        if addr is not None:
+            self._sever_lane(addr, "peer node died")
+
+    def _on_fence(self, epoch) -> None:
+        """The head re-adopted this daemon AFTER declaring its node
+        dead: everything from the dead era was already resubmitted or
+        failed head-side, so clear the local-lease bodies (no zombie
+        re-lease), the in-flight p2p call table (no stale head
+        fallback re-executing a settled call), and the outbox (its
+        replays were acked-and-dropped by the fenced pool anyway)."""
+        import logging
+
+        # _local_leases is GIL-atomic like its other mutation sites
+        # (worker reader threads pop, admission assigns — none hold a
+        # lock); only the _local_tids admission set is _resview_lock'd
+        n_leases = len(self._local_leases)
+        self._local_leases.clear()
+        with self._resview_lock:
+            self._local_tids.clear()
+        with self._p2p_lock:
+            n_calls = len(self._p2p_calls)
+            self._p2p_calls.clear()
+        self._outbox.ack(self._outbox.last_seq)
+        logging.getLogger(__name__).warning(
+            "fenced by head (epoch %s): cleared %d dead-era local "
+            "leases and %d in-flight p2p calls", epoch, n_leases,
+            n_calls)
+
     def _gossip_loop(self) -> None:
         """Tentpole (d): re-share the freshest resource view this
         daemon holds with its peers over the existing actor lanes, so
@@ -1791,7 +1878,13 @@ class NodeDaemon:
                 view = dict(self._resview)
             if not (view.get("accept") or view.get("p2p")):
                 continue  # knobs off: the peer wire stays silent
+            # origin stamp: receivers drop views gossiped FROM a node
+            # the head has since declared dead (ghost-view eviction)
+            view["from"] = tuple(self.peer_address)
             for addr in view.get("peers") or ():
+                with self._p2p_lock:
+                    if tuple(addr) in self._dead_peers:
+                        continue
                 # the gossip frames ride the same peer lanes as p2p
                 # calls, so the peer_link chaos site covers them too:
                 # a severed/dropped lane must cost only freshness (the
@@ -2054,6 +2147,10 @@ class NodeDaemon:
                 self._apply_resview(msg[1])
             elif kind == "aroute":
                 self._on_aroute(msg[1], msg[2])
+            elif kind == "node_dead":
+                self._on_peer_dead(msg[1])
+            elif kind == "fence":
+                self._on_fence(msg[1])
             elif kind == "free":
                 for b in msg[1]:
                     self.store.free_object(ObjectID(b))

@@ -451,6 +451,16 @@ class ProcessWorkerPool:
             self._finish_task(pending, spec.task_id, retry)
         for h in handles:
             self._kill_handle(h)
+            if h.actor_rt is not None:
+                # a REMOTE pool has no per-process monitor to observe
+                # that kill — the daemon that would report worker_died
+                # died with the node — so synthesize the failure here
+                # or the actor runtime never learns its process is
+                # gone (no restart, in-flight rounds hang). Idempotent
+                # under _on_worker_failure's was_dead guard, so the
+                # local-pool monitor double-firing is harmless.
+                self._on_worker_failure(h, rex.NodeDiedError(
+                    f"node died: {reason}"))
 
     def _kill_handle(self, h: _Handle) -> None:
         """SIGKILL the worker behind a handle (remote pools route this
@@ -740,6 +750,13 @@ class ProcessWorkerPool:
         fn_id = spec.func_id
         if fn_blob is None:
             fn_blob = cloudpickle.dumps(spec.func)
+            fn_id = fn_id_of(fn_blob)
+        elif fn_id is None:
+            # specs built from retained lease records (failover / node
+            # loss resubmits) carry the original blob but no cached id;
+            # a None id would collide every such fn in the per-worker
+            # fn_cache and the sent_fns dedupe, executing the WRONG
+            # function body under this task's name
             fn_id = fn_id_of(fn_blob)
         payload = dict(
             task_id=spec.task_id.binary(),
@@ -1114,12 +1131,24 @@ class ProcessWorkerPool:
                  timing=None) -> None:
         inf = self._take_inflight(h, task_id)
         if inf is None:
-            return  # force-cancel/worker-failure claimed the task first
+            # force-cancel/worker-failure claimed the task first — or,
+            # on a FENCED pool (node rejoined after being declared
+            # dead), this is a dead-era lease's late completion: the
+            # reconciler already resubmitted it, so the stale result is
+            # dropped, never double-resolved
+            if getattr(self, "_fenced", False):
+                self._worker.note_two_level("orphan_fenced")
+            return
         if inf.pending is None:
             # adopted lease (failover re-attach or node-local
             # dispatch): resolve the refs, free the worker. The trace
             # plane may hold a live record for it (local-dispatch
-            # lane); unknown ids are a no-op pop there.
+            # lane); unknown ids are a no-op pop there. Pin release
+            # keeps the record as lineage — this is the REMOTE node's
+            # completion path, and the returns may be the sole copy in
+            # that node's arena
+            self._worker.release_local_lease_pins(task_id.binary(),
+                                                  keep_lineage=True)
             self.store_result_entries(inf.return_ids, entries)
             tp = self._worker.trace_plane
             if tp is not None:
@@ -1173,8 +1202,11 @@ class ProcessWorkerPool:
             if inf.pending is None:
                 # adopted lease (failover re-attach or node-local
                 # dispatch): store results only (no spec, no
-                # scheduler/task-manager state for this task here)
-                self._worker.release_local_lease_pins(task_id.binary())
+                # scheduler/task-manager state for this task here).
+                # keep_lineage: the record becomes the lineage entry
+                # that reconstructs sole-copy returns after node death
+                self._worker.release_local_lease_pins(task_id.binary(),
+                                                      keep_lineage=True)
                 try:
                     ready_oids.extend(
                         self._store_entries(inf.return_ids, entries))
@@ -1229,7 +1261,12 @@ class ProcessWorkerPool:
                 tb: str, timing=None) -> None:
         inf = self._take_inflight(h, task_id)
         if inf is None:
-            return  # force-cancel/worker-failure claimed the task first
+            # force-cancel/worker-failure claimed it first — or a
+            # fenced pool dropping a dead-era lease's late error (see
+            # _on_done)
+            if getattr(self, "_fenced", False):
+                self._worker.note_two_level("orphan_fenced")
+            return
         if inf.pending is None:
             # adopted failover lease: no spec survives the restart, so
             # fail the refs terminally instead of consulting retry policy
@@ -1315,22 +1352,23 @@ class ProcessWorkerPool:
             for exec_id, inf in inflight:
                 if inf.pending is None:
                     # adopted lease (locally dispatched or re-attached
-                    # across head failover) with no spec to retry from
-                    # — the daemon already re-leased anything with
-                    # attempts left (its local_retry report moved the
-                    # entry off this handle first), so what remains
-                    # fails terminally here
-                    self._worker.release_local_lease_pins(
-                        exec_id.binary())
+                    # across head failover) with no spec to retry from.
+                    # A LIVE daemon re-leases anything with attempts
+                    # left itself (its local_retry report moved the
+                    # entry off this handle first); whatever reaches
+                    # here goes through the head-side orphan-lease
+                    # reconciler, which resubmits under the original
+                    # return oids when a retained record still carries
+                    # attempts (whole-node death, no sibling slot) and
+                    # fails the refs terminally otherwise
                     err = rex.WorkerCrashedError(
                         f"worker process {h.pid} died while running an "
                         f"adopted lease (locally dispatched with retries "
                         f"exhausted, or re-attached across head "
                         f"failover): {cause}" + self._err_tail(h))
-                    for oid in inf.return_ids:
-                        self._worker.memory_store.put(
-                            oid, err, is_exception=True)
-                        self._worker.scheduler.notify_object_ready(oid)
+                    self._worker.reconcile_orphan_lease(
+                        exec_id.binary(),
+                        [oid.binary() for oid in inf.return_ids], err)
                     self._lease_done(exec_id)
                     with self._lock:
                         self._by_task.pop(exec_id, None)

@@ -83,8 +83,17 @@ class RemoteNodePool(ProcessWorkerPool):
     def __init__(self, worker, num_workers: int, node_index: int, conn,
                  node_id, daemon_proc: Optional[subprocess.Popen] = None,
                  arena_name: Optional[str] = None,
-                 peer_address: Optional[tuple] = None):
+                 peer_address: Optional[tuple] = None,
+                 fenced: bool = False):
         self._arena_name = arena_name
+        # epoch fence (node-death FT): a daemon that rejoins AFTER the
+        # head declared its node dead gets a fenced pool — outbox
+        # REPLAY envelopes (receipts stranded from the dead era) are
+        # acked but never dispatched, because the head already failed
+        # or resubmitted everything that era produced; processing a
+        # stale lease/completion replay would double-resolve it. Fresh
+        # (non-replay) traffic flows normally.
+        self._fenced = fenced
         # daemon's direct-transfer endpoint (object manager peer plane):
         # other nodes pull object bytes straight from it, head-free
         self.peer_address = tuple(peer_address) if peer_address else None
@@ -182,6 +191,13 @@ class RemoteNodePool(ProcessWorkerPool):
                         self.outbox_replayed += 1
                 self._send_daemon(("ack", high_water))
                 if duplicate:
+                    continue
+                if is_replay and getattr(self, "_fenced", False):
+                    # stale-era replay into a fenced (rejoined-after-
+                    # declared-dead) pool: ack'd above so the daemon
+                    # trims its outbox, but never dispatched — the
+                    # node-death reconciler already settled this era
+                    self._worker.note_two_level("orphan_fenced")
                     continue
                 runtime_sanitizer.check_wire("daemon_to_head", inner)
                 msg = inner
@@ -310,8 +326,18 @@ class RemoteNodePool(ProcessWorkerPool):
                 ev.set()
             table.clear()
         grace = GLOBAL_CONFIG.daemon_rejoin_grace_s
-        daemon_known_dead = (self._daemon_proc is not None
-                             and self._daemon_proc.poll() is not None)
+        proc = self._daemon_proc
+        if proc is not None and proc.poll() is None \
+                and getattr(self, "_respawn_disabled", False):
+            # machine-death chaos killpg'd the tree: the socket EOF can
+            # beat the zombie transition by a scheduler tick, and
+            # poll() alone would misread a corpse as a live daemon
+            # worth a full rejoin grace window
+            try:
+                proc.wait(timeout=0.5)
+            except Exception:
+                pass
+        daemon_known_dead = proc is not None and proc.poll() is not None
         if (grace > 0 and not daemon_known_dead and not self._shutdown
                 and not self._node_dead
                 and self._worker.gcs.mark_node_rejoining(self.node_id)):
@@ -341,11 +367,13 @@ class RemoteNodePool(ProcessWorkerPool):
         self._fail_lost_daemon()
 
     def _fail_lost_daemon(self) -> None:
-        # snapshot: _queue_loop threads pop _hqueues as they die
-        for q in list(self._hqueues.values()):
-            q.put(("__died__", "daemon connection lost"))
         with self._conn_lock:
             self._pending_sends.clear()
+        # declare the node dead BEFORE waking the per-worker queue
+        # loops: their __died__ handling restarts actors, and a
+        # restart that races the _node_dead flag re-spawns onto this
+        # very corpse (burning a restart attempt on a worker that can
+        # never register)
         if not self._shutdown and not self._node_dead:
             logger.warning("node %s: daemon connection lost; marking dead",
                            self.node_id.hex()[:16])
@@ -354,6 +382,9 @@ class RemoteNodePool(ProcessWorkerPool):
                     self.node_id, reason="daemon connection lost")
             except Exception:
                 logger.exception("on_node_failure failed")
+        # snapshot: _queue_loop threads pop _hqueues as they die
+        for q in list(self._hqueues.values()):
+            q.put(("__died__", "daemon connection lost"))
         self._unlink_dead_arena()
 
     def reattach(self, conn) -> None:
@@ -619,17 +650,43 @@ class RemoteNodePool(ProcessWorkerPool):
         return slot[0]
 
     def simulate_machine_death(self) -> None:
-        """Chaos: SIGKILL the node daemon (the whole 'machine'). The
-        control plane is NOT told; the severed connection / health
-        checks must notice."""
+        """Chaos: SIGKILL the node daemon AND its whole worker tree
+        (the daemon runs in its own session — see the
+        start_new_session spawn flag — so killpg takes out every
+        process on the 'machine' at once; nothing survives to flush an
+        outbox or report a death). The control plane is NOT told; the
+        severed connection / health checks must notice."""
+        import signal
+
         self._respawn_disabled = True
         if self._daemon_proc is not None:
+            pid = self._daemon_proc.pid
+            killed = False
             try:
-                self._daemon_proc.kill()
-            except Exception:
+                # only a daemon in its OWN process group is tree-
+                # killable; a same-group daemon (legacy spawn) falls
+                # back to killing just the daemon process
+                if os.getpgid(pid) != os.getpgid(0):
+                    os.killpg(os.getpgid(pid), signal.SIGKILL)
+                    killed = True
+            except (OSError, ProcessLookupError):
                 pass
+            if not killed:
+                try:
+                    self._daemon_proc.kill()
+                except Exception:
+                    pass
         else:
             self._send_daemon(("exit",))
+
+    def take_local_tids(self) -> set:
+        """Node-death reconciliation: claim (snapshot + clear) the
+        locally-admitted in-flight lease set, so the reconciler — not
+        the worker-failure sweep — decides each lease's fate exactly
+        once."""
+        with self._seq_lock:
+            tids, self._local_tids = self._local_tids, set()
+        return tids
 
     # -- object movement ----------------------------------------------
     def fetch_object(self, oid: ObjectID,

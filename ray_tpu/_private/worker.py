@@ -482,7 +482,10 @@ class Worker:
         self.two_level_stats: Dict[str, int] = {"local_dispatch": 0,
                                                 "spillback": 0,
                                                 "p2p": 0,
-                                                "head_fallback": 0}
+                                                "head_fallback": 0,
+                                                "node_deaths": 0,
+                                                "orphan_retried": 0,
+                                                "orphan_fenced": 0}
         # p2p exactly-once arbiter: first arrival (completion receipt
         # OR head fallback) for a task id claims it, the loser no-ops.
         # Bounded FIFO — duplicates race within seconds, not hours.
@@ -494,6 +497,23 @@ class Worker:
         self._local_lease_pins: Dict[bytes, List[ObjectID]] = {}
         self._local_pin_lock = runtime_sanitizer.wrap_lock(
             threading.Lock(), "_private.worker.Worker._local_pin_lock")
+        # resubmittable bodies of adopted local leases (tid_bin ->
+        # journal-shaped record), retained IN MEMORY regardless of the
+        # journal knob: the node-death reconciler needs them to retry
+        # a dead node's orphaned leases under their original return
+        # oids, and the default config journals nothing. Dropped when
+        # the lease resolves (same lifetime as the arg pins above).
+        self._local_lease_records: Dict[bytes, dict] = {}
+        # COMPLETED local leases' records, kept for lineage
+        # reconstruction (their returns may be the sole copy in the
+        # producing node's arena, and no head-side TaskSpec exists to
+        # re-run them) — see release_local_lease_pins(keep_lineage=True)
+        self._local_lease_lineage: Dict[bytes, dict] = {}
+        # arena names of nodes declared DEAD whose daemon may still
+        # re-dial (partition, not death): their rejoin gets a FENCED
+        # pool so stale outbox replays from the dead era can never
+        # double-resolve work the reconciler already settled
+        self._fenced_arenas: Dict[str, float] = {}
         # resource-view push thread (started with the first remote
         # node; sends only while a two-level knob is on)
         self._resview_thread: Optional[threading.Thread] = None
@@ -900,18 +920,25 @@ class Worker:
         attempt = int(info.get("attempt", 0))
         if h is not None:
             pool.adopt_inflight(h, tid_bin, returns, attempt)
+        record = {
+            "name": info.get("name"),
+            "fn_blob": info.get("fn_blob"),
+            "args_blob": info.get("args_blob"),
+            "num_returns": int(info.get("num_returns", 1)),
+            "returns": returns,
+            "resources": dict(info.get("resources") or {}),
+            "attempt": attempt,
+            "max_retries": int(info.get("max_retries", 0)),
+            "node_index": pool.node_index,
+        }
+        # retained in memory for the node-death reconciler even when
+        # the durable journal is off (the default): a whole-node
+        # SIGKILL must be able to retry this lease under its original
+        # return oids without any WAL to replay
+        with self._local_pin_lock:
+            self._local_lease_records[tid_bin] = record
         if self.gcs.journal_enabled:
-            self.gcs.journal_lease(tid_bin, {
-                "name": info.get("name"),
-                "fn_blob": info.get("fn_blob"),
-                "args_blob": info.get("args_blob"),
-                "num_returns": int(info.get("num_returns", 1)),
-                "returns": returns,
-                "resources": dict(info.get("resources") or {}),
-                "attempt": attempt,
-                "max_retries": int(info.get("max_retries", 0)),
-                "node_index": pool.node_index,
-            })
+            self.gcs.journal_lease(tid_bin, dict(record))
         arg_pin = [ObjectID(b) for b in info.get("arg_refs") or ()]
         if arg_pin:
             # pin the arg objects for the lease's lifetime, mirroring
@@ -955,6 +982,10 @@ class Worker:
                 old.inflight.pop(task_id, None)
         if h is not None:
             pool.adopt_inflight(h, tid_bin, returns, attempt)
+        with self._local_pin_lock:
+            rec = self._local_lease_records.get(tid_bin)
+            if rec is not None:
+                rec["attempt"] = attempt
         if self.gcs.journal_enabled:
             lease = self.gcs.journal_get(tid_bin)
             if lease is not None:
@@ -965,14 +996,45 @@ class Worker:
             tp.record_failed(TaskID(tid_bin),
                              "worker died (local retry %d)" % attempt)
 
-    def release_local_lease_pins(self, tid_bin: bytes) -> None:
-        """Drop the arg-object pins taken at local-lease adoption.
-        No-op for tasks without pinned args (head-path tasks, failover
-        re-attached leases)."""
+    def release_local_lease_pins(self, tid_bin: bytes,
+                                 keep_lineage: bool = False) -> None:
+        """Drop the arg-object pins taken at local-lease adoption,
+        plus the retained resubmittable record (the lease reached a
+        terminal state on every path that calls this). No-op for tasks
+        without pinned args (head-path tasks, failover re-attached
+        leases).
+
+        ``keep_lineage`` (the SUCCESS completion path): the head never
+        built a TaskSpec for a locally-dispatched lease, so the lease
+        record is the ONLY thing that can reconstruct its sole-copy
+        returns after the producing node dies. Migrate it to the
+        bounded lineage-record table instead of dropping it; the
+        recovery manager resubmits through it on loss."""
         with self._local_pin_lock:
             pins = self._local_lease_pins.pop(tid_bin, None)
+            rec = self._local_lease_records.pop(tid_bin, None)
+            if keep_lineage and rec is not None \
+                    and rec.get("fn_blob") is not None \
+                    and int(rec.get("attempt", 0)) \
+                    < int(rec.get("max_retries", 0)):
+                lt = self._local_lease_lineage
+                lt[tid_bin] = rec
+                # count-capped FIFO (records carry real fn/args blobs,
+                # unlike the 256-byte-estimated head-path specs);
+                # evicted entries are simply no longer recoverable
+                while len(lt) > 2048:
+                    lt.pop(next(iter(lt)))
         if pins:
             self.reference_counter.remove_submitted_task_references(pins)
+
+    def take_local_lease_lineage(self, tid_bin: bytes) -> Optional[dict]:
+        """Claim (pop) a completed local lease's lineage record for
+        reconstruction. Popping is the dedup: once the resubmission
+        completes, the rebuilt spec lands in the task manager's normal
+        lineage table (keyed by this same original id), and further
+        losses recover through that path."""
+        with self._local_pin_lock:
+            return self._local_lease_lineage.pop(tid_bin, None)
 
     def on_p2p_done(self, pool, tid_bin: bytes, receipt: dict) -> None:
         """Sequenced completion receipt for a peer-to-peer actor call:
@@ -1924,7 +1986,12 @@ class Worker:
                  or GLOBAL_CONFIG.object_store_memory),
              str(GLOBAL_CONFIG.inline_object_max_bytes),
              info, str(GLOBAL_CONFIG.daemon_rejoin_timeout_s)],
-            env=env, close_fds=True)
+            env=env, close_fds=True,
+            # its own session: the daemon leads a process group holding
+            # its whole worker tree, so machine-death chaos can killpg
+            # the entire "machine" at once (and a SIGINT at the driver
+            # terminal never reaches the simulated remote node)
+            start_new_session=True)
         if not slot_ev.wait(timeout=30.0) or not slot:
             proc.kill()
             raise RuntimeError("node daemon failed to register with the "
@@ -2086,6 +2153,15 @@ class Worker:
         num_cpus = float(info.get("num_cpus", 4.0))
         num_tpus = float(info.get("num_tpus", 0.0))
         resources = dict(info.get("resources") or {})
+        # epoch fence: a daemon whose node this head already DECLARED
+        # DEAD (partition outlived the grace window) comes back as a
+        # fresh node, but nothing from its dead era may resolve — the
+        # node-death reconciler already resubmitted or failed every
+        # adopted lease and restarted its actors elsewhere. The fenced
+        # pool acks-but-drops outbox REPLAY envelopes, no dead-era
+        # lease is re-attached, and the ("fence", epoch) frame below
+        # tells the daemon to clear its dead-era local-lease state.
+        fenced = bool(arena_name) and arena_name in self._fenced_arenas
         node_id = NodeID.from_random()
         state = NodeState((num_cpus, num_tpus, 1e18,
                            sum(resources.values())),
@@ -2093,7 +2169,7 @@ class Worker:
         row = self.scheduler.add_node(state, wake=False)
         pool = RemoteNodePool(self, 0, row, conn, node_id,
                               daemon_proc=None, arena_name=arena_name,
-                              peer_address=peer_address)
+                              peer_address=peer_address, fenced=fenced)
         self._node_pools[row] = pool
         self._has_remote_nodes = True
         adopted_actors = 0
@@ -2103,7 +2179,15 @@ class Worker:
             inflight = winfo.get("inflight") or {}
             h = pool.adopt_worker(int(num), winfo.get("pid"),
                                   is_actor=actor_hex is not None,
-                                  busy=bool(inflight))
+                                  busy=bool(inflight) and not fenced)
+            if fenced:
+                # dead-era state is unwanted: stale in-flight results
+                # find no inflight entry and drop; actor workers are
+                # released (their actors already restarted elsewhere
+                # or went DEAD when the node did)
+                if actor_hex is not None:
+                    pool.release_actor_worker(h, kill=True)
+                continue
             if actor_hex is None:
                 # lease reconciliation: tasks this worker still RUNS
                 # re-attach as synthetic inflight entries under their
@@ -2146,6 +2230,13 @@ class Worker:
                 logger.exception("actor %s re-adoption failed",
                                  actor_id.hex()[:16])
                 pool.release_actor_worker(h, kill=True)
+        if fenced:
+            # the daemon clears its dead-era local-lease/outbox/p2p
+            # bookkeeping so no zombie re-lease or stale fallback ever
+            # resurfaces; the epoch value is an opaque fence token for
+            # the daemon's log
+            pool._send_daemon(("fence", int(time.monotonic() * 1000)))
+            self._fenced_arenas.pop(arena_name, None)
         # plain workers survive with their leases now (the daemon no
         # longer kills mid-task workers at rejoin); still top up to the
         # node's declared worker count so the row never advertises CPUs
@@ -2162,9 +2253,12 @@ class Worker:
         self.gcs.start_health_checks()
         self.scheduler.poke()
         self._ensure_resview_push()
-        logger.info("re-adopted node %s (row %d): %d workers, %d actors, "
-                    "%d in-flight leases", node_id.hex()[:16], row,
-                    len(workers), adopted_actors, adopted_leases)
+        logger.info("re-adopted node %s (row %d)%s: %d workers, "
+                    "%d actors, %d in-flight leases",
+                    node_id.hex()[:16], row,
+                    " FENCED (rejoin after declared dead)" if fenced
+                    else "", len(workers), adopted_actors,
+                    adopted_leases)
         self._start_failover_reconciler()
         return entry
 
@@ -2208,13 +2302,14 @@ class Worker:
                 "head failover: %d journaled leases unclaimed by "
                 "rejoining nodes; %d resubmitted", len(unclaimed), resub)
 
-    def _resubmit_lease(self, tid_bin: bytes, rec: dict) -> bool:
-        """Rebuild a TaskSpec from a journaled lease record and submit
-        it under the ORIGINAL return oids with a bumped attempt token —
-        a stale replay of the dead attempt finds no inflight entry and
-        drops, so the task's side effects run at most once post-restart.
-        Records without a resubmittable body fail their refs instead of
-        hanging the owner's get()."""
+    def _resubmit_lease(self, tid_bin: bytes, rec: dict,
+                        why: str = "head failover") -> bool:
+        """Rebuild a TaskSpec from a retained/journaled lease record
+        and submit it under the ORIGINAL return oids with a bumped
+        attempt token — a stale replay of the dead attempt finds no
+        inflight entry and drops, so the task's side effects run at
+        most once post-recovery. Records without a resubmittable body
+        fail their refs instead of hanging the owner's get()."""
         import cloudpickle
 
         returns = [ObjectID(b) for b in rec.get("returns", [])]
@@ -2227,9 +2322,8 @@ class Worker:
             args, kwargs = cloudpickle.loads(args_blob)
         except Exception as e:
             exc = rex.WorkerCrashedError(
-                f"task {name} was in flight on a node that did not "
-                f"rejoin after head failover, and its journal record "
-                f"cannot be resubmitted ({e})")
+                f"task {name} was in flight on a dead node ({why}), "
+                f"and its lease record cannot be resubmitted ({e})")
             for oid in returns:
                 self.reference_counter.add_owned_object(oid)
                 self.memory_store.put(oid, exc, is_exception=True)
@@ -2255,8 +2349,8 @@ class Worker:
         self.task_manager.add_pending(spec, [])
         self.scheduler.submit(PendingTask(spec=spec, deps=[],
                                           execute=_noop_exec))
-        logger.warning("head failover: resubmitting %s (lease %s, "
-                       "attempt %d)", name, tid_bin.hex()[:16],
+        logger.warning("%s: resubmitting %s (lease %s, attempt %d)",
+                       why, name, tid_bin.hex()[:16],
                        spec.attempt_number)
         return True
 
@@ -2300,13 +2394,104 @@ class Worker:
                 e.value.node_index = new_primary
         # 2) placement groups with bundles on the node reschedule
         self.placement_groups.on_node_dead(entry.index)
-        # 3) fail queued + running work retriably; kill worker processes.
-        #    Monitors drive per-task retries; actor runtimes observe their
-        #    worker's death and restart elsewhere or go DEAD.
+        # 3) two-level plane reconciliation: decide the fate of every
+        #    lease the node's LocalScheduler admitted (retry under the
+        #    original return oids or fail the refs), release their arg
+        #    pins, fence the arena against stale rejoin replays, and
+        #    broadcast route invalidation so peers drop cached p2p
+        #    routes NOW instead of waiting out the lane-sever timeout.
+        self.note_two_level("node_deaths")
         pool = self._node_pools.pop(entry.index, None)
         if pool is not None:
+            if getattr(pool, "is_remote", False):
+                self._reconcile_orphan_leases(pool, reason)
+                arena = getattr(pool, "_arena_name", None)
+                if arena:
+                    self._fenced_arenas[arena] = time.monotonic()
+                self._broadcast_node_death(entry.index, pool)
+            # 4) fail queued + running work retriably; kill worker
+            #    processes. Monitors drive per-task retries; actor
+            #    runtimes observe their worker's death and restart
+            #    elsewhere or go DEAD.
             pool.fail_node(reason or "node removed")
         self.placement_groups.poke()
+
+    def _reconcile_orphan_leases(self, pool, reason: str) -> None:
+        """Node-death half of adopted-lease reconciliation: claim every
+        lease the dead node's LocalScheduler still had in flight and
+        route it through :meth:`reconcile_orphan_lease`. Claiming the
+        inflight entry here (under the pool lock) keeps the per-worker
+        failure sweep from double-handling the same lease when the
+        dead daemon's ``__died__`` notifications race this call."""
+        tids = pool.take_local_tids()
+        retried = 0
+        for tid_bin in sorted(tids):
+            task_id = TaskID(tid_bin)
+            with pool._lock:
+                h = pool._by_task.get(task_id)
+            if h is None:
+                continue
+            inf = pool._take_inflight(h, task_id)
+            if inf is None:
+                continue  # a failure sweep claimed it first
+            err = rex.NodeDiedError(
+                f"node {pool.node_index} died while running a locally "
+                f"dispatched lease: {reason or 'node removed'}")
+            if self.reconcile_orphan_lease(
+                    tid_bin, [o.binary() for o in inf.return_ids], err):
+                retried += 1
+        if tids:
+            logger.warning(
+                "node %d death: %d adopted local leases reconciled "
+                "(%d resubmitted, %d failed)", pool.node_index,
+                len(tids), retried, len(tids) - retried)
+
+    def reconcile_orphan_lease(self, tid_bin: bytes, return_bins,
+                               err: BaseException) -> bool:
+        """An adopted local lease lost its worker (or whole node) with
+        no daemon-side retry in flight. Popping the retained record is
+        the exactly-once arbiter between the node-death reconciler and
+        the per-worker failure sweep: the claimant resubmits the lease
+        head-side under its ORIGINAL return oids when attempts remain,
+        or fails its refs terminally. Arg pins release either way (a
+        dead node can never send the resolution that would have freed
+        them). Returns True when the lease was resubmitted."""
+        with self._local_pin_lock:
+            rec = self._local_lease_records.pop(tid_bin, None)
+        self.release_local_lease_pins(tid_bin)
+        if self.gcs.journal_enabled:
+            if self.gcs.claim_lease(tid_bin) is not None:
+                self.gcs.journal_lease_done(tid_bin)
+        if rec is not None and int(rec.get("attempt", 0)) \
+                < int(rec.get("max_retries", 0)):
+            if self._resubmit_lease(tid_bin, dict(rec),
+                                    why="node death"):
+                self.note_two_level("orphan_retried")
+                return True
+            return False  # _resubmit_lease already failed the refs
+        returns = [ObjectID(b) for b in
+                   ((rec or {}).get("returns") or return_bins or ())]
+        for oid in returns:
+            self.memory_store.put(oid, err, is_exception=True)
+            self.scheduler.notify_object_ready(oid)
+        return False
+
+    def _broadcast_node_death(self, index: int, pool) -> None:
+        """Route invalidation: tell every surviving daemon the node is
+        gone NOW. Peers evict its gossip view, drop cached p2p actor
+        routes to its address, and sweep in-flight lane calls to the
+        head path immediately instead of waiting out the 15s p2p
+        result timeout."""
+        peer = getattr(pool, "peer_address", None)
+        info = {"index": index,
+                "peer": tuple(peer) if peer else None}
+        for p in list(self._node_pools.values()):
+            if p is pool or not getattr(p, "is_remote", False):
+                continue
+            try:
+                p._send_daemon(("node_dead", info))
+            except Exception:
+                pass  # a dying link has nothing to invalidate
 
     def _execute_task(self, pending: PendingTask) -> None:
         spec = pending.spec

@@ -393,8 +393,10 @@ async function refresh() {
         .map(([r, c]) => r + ":" + c).join(" ") || "–",
       resview: n.resview_age_s == null ? "–"
         : n.resview_age_s.toFixed(1) + "s",
+      // node-loss fault domain: why the reconciler declared it dead
+      reason: n.death_reason || "–",
     })), ["node", "state", "kind", "resources", "localq", "dispatched",
-          "spills", "resview"],
+          "spills", "resview", "reason"],
        ["state"]);
     document.getElementById("tasks").innerHTML = rows(
       Object.entries(t).map(([state, count]) => ({state, count})),

@@ -133,6 +133,10 @@ def list_nodes() -> List[Dict[str, Any]]:
             # how long the daemon link has been down; escalates to DEAD
             # once it passes config daemon_rejoin_grace_s
             row["rejoining_for_s"] = round(now - e.rejoining_since, 3)
+        if e.state == "DEAD":
+            # why the node-death reconciler fired (chaos machine-death,
+            # expired rejoin grace, stale heartbeat, ...)
+            row["death_reason"] = getattr(e, "death_reason", "") or ""
         pool = e.pool
         if pool is not None and getattr(pool, "is_remote", False):
             # outbox telemetry (same numbers as the metrics endpoint's

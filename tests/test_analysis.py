@@ -574,6 +574,42 @@ class TestWireProtocol:
         assert any(k.startswith("wire:arity:") and "env" in k
                    and "unpack3" in k for k in keys), keys
 
+    def test_node_death_frame_drift_caught(self, tmp_path):
+        """Node-loss satellite: the death broadcast (("node_dead",
+        info) to every surviving daemon) and the rejoin fence
+        (("fence", epoch)) ride the EXISTING head->daemon channel
+        (_send_daemon -> NodeDaemon.run), so the real table needed no
+        new send/recv entries. This fixture injects the drift that
+        WOULD appear if the halves diverged: a fence whose daemon
+        branch expects a generation field the head never ships, and a
+        death broadcast with no daemon branch at all."""
+        _write(tmp_path, "head.py", """
+            def declare_dead(self, index, peer, epoch):
+                self._send_daemon(("node_dead", {"index": index,
+                                                 "peer": peer}))
+                self._send_daemon(("fence", epoch))
+            """)
+        _write(tmp_path, "daemon.py", """
+            def run_one(msg):
+                kind = msg[0]
+                if kind == "fence":
+                    # expects a generation the head never ships
+                    return msg[2]
+                return None
+            """)
+        channels = [ChannelSpec(name="h2d_death",
+                                sends=[SendSpec("head.py",
+                                                "_send_daemon")],
+                                recvs=[RecvSpec("daemon.py",
+                                                "run_one")])]
+        keys = _keys(wire_protocol.analyze(str(tmp_path), _mk,
+                                           channels=channels,
+                                           op_channels=[]))
+        assert any(k.startswith("wire:arity:") and "fence" in k
+                   for k in keys), keys
+        assert any(k.startswith("wire:sent-unhandled:")
+                   and "node_dead" in k for k in keys), keys
+
     def test_real_channels_have_no_drift(self):
         # satellite (f): remote_pool<->node_daemon (and the other three
         # channels) must agree on tags and arities; the daemon/demux
@@ -710,6 +746,38 @@ class TestRegistry:
         assert "registry:chaos-site-undocumented:secret_site" in keys, keys
         assert "registry:chaos-site-phantom:phantom_site" in keys, keys
         assert not any(k.endswith(":task") for k in keys), keys
+
+    def test_chaos_site_annassign_table_is_collected(self, tmp_path):
+        """The real chaos.py declares ``_SITE_KINDS`` with a type
+        annotation, and an Assign-only AST walk silently skipped it —
+        reading the whole site registry as empty and disabling the
+        README cross-check entirely. Guard the AnnAssign shape: the
+        check must stay ACTIVE (an undocumented site still surfaces)
+        while the documented ``node`` site passes clean."""
+        root, _ = self._fixture(tmp_path)
+        _write(tmp_path, "pkg/_private/chaos.py", """
+            from typing import Dict, Tuple
+
+            _SITE_KINDS: Dict[str, Tuple[str, ...]] = {
+                "task": ("exception", "hang"),
+                "node": ("kill", "restart", "flap"),
+                "secret_site": ("kill",),
+            }
+            """)
+        readme = tmp_path / "README3.md"
+        readme.write_text(
+            "### Chaos engineering\n\n"
+            "Sites: `task` (exception/hang), `node` (kill/restart/"
+            "flap: machine-death SIGKILL of a node's daemon and "
+            "worker tree).\n\n## Next section\n")
+        keys = _keys(registry.analyze(
+            root, _mk, client_relpath="client.py",
+            state_relpath="util/state.py",
+            metrics_relpaths=("_private/metrics.py",),
+            readme_path=str(readme)))
+        assert "registry:chaos-site-undocumented:secret_site" in keys, keys
+        assert not any(k.endswith(":node") or k.endswith(":task")
+                       for k in keys), keys
 
 
 # ---------------------------------------------------------------------------

@@ -349,7 +349,10 @@ class TestKnobsOff:
             assert not GLOBAL_CONFIG.actor_p2p
             assert w.two_level_stats == {"local_dispatch": 0,
                                          "spillback": 0, "p2p": 0,
-                                         "head_fallback": 0}
+                                         "head_fallback": 0,
+                                         "node_deaths": 0,
+                                         "orphan_retried": 0,
+                                         "orphan_fenced": 0}
             lines = metrics_mod._render_core(w)
             for fam in ("ray_tpu_sched_local_dispatch_total",
                         "ray_tpu_sched_spillback_total",
