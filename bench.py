@@ -192,6 +192,33 @@ def _head_bypass_subprocess(p2p, n_calls: int,
         f"head_bypass child produced no result: {out.stderr[-2000:]}")
 
 
+_QOS_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from ray_tpu._private import perf
+r = perf.qos_ab({qos}, n_per_tenant={n_per_tenant}, n_submit={n_submit})
+print("QOS_JSON:" + json.dumps(r))
+"""
+
+
+def _qos_subprocess(qos: bool, n_per_tenant: int,
+                    n_submit: int) -> dict:
+    """One QoS A/B arm in a fresh interpreter (the cluster spawns node
+    daemons; a clean process keeps the arms independent)."""
+    env = spawn_env.child_env()
+    code = _QOS_CHILD.format(repo=REPO, qos=qos,
+                             n_per_tenant=n_per_tenant,
+                             n_submit=n_submit)
+    timeout = max(60.0, min(300.0, _remaining() - 10.0))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    for line in out.stdout.splitlines():
+        if line.startswith("QOS_JSON:"):
+            return json.loads(line[len("QOS_JSON:"):])
+    raise RuntimeError(
+        f"qos child produced no result: {out.stderr[-2000:]}")
+
+
 _FAILOVER_CHILD = """
 import json, os, re, signal, subprocess, sys, time
 sys.path.insert(0, {repo!r})
@@ -895,6 +922,48 @@ def main() -> int:
         except Exception:
             traceback.print_exc()
         OUT["head_bypass"] = hb or None
+        _emit()
+
+    # --- QoS plane: tiers + fair-share vs the escape hatch --------------
+    # Mixed two-tenant load (tier-1 "prod" at weight 3, tier-0 "batch"
+    # at weight 1) with a concurrent node-side nested-submit lane. ON
+    # drains by strict tier + weighted fair-share and ships the resview
+    # watermark; OFF (qos=False) is the byte-for-byte escape hatch.
+    # Claims under test: tier-1 p50 drops under the plane (the A/B is
+    # the point: OFF has no tiers so the batch class drains first),
+    # head-skip stays high (tier spills are the only new decline
+    # reason), both arms produce equal results, and the escape hatch
+    # costs nothing — the OFF arm's total wall-clock is never slower
+    # than the ON arm's (15% noise margin).
+    if section("qos", 65):
+        qs = {}
+        n_per_tenant, n_submit = (10, 6) if smoke else (30, 16)
+        try:
+            on = _qos_subprocess(True, n_per_tenant, n_submit)
+            off = _qos_subprocess(False, n_per_tenant, n_submit)
+            qs["on"] = on
+            qs["off"] = off
+            qs["equal_results"] = (on["total"] == off["total"]
+                                   and on["n_submit"] == off["n_submit"])
+            qs["tier1_p50_speedup"] = round(
+                off["tier1_p50_ms"] / max(on["tier1_p50_ms"], 1e-9), 2)
+            qs["tier1_p99_speedup"] = round(
+                off["tier1_p99_ms"] / max(on["tier1_p99_ms"], 1e-9), 2)
+            # the escape-hatch guard: qos=False pays no overall tax
+            qs["off_never_slower"] = bool(
+                off["seconds"] <= on["seconds"] * 1.15)
+            print(f"  qos: tier-1 p50 {on['tier1_p50_ms']}ms / p99 "
+                  f"{on['tier1_p99_ms']}ms with the plane vs "
+                  f"{off['tier1_p50_ms']}ms / {off['tier1_p99_ms']}ms "
+                  f"off ({qs['tier1_p50_speedup']}x p50); tier-0 p50 "
+                  f"{on['tier0_p50_ms']}ms vs {off['tier0_p50_ms']}ms; "
+                  f"head_skip {on['head_skip']} on ({on['spillback_tier']}"
+                  f" tier-spills) vs {off['head_skip']} off; off arm "
+                  f"never slower overall: {qs['off_never_slower']}",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+        OUT["qos"] = qs or None
         _emit()
 
     # --- model perf: step time / tokens/s / MFU ------------------------

@@ -185,6 +185,71 @@ DEFAULT_CHANNELS: List[ChannelSpec] = [
 
 
 @dataclass
+class FrameVarSpec:
+    """One function that builds or reads a dict-shaped frame through a
+    variable: ``var = {...literal...}`` / ``var["k"] = v`` on the
+    producer side, ``var.get("k")`` / ``var["k"]`` on the consumer
+    side. ``var`` matches a local name (``view``) or an attribute's
+    terminal name (``_resview`` matches ``self._resview``)."""
+    file: str
+    func: str            # "Class.method" or "func"
+    var: str             # local name or attribute terminal name
+
+
+@dataclass
+class FrameFieldSpec:
+    """Dict-shaped frame riding an already-checked channel. The
+    tag+arity pass above sees ``("rview", view)`` as a healthy 2-tuple
+    no matter what keys ``view`` carries, so field drift — a consumer
+    reading a key no producer writes (silently None forever), or a
+    producer shipping a key nothing reads (dead payload) — needs its
+    own producer/consumer table."""
+    name: str
+    producers: Sequence[FrameVarSpec]
+    consumers: Sequence[FrameVarSpec]
+    #: keys consumers may read that no modeled producer writes
+    #: (injected in transit by relays the table does not model)
+    assume_produced: Set[str] = field(default_factory=set)
+    #: keys producers write that no modeled consumer reads
+    assume_read: Set[str] = field(default_factory=set)
+
+
+#: dict-shaped frame field tables (file paths relative to ray_tpu/)
+DEFAULT_FRAME_FIELDS: List[FrameFieldSpec] = [
+    FrameFieldSpec(
+        # the head's resource-view push (head_to_daemon "resview"
+        # frames) and the daemons' peer gossip re-share of the same
+        # dict (peer_actor_lane "rview" frames). The "wm" row is the
+        # QoS top-spilled-tier watermark: written only when the plane
+        # is on (qos=False frames stay byte-for-byte pre-QoS), read by
+        # local admission so a low-tier nested task cannot locally
+        # dispatch past a spilled high-tier one.
+        name="resview",
+        producers=[
+            FrameVarSpec("_private/worker.py",
+                         "Worker._resview_push_loop", "view"),
+            # gossip re-shares the head's dict verbatim plus an origin
+            # stamp for ghost-view eviction
+            FrameVarSpec("_private/runtime/node_daemon.py",
+                         "NodeDaemon._gossip_loop", "view"),
+        ],
+        consumers=[
+            FrameVarSpec("_private/runtime/node_daemon.py",
+                         "NodeDaemon._apply_resview", "view"),
+            FrameVarSpec("_private/runtime/node_daemon.py",
+                         "NodeDaemon._maybe_local_submit", "view"),
+            FrameVarSpec("_private/runtime/node_daemon.py",
+                         "NodeDaemon._gossip_loop", "view"),
+            # p2p caller side reads the node index off the stored view
+            # through the attribute receiver (self._resview)
+            FrameVarSpec("_private/runtime/node_daemon.py",
+                         "NodeDaemon._maybe_p2p_call", "_resview"),
+        ],
+    ),
+]
+
+
+@dataclass
 class OpChannelSpec:
     """ray:// op-mode channel: ``_rpc("op", *payload)`` client calls
     against ``_op_<name>(self, session, *payload)`` server methods."""
@@ -435,6 +500,93 @@ def check_channel(channel: ChannelSpec, root: str,
     return findings
 
 
+def _frame_var_matches(node: ast.AST, var: str) -> bool:
+    return ((isinstance(node, ast.Name) and node.id == var)
+            or (isinstance(node, ast.Attribute) and node.attr == var))
+
+
+def collect_fields_produced(tree: ast.Module,
+                            spec: FrameVarSpec) -> Set[str]:
+    """String keys the function writes into its frame var: dict-literal
+    assignments (``var = {"k": ...}``) and key stores
+    (``var["k"] = ...``)."""
+    out: Set[str] = set()
+    for fn in find_function(tree, spec.func):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if (_frame_var_matches(tgt, spec.var)
+                        and isinstance(node.value, ast.Dict)):
+                    out.update(k.value for k in node.value.keys
+                               if isinstance(k, ast.Constant)
+                               and isinstance(k.value, str))
+                if (isinstance(tgt, ast.Subscript)
+                        and _frame_var_matches(tgt.value, spec.var)
+                        and isinstance(tgt.slice, ast.Constant)
+                        and isinstance(tgt.slice.value, str)):
+                    out.add(tgt.slice.value)
+    return out
+
+
+def collect_fields_read(tree: ast.Module,
+                        spec: FrameVarSpec) -> Dict[str, int]:
+    """key -> first line where the function reads it from the frame
+    var, via ``var.get("k")`` or a ``var["k"]`` load."""
+    out: Dict[str, int] = {}
+    for fn in find_function(tree, spec.func):
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and _frame_var_matches(node.func.value, spec.var)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                out.setdefault(node.args[0].value, node.lineno)
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and _frame_var_matches(node.value, spec.var)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                out.setdefault(node.slice.value, node.lineno)
+    return out
+
+
+def check_frame_fields(table: FrameFieldSpec, root: str,
+                       make_finding) -> List:
+    import os
+    findings = []
+    produced: Set[str] = set()
+    for spec in table.producers:
+        tree = parse_file(os.path.normpath(os.path.join(root, spec.file)))
+        if tree is None:
+            continue
+        produced |= collect_fields_produced(tree, spec)
+    read: Dict[str, int] = {}
+    read_file = table.consumers[0].file if table.consumers else ""
+    for spec in table.consumers:
+        tree = parse_file(os.path.normpath(os.path.join(root, spec.file)))
+        if tree is None:
+            continue
+        for key, line in collect_fields_read(tree, spec).items():
+            read.setdefault(key, line)
+    if not produced or not read:
+        return findings  # a moved/renamed function: nothing to compare
+    for key in sorted(set(read) - produced - table.assume_produced):
+        findings.append(make_finding(
+            f"{PASS}:field-unproduced:{table.name}:{key}",
+            f"[{table.name}] consumers read frame field {key!r} but no "
+            f"producer writes it (silently None forever)", read_file,
+            read[key]))
+    for key in sorted(produced - set(read) - table.assume_read):
+        findings.append(make_finding(
+            f"{PASS}:field-unread:{table.name}:{key}",
+            f"[{table.name}] producers ship frame field {key!r} but no "
+            f"consumer reads it (dead payload)", read_file, 0))
+    return findings
+
+
 def check_op_channel(channel: OpChannelSpec, root: str,
                      make_finding) -> List:
     import os
@@ -500,7 +652,8 @@ def check_op_channel(channel: OpChannelSpec, root: str,
 
 def analyze(root: str, make_finding,
             channels: Optional[Sequence[ChannelSpec]] = None,
-            op_channels: Optional[Sequence[OpChannelSpec]] = None
+            op_channels: Optional[Sequence[OpChannelSpec]] = None,
+            frame_fields: Optional[Sequence[FrameFieldSpec]] = None
             ) -> List:
     findings = []
     for ch in (DEFAULT_CHANNELS if channels is None else channels):
@@ -508,4 +661,7 @@ def analyze(root: str, make_finding,
     for och in (DEFAULT_OP_CHANNELS if op_channels is None
                 else op_channels):
         findings.extend(check_op_channel(och, root, make_finding))
+    for ff in (DEFAULT_FRAME_FIELDS if frame_fields is None
+               else frame_fields):
+        findings.extend(check_frame_fields(ff, root, make_finding))
     return findings

@@ -61,6 +61,7 @@ _STATE_VERBS = frozenset({
     "list_placement_groups", "summarize_tasks", "list_data_streams",
     "list_faults", "list_logs", "get_log", "task_timeline",
     "list_traces", "get_trace", "profile_stacks", "list_utilization",
+    "list_tenants",
 })
 
 
@@ -225,6 +226,8 @@ class ClientServer:
             placement_group_capture_child_tasks=d.get("pg_capture", False),
             runtime_env=d.get("runtime_env"),
             generator=d.get("generator", False),
+            priority=int(d.get("priority") or 0),
+            tenant=d.get("tenant") or "default",
         )
         with self._traced("submit"):
             refs = self._worker.submit_task(spec)
@@ -724,6 +727,12 @@ class ClientWorker:
             d["pg_id"] = spec.placement_group_id.binary()
             d["pg_bundle_index"] = spec.placement_group_bundle_index
             d["pg_capture"] = spec.placement_group_capture_child_tasks
+        # QoS tier/tenant ride only when non-default (qos=False blobs
+        # stay byte-for-byte pre-QoS)
+        if getattr(spec, "priority", 0):
+            d["priority"] = spec.priority
+        if getattr(spec, "tenant", "default") != "default":
+            d["tenant"] = spec.tenant
         return_bins = self._rpc("submit", cloudpickle.dumps(d))
         return [ObjectRef(ObjectID(b), None) for b in return_bins]
 

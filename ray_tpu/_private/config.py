@@ -260,6 +260,30 @@ _d("actor_p2p", bool, True,
    "head path with the same attempt token (retries stay exactly-"
    "once). Off = every actor call routes through the head, byte-for-"
    "byte pre-p2p behavior")
+_d("qos", bool, False,
+   "multi-tenant QoS plane: submissions carry a tenant + priority tier "
+   "(@remote(priority=...) / .options(priority=..., tenant=...)); the "
+   "head's ready queues become weighted fair-share per tenant (deficit "
+   "round-robin on the tenant_quotas weights) with strict priority "
+   "tiers on top, a starved higher-tier task preempts the lowest-tier "
+   "running victim after preempt_grace_s (the kill rides the worker-"
+   "death retry path: bumped attempt, journaled lease, exactly-once — "
+   "never a double execution), and resview frames carry a per-node "
+   "top-spilled-tier watermark so a daemon never locally admits below "
+   "a tier the head is still holding for that node. Off = no tenancy "
+   "anywhere, byte-for-byte pre-QoS frames and lease envelopes")
+_d("tenant_quotas", str, "",
+   "JSON object mapping tenant name -> fair-share weight, e.g. "
+   "'{\"prod\": 3, \"batch\": 1}'; unlisted tenants (including the "
+   "implicit \"default\" tenant) get weight 1. Weights divide capacity "
+   "inside a priority tier only — tiers stay strict. Empty = every "
+   "tenant weight 1 (pure round-robin fair share)")
+_d("preempt_grace_s", float, 1.0,
+   "how long a higher-tier task may sit queued with zero running "
+   "tasks of its tier before the QoS plane kills the lowest-tier "
+   "running victim to make room; the victim retries with a bumped "
+   "attempt (granted an extra system retry if it had none left). "
+   "0 preempts on the first monitor tick; requires qos")
 _d("resview_gossip_s", float, 1.0,
    "period of daemon-to-daemon resource-view gossip over the peer "
    "lanes: each daemon re-shares the freshest (highest-version) view "

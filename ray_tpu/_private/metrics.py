@@ -169,9 +169,10 @@ class Histogram(_Metric):
 
 # fixed spill-reason label set: one per LocalScheduler admission check
 # (see node_daemon._maybe_local_submit) plus "other" for daemons
-# predating per-reason reporting
-SPILL_REASONS = ("queue_full", "pg", "resources", "refs", "no_slot",
-                 "other")
+# predating per-reason reporting. "tier" is the QoS watermark check:
+# the submission's priority sat below the head's top-spilled-tier.
+SPILL_REASONS = ("queue_full", "tier", "pg", "resources", "refs",
+                 "no_slot", "other")
 
 
 def _render_core(worker) -> List[str]:
@@ -268,6 +269,50 @@ def _render_core(worker) -> List[str]:
     emit("ray_tpu_actor_calls_head_fallback_total", "counter",
          "p2p actor calls re-routed through the head path after a "
          "peer-lane drop/sever/timeout", tl.get("head_fallback", 0))
+
+    # QoS plane (config.qos): preemptions by victim tier, per-tenant
+    # queue/run gauges, and the fair-share deficit. Schema-stable
+    # zeros when the plane is off: the bare totals always render, and
+    # labeled series appear per tier/tenant the plane has actually
+    # seen (no tenants exist while it is off).
+    plane = getattr(worker, "qos_plane", None)
+    qstats = plane.stats() if plane is not None else {}
+    lines.append("# HELP ray_tpu_sched_preemptions_total running "
+                 "tasks killed by the QoS plane to unblock a starved "
+                 "higher tier, by victim tier (synthetic worker "
+                 "death: the victim retries, exactly-once)")
+    lines.append("# TYPE ray_tpu_sched_preemptions_total counter")
+    lines.append(f"ray_tpu_sched_preemptions_total "
+                 f"{qstats.get('preemptions_total', 0)}")
+    for tier, n in sorted((qstats.get("preempts_by_tier") or {}).items()):
+        lines.append(
+            f'ray_tpu_sched_preemptions_total{{tier="{tier}"}} {n}')
+    tenants = qstats.get("tenants") or {}
+    lines.append("# HELP ray_tpu_tenant_queued_tasks tasks queued at "
+                 "the head per QoS tenant")
+    lines.append("# TYPE ray_tpu_tenant_queued_tasks gauge")
+    lines.append(f"ray_tpu_tenant_queued_tasks "
+                 f"{sum(t['queued'] for t in tenants.values())}")
+    for name in sorted(tenants):
+        lines.append(f'ray_tpu_tenant_queued_tasks{{tenant="{name}"}} '
+                     f"{tenants[name]['queued']}")
+    lines.append("# HELP ray_tpu_tenant_running_tasks dispatched "
+                 "(running or leased) tasks per QoS tenant")
+    lines.append("# TYPE ray_tpu_tenant_running_tasks gauge")
+    lines.append(f"ray_tpu_tenant_running_tasks "
+                 f"{sum(t['running'] for t in tenants.values())}")
+    for name in sorted(tenants):
+        lines.append(f'ray_tpu_tenant_running_tasks{{tenant="{name}"}} '
+                     f"{tenants[name]['running']}")
+    lines.append("# HELP ray_tpu_fairshare_deficit per-tenant "
+                 "weighted fair-share deficit in dispatches (positive "
+                 "= underserved relative to the tenant_quotas weight "
+                 "share of everything dispatched so far)")
+    lines.append("# TYPE ray_tpu_fairshare_deficit gauge")
+    lines.append("ray_tpu_fairshare_deficit 0")
+    for name in sorted(tenants):
+        lines.append(f'ray_tpu_fairshare_deficit{{tenant="{name}"}} '
+                     f"{tenants[name]['deficit']}")
 
     # task event plane: latency-breakdown histograms + failure counters
     from ray_tpu._private import task_events

@@ -36,13 +36,16 @@ VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
 class _Entry:
     __slots__ = ("pg_id", "bundles", "strategy", "name", "state", "rows",
-                 "ready_oid", "demands", "customs")
+                 "ready_oid", "demands", "customs", "priority")
 
-    def __init__(self, pg_id, bundles, strategy, name):
+    def __init__(self, pg_id, bundles, strategy, name, priority=0):
         self.pg_id = pg_id
         self.bundles = bundles
         self.strategy = strategy
         self.name = name
+        # QoS tier (config.qos / gang-aware autoscaler): higher-tier
+        # pending gangs take freed or newly provisioned capacity first
+        self.priority = int(priority)
         self.state = "PENDING"
         self.rows: List[int] = []
         self.ready_oid = ObjectID.from_random()
@@ -63,10 +66,14 @@ class PlacementGroupManager:
         self._retry_wake = threading.Event()
         self._retry_thread: Optional[threading.Thread] = None
         self._shutdown = False
+        # set by the gang-aware autoscaler: groups infeasible under the
+        # cluster's FULL current capacity park in the pending queue
+        # (scale-up demand) instead of failing permanently
+        self.hold_infeasible = False
 
     # -- API ----------------------------------------------------------------
     def create(self, bundles: List[Dict[str, float]], strategy: str,
-               name: str) -> _Entry:
+               name: str, priority: int = 0) -> _Entry:
         if strategy not in VALID_STRATEGIES:
             raise ValueError(f"strategy must be one of {VALID_STRATEGIES}, "
                              f"got {strategy!r}")
@@ -77,7 +84,7 @@ class PlacementGroupManager:
                 raise ValueError(f"invalid bundle {b!r}")
         entry = _Entry(PlacementGroupID.from_random(), [dict(b) for b in
                                                         bundles],
-                       strategy, name)
+                       strategy, name, priority=priority)
         with self._lock:
             self._table[entry.pg_id] = entry
         if not self._try_place(entry):
@@ -132,6 +139,7 @@ class PlacementGroupManager:
                     "name": e.name, "strategy": e.strategy,
                     "state": e.state, "bundles": list(e.bundles),
                     "bundle_rows": list(e.rows),
+                    "priority": e.priority,
                 }
                 for e in self._table.values()
             }
@@ -142,6 +150,18 @@ class PlacementGroupManager:
             if not self._pending:
                 return
         self._retry_wake.set()
+
+    def pending_gangs(self) -> List[Dict[str, Any]]:
+        """Snapshot of unplaced groups for the gang-aware autoscaler:
+        demand matrices + QoS tier, in submission order (the kernel
+        applies the tier permutation itself)."""
+        with self._lock:
+            entries = [self._table[p] for p in self._pending
+                       if self._table[p].state == "PENDING"]
+            return [{"pg_id": e.pg_id, "name": e.name,
+                     "priority": e.priority, "demands": e.demands,
+                     "strategy": e.strategy}
+                    for e in entries]
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -191,7 +211,7 @@ class PlacementGroupManager:
         feasible = cap.shape[0] > 0 and kernels.pack_bundles_np(
             entry.demands, cap, cap, entry.strategy,
             eligible=self._eligibility(entry, rows)) is not None
-        if not feasible:
+        if not feasible and not self.hold_infeasible:
             with self._lock:
                 entry.state = "INFEASIBLE"
             self._worker.memory_store.put(
@@ -264,6 +284,11 @@ class PlacementGroupManager:
                 return
             with self._lock:
                 pending = [self._table[p] for p in self._pending]
+            # strict QoS tiers, FIFO within: a freed slice goes to the
+            # highest-tier pending gang first (stable sort preserves
+            # submission order inside a tier — same discipline as
+            # kernels.pack_gangs_tiered_np)
+            pending.sort(key=lambda e: -e.priority)
             for entry in pending:
                 if entry.state != "PENDING":
                     with self._lock:

@@ -1418,6 +1418,7 @@ class NodeDaemon:
             accept = bool(view.get("accept"))
             cap = int(view.get("cap") or 0)
             job_bin = view.get("job")
+            watermark = view.get("wm")
             depth = len(self._local_tids)
         if not accept or job_bin is None:
             return fwd
@@ -1432,6 +1433,14 @@ class NodeDaemon:
             d = cloudpickle.loads(args[0])
         except Exception:
             return fwd
+        if watermark is not None \
+                and int(d.get("priority") or 0) < int(watermark):
+            # QoS top-spilled-tier watermark (config.qos): work at a
+            # higher tier is still queued at the head, so locally
+            # admitting this lower-tier task would let it jump the
+            # line — spill upward and let the head's fair-share order
+            # decide (the plane off pushes no "wm" key at all)
+            return spill("tier")
         res = d.get("resources") or {}
         if d.get("pg_id") is not None:      # placement is the head's
             return spill("pg")

@@ -126,7 +126,7 @@ class _Handle:
     __slots__ = ("worker_num", "proc", "conn", "ctrl", "worker_id", "pid",
                  "inflight", "borrows",
                  "sent_fns", "sent_hdrs", "dead", "force_cancel_id",
-                 "timeout_cancel_id",
+                 "timeout_cancel_id", "preempt_cancel_id",
                  "chaos_kill", "send_lock",
                  "ready", "actor_rt", "oom_kill", "log_paths",
                  "ring_in", "ring_out", "ring_region")
@@ -159,6 +159,10 @@ class _Handle:
         # deadline enforcement killed this worker for this task: the
         # target fails with TaskTimeoutError (retriable), not cancelled
         self.timeout_cancel_id: Optional[TaskID] = None
+        # QoS preemption killed this worker for this task: the target
+        # fails as a synthetic worker death (retriable WorkerCrashedError
+        # carrying the preemption message), never cancelled
+        self.preempt_cancel_id: Optional[TaskID] = None
         self.chaos_kill = False       # chaos plane SIGKILLed this worker
         self.send_lock = threading.Lock()
         self.ready = False
@@ -1381,6 +1385,13 @@ class ProcessWorkerPool:
                         f"task {spec.name} exceeded its {spec.timeout_s}s "
                         f"deadline (worker {h.pid} killed)",
                         task_id=exec_id, timeout_s=spec.timeout_s)
+                elif h.preempt_cancel_id == exec_id:
+                    # synthetic worker death: retriable, so the victim
+                    # re-queues with a bumped attempt under its original
+                    # return ids — the QoS preemption contract
+                    exc = rex.WorkerCrashedError(
+                        f"task {spec.name} preempted by higher-tier work "
+                        f"(worker {h.pid} killed); attempt will retry")
                 elif h.oom_kill:
                     exc = rex.OutOfMemoryError(
                         f"worker killed by the memory monitor while "
@@ -1548,6 +1559,8 @@ class ProcessWorkerPool:
                                 if d.get("pg_id") is not None else None),
             placement_group_bundle_index=d.get("pg_bundle_index", -1),
             placement_group_capture_child_tasks=d.get("pg_capture", False),
+            priority=int(d.get("priority") or 0),
+            tenant=d.get("tenant") or "default",
         )
         # the submitting task's trace context rides the RPC blob: the
         # nested submission becomes its child via the ambient parent
@@ -1652,6 +1665,38 @@ class ProcessWorkerPool:
         if h is None:
             return False
         h.timeout_cancel_id = task_id
+        self._kill_handle(h)
+        return True
+
+    def cancel_for_preemption(self, task_id: TaskID) -> bool:
+        """QoS preemption (config.qos): fail the attempt as a synthetic
+        worker death — cancel_for_timeout's shape with a retriable
+        WorkerCrashedError classification, so the victim re-queues with
+        a bumped attempt under its original return ids and the
+        journaled-lease dedup guarantees exactly-once effects."""
+        with self._lock:
+            for item in self._queue:
+                if item[0].spec.task_id == task_id:
+                    self._queue.remove(item)
+                    queued = item[0]
+                    break
+            else:
+                queued = None
+        if queued is not None:
+            spec = queued.spec
+            return_ids = (getattr(spec, "_retry_return_ids", None)
+                          or spec.return_ids())
+            err = rex.WorkerCrashedError(
+                f"task {spec.name} preempted by higher-tier work while "
+                f"queued on node {self.node_index}; attempt will retry")
+            retry = self._worker._handle_task_failure(spec, return_ids, err)
+            self._finish_task(queued, task_id, retry)
+            return True
+        with self._lock:
+            h = self._by_task.get(task_id)
+        if h is None:
+            return False
+        h.preempt_cancel_id = task_id
         self._kill_handle(h)
         return True
 

@@ -262,6 +262,14 @@ def _dump_spec(spec, trace=None, mark_refs=False) -> bytes:
         d["pg_id"] = spec.placement_group_id.binary()
         d["pg_bundle_index"] = spec.placement_group_bundle_index
         d["pg_capture"] = spec.placement_group_capture_child_tasks
+    # QoS tier/tenant ride only when non-default, so qos=False (where
+    # they are always default) keeps the submit blob byte-for-byte
+    priority = getattr(spec, "priority", 0)
+    if priority:
+        d["priority"] = priority
+    tenant = getattr(spec, "tenant", "default")
+    if tenant != "default":
+        d["tenant"] = tenant
     return cloudpickle.dumps(d)
 
 

@@ -46,6 +46,13 @@ class SchedulerBase:
     _num_local_dispatch = 0
     _num_spillback = 0
 
+    # Optional QosPlane the worker attaches after construction when the
+    # qos knob is on: drains consult plane.order() so ready work
+    # dispatches strict-tier-first with weighted fair-share between
+    # tenants inside a tier. None (the class default) keeps the FIFO
+    # drain order byte-for-byte pre-QoS.
+    qos_plane = None
+
     def note_local_dispatch(self) -> None:
         """A node's LocalScheduler admitted a worker-submitted task
         without this (head) scheduler ever seeing it."""

@@ -352,6 +352,84 @@ def jax_pack_many(demands, avail, cap, *, strict_spread: bool):
     return pack(demands, avail, cap)
 
 
+def pack_gangs_tiered_np(demands: np.ndarray, tiers: np.ndarray,
+                         avail: np.ndarray, cap: np.ndarray,
+                         spread: Optional[np.ndarray] = None
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Tier-aware batched gang pack (QoS plane / gang-aware autoscaler).
+
+    Like the sequential core of :func:`jax_pack_many` but gangs are
+    admitted in strict priority-tier order — higher ``tiers[g]`` first,
+    FIFO (submission index) within a tier — so a low-tier gang can never
+    reserve capacity ahead of a higher-tier one that also fits.  Each
+    gang of B bundles (demands [G,B,R]) is all-or-nothing against the
+    shared node state [N,R]: the reservation commits only if every
+    bundle found a node, otherwise the gang's trial consumption rolls
+    back entirely (no partial placement is ever visible).
+
+    ``spread`` [G] bool marks gangs whose non-empty bundles must land
+    on DISTINCT nodes (STRICT_SPREAD); zero-demand padding rows are
+    exempt so callers may pad ragged gang sizes freely.
+
+    Returns (node_of [G,B] with -1 for unplaced, ok [G], final avail)
+    in the ORIGINAL gang order regardless of tier permutation.
+    """
+    G, B, R = demands.shape
+    N = avail.shape[0]
+    alive = cap.any(axis=1)
+    rem = avail.copy()
+    node_of = np.full((G, B), -1, dtype=np.int32)
+    ok = np.zeros(G, dtype=bool)
+    # strict tiers with FIFO inside: stable sort on descending tier
+    order = np.argsort(-np.asarray(tiers, dtype=np.int64), kind="stable")
+    for g in order:
+        trial = rem.copy()
+        placed = np.full(B, -1, dtype=np.int32)
+        used = np.zeros(N, dtype=bool)
+        distinct = bool(spread[g]) if spread is not None else False
+        good = True
+        for b in range(B):
+            d = demands[g, b]
+            real = bool((d > 0).any())
+            fits = alive & (trial >= d[None, :]).all(axis=1)
+            if distinct and real:
+                fits &= ~used
+            n = int(np.argmax(fits))
+            if not fits.any():
+                good = False
+                break
+            trial[n] -= d
+            if real:
+                used[n] = True
+            placed[b] = n
+        if good:
+            rem = trial
+            node_of[g] = placed
+            ok[g] = True
+    return node_of, ok, rem
+
+
+def jax_pack_many_tiered(demands, tiers, avail, cap, *,
+                         strict_spread: bool):
+    """Tier-aware :func:`jax_pack_many`: permute the gang axis into
+    strict-tier order (higher first, FIFO within — stable argsort on
+    the host, same discipline as :func:`pack_gangs_tiered_np`), run the
+    batched on-device pack, then un-permute so callers see results in
+    submission order. The scan itself is tier-oblivious; ordering IS
+    the policy, exactly like priority drains in the tensor scheduler.
+    """
+    import numpy as _np
+
+    order = _np.argsort(-_np.asarray(tiers, dtype=_np.int64),
+                        kind="stable")
+    inv = _np.empty_like(order)
+    inv[order] = _np.arange(order.shape[0])
+    node_of, ok, avail = jax_pack_many(
+        _np.asarray(demands)[order], avail, cap,
+        strict_spread=strict_spread)
+    return _np.asarray(node_of)[inv], _np.asarray(ok)[inv], avail
+
+
 # ======================================================================
 # jax backend
 # ======================================================================

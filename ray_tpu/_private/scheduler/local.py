@@ -464,6 +464,14 @@ class EventScheduler(SchedulerBase):
         locality_on = (GLOBAL_CONFIG.scheduler_locality
                        and self.locations_of is not None)
         spill_depth = GLOBAL_CONFIG.locality_spillback_queue_depth
+        plane = self.qos_plane
+        if plane is not None and len(self._ready) > 1:
+            # QoS drain order: strict tiers first, weighted fair-share
+            # between tenants inside a tier, FIFO within a tenant
+            tasks = list(self._ready)
+            order = plane.order([(t.spec.priority, t.spec.tenant)
+                                 for t in tasks])
+            self._ready = collections.deque(tasks[i] for i in order)
         deferred: List[PendingTask] = []
         while self._ready:
             task = self._ready.popleft()

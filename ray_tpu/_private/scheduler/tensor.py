@@ -683,6 +683,25 @@ class TensorScheduler(SchedulerBase):
         ready_idx = np.flatnonzero((self._state == WAITING) & (self._indeg <= 0))
         if len(ready_idx) == 0:
             return None
+        plane = self.qos_plane
+        tiers = None
+        if plane is not None and len(ready_idx) > 0:
+            # QoS assignment order: permuting ready_idx dispatches strict
+            # tiers first with weighted fair-share between tenants inside
+            # a tier (slot order, i.e. FIFO, within a tenant). The greedy
+            # kernel honors array order WITHIN a scheduling class but
+            # drains classes as groups, so ``tiers`` (priority per ready
+            # position, descending) rides along: _assign chunks the batch
+            # into per-tier runs so a lower tier never jumps a higher one
+            # just because its class was registered first.
+            tasks = self._tasks
+            keys = []
+            for slot in ready_idx:
+                spec = tasks[int(slot)].spec
+                keys.append((spec.priority, spec.tenant))
+            order = plane.order(keys)
+            ready_idx = ready_idx[np.asarray(order, dtype=np.int64)]
+            tiers = np.asarray([keys[i][0] for i in order], dtype=np.int64)
         if self._mask_dirty:
             self._rebuild_masks_locked()
         locality = None
@@ -695,7 +714,7 @@ class TensorScheduler(SchedulerBase):
         return (ready_idx, self._cls[ready_idx].copy(), self._demands.copy(),
                 self._avail.copy(), self._cap.copy(),
                 self._class_mask.copy(), self._class_spread.copy(),
-                locality, outstanding)
+                locality, outstanding, tiers)
 
     def _locality_matrix_locked(self, ready_idx) -> Optional[np.ndarray]:
         """[len(ready_idx), N] resident-arg-bytes per candidate node,
@@ -800,7 +819,26 @@ class TensorScheduler(SchedulerBase):
         """Batched assignment OUTSIDE the lock (jit compilation of the jax
         path can take seconds and must not block submit()/notify_*)."""
         (ready_idx, ready_cls, demands, avail, cap, class_mask,
-         class_spread, locality, outstanding) = snapshot
+         class_spread, locality, outstanding, tiers) = snapshot
+        if tiers is not None and len(ready_idx) > 1 and tiers[0] != tiers[-1]:
+            # QoS tier barrier: the kernels drain each scheduling class as
+            # a group, which would let a lower-tier class registered first
+            # absorb capacity ahead of a higher tier. Split the (already
+            # tier-descending) batch into contiguous per-tier runs and
+            # assign them in order, threading avail, so strict-tier order
+            # holds ACROSS classes too. A handful of tiers per tick keeps
+            # this cheap; qos=False never reaches here (tiers is None).
+            bounds = np.flatnonzero(np.diff(tiers)) + 1
+            node_parts = []
+            cur_avail = avail
+            for s, e in zip(np.r_[0, bounds], np.r_[bounds, len(ready_idx)]):
+                sub = (ready_idx[int(s):int(e)], ready_cls[int(s):int(e)],
+                       demands, cur_avail, cap, class_mask, class_spread,
+                       locality[int(s):int(e)] if locality is not None
+                       else None, outstanding, None)
+                _, sub_nodes, cur_avail = self._assign(sub)
+                node_parts.append(sub_nodes)
+            return ready_idx, np.concatenate(node_parts), cur_avail
         backend = GLOBAL_CONFIG.sched_backend
         # class count no longer gates the device path: the kernel scans the
         # class axis (class as data), so many classes don't grow the program

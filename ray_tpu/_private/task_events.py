@@ -57,6 +57,7 @@ RETRIED = 12    # failed attempt that was retried (not terminal)
 STAGED = 13     # dispatch-time arg staging kicked off (None = no staging)
 TCTX = 14       # trace plane context 4-tuple (trace_id, span_id,
                 # parent_span_id, sampled) | None when unsampled
+TIER = 15       # QoS priority tier (0 when the plane is off)
 
 _LIVE, _FINISHED, _FAILED = "LIVE", "FINISHED", "FAILED"
 
@@ -132,7 +133,7 @@ class TaskEventAggregator:
     def _new_rec(self, task_id: Any, name: str, attempt: int,
                  now: float) -> list:
         return [task_id, name, attempt, -1, None, None,
-                now, None, None, None, None, _LIVE, False, None, None]
+                now, None, None, None, None, _LIVE, False, None, None, 0]
 
     def record_submitted_batch(self, specs: Iterable[Any]) -> None:
         now = time.time()
@@ -142,6 +143,7 @@ class TaskEventAggregator:
                 rec = self._new_rec(
                     s.task_id, s.name, s.attempt_number, now)
                 rec[TCTX] = getattr(s, "trace_ctx", None)
+                rec[TIER] = getattr(s, "priority", 0) or 0
                 live[s.task_id] = rec
             if len(live) > self._live_cap:
                 self._trim_live_locked()
@@ -274,6 +276,7 @@ class TaskEventAggregator:
             # retry mutates the spec in place, so the new attempt
             # carries the SAME logical trace context as the failed one
             new_rec[TCTX] = getattr(spec, "trace_ctx", None)
+            new_rec[TIER] = getattr(spec, "priority", 0) or 0
             self._live[spec.task_id] = new_rec
 
     # ------------------------------------------------------------------
@@ -482,6 +485,7 @@ def _row(rec: list) -> Dict[str, Any]:
         "state": rec[STATE],
         "node_index": rec[NODE],
         "scheduling_class": -1,
+        "tier": rec[TIER] if len(rec) > TIER else 0,
     }
     out.update(_detail(rec))
     return out

@@ -106,6 +106,13 @@ class TaskSpec:
     # place. NOT part of scheduling_class() for the same reason as
     # arg_sizes.
     trace_ctx: Any = None
+    # QoS plane (config.qos): strict priority tier (higher preempts
+    # lower) and owning tenant for weighted fair-share. Queue-ordering
+    # inputs only — NOT part of scheduling_class(), so tasks differing
+    # only in tier/tenant still share leases, and both default to the
+    # pre-QoS values so qos=False envelopes stay byte-for-byte.
+    priority: int = 0
+    tenant: str = "default"
 
     def return_ids(self) -> List[ObjectID]:
         memo = self._rid_memo

@@ -125,6 +125,9 @@ class TaskManager:
             rr = getattr(spec, "_retry_return_ids", None)
             origin = rr[0].task_id() if rr else old_id
             self._pending_origin[origin] = spec.task_id
+        plane = self._worker.qos_plane
+        if plane is not None:
+            plane.note_rekeyed(old_id, spec.task_id)
 
     def pending_spec_for_object(self, oid: ObjectID) -> Optional[TaskSpec]:
         """The in-flight spec that will produce oid, or None if its
@@ -157,11 +160,14 @@ class TaskManager:
         this lineage insert, stranding the spec in ``_lineage``
         forever. Checking liveness under the table lock closes that
         window (a concurrent eviction blocks on this same lock)."""
+        plane = self._worker.qos_plane
         with self._lock:
             for task_id, oid in pairs:
                 entry = self._pending.pop(task_id, None)
                 if entry is None:
                     continue
+                if plane is not None:
+                    plane.note_done(task_id)
                 spec, _ = entry
                 rr = getattr(spec, "_retry_return_ids", None)
                 key = rr[0].task_id() if rr else task_id
@@ -177,6 +183,9 @@ class TaskManager:
     def _complete_locked(self, task_id: TaskID) -> None:
         entry = self._pending.pop(task_id, None)
         if entry is not None:
+            plane = self._worker.qos_plane
+            if plane is not None:
+                plane.note_done(task_id)
             spec, _ = entry
             # retain lineage for reconstruction while returns in
             # scope — keyed by the id the RETURN ids derive from, so
@@ -254,9 +263,16 @@ class _Dispatcher:
         self._worker = worker
 
     def __call__(self, pending) -> None:
+        plane = self._worker.qos_plane
+        if plane is not None:
+            plane.note_dispatched(pending.spec.task_id)
         self._worker._dispatch(pending)
 
     def dispatch_many(self, pendings) -> None:
+        plane = self._worker.qos_plane
+        if plane is not None:
+            for pending in pendings:
+                plane.note_dispatched(pending.spec.task_id)
         self._worker._dispatch_many(pendings)
 
 
@@ -373,6 +389,9 @@ class Worker:
         # by the first daemon rejoin after a journaled head restart)
         self._failover_reconciler_started = False
         self.reference_counter = ReferenceCounter(self._on_object_out_of_scope)
+        # declared before the task manager / scheduler exist: both read
+        # it on their hot paths (None = QoS plane off)
+        self.qos_plane = None
         self.task_manager = TaskManager(self)
 
         nworkers = num_workers or GLOBAL_CONFIG.num_workers or os.cpu_count() or 4
@@ -638,6 +657,23 @@ class Worker:
         self._deadline_heap: List[tuple] = []
         self._deadline_seq = _Counter()
         self._deadline_thread: Optional[threading.Thread] = None
+
+        # QoS plane (config.qos, declared early in __init__): tenant
+        # fair-share ordering at the head, starvation-triggered
+        # preemption, and the top-spilled-tier watermark on resview
+        # frames. Stays None when the knob is off — every QoS hook is a
+        # `plane is not None` check, so the off state stays
+        # byte-for-byte pre-QoS.
+        self._qos_thread: Optional[threading.Thread] = None
+        if GLOBAL_CONFIG.qos:
+            from ray_tpu._private.qos import QosPlane
+            self.qos_plane = QosPlane(
+                tenant_quotas=GLOBAL_CONFIG.tenant_quotas,
+                preempt_grace_s=GLOBAL_CONFIG.preempt_grace_s)
+            self.scheduler.qos_plane = self.qos_plane
+            self._qos_thread = threading.Thread(
+                target=self._qos_loop, daemon=True, name="ray_tpu_qos")
+            self._qos_thread.start()
 
         # deferred unref queue: ObjectRef.__del__ may fire during GC while
         # runtime locks are held, so deletions drain on a dedicated thread
@@ -1205,9 +1241,18 @@ class Worker:
                              and getattr(e.pool, "is_remote", False)]
                     addrs = {p.node_index: getattr(p, "peer_address", None)
                              for p in pools}
+                    # per-node top-spilled-tier watermark (config.qos):
+                    # the highest priority tier still queued at the
+                    # head — daemons must not locally admit below it
+                    # (a low-tier nested task would jump a spilled
+                    # high-tier one). The key is absent entirely when
+                    # the plane is off: qos=False frames stay
+                    # byte-for-byte pre-QoS.
+                    wm = (self.qos_plane.top_queued_tier()
+                          if self.qos_plane is not None else None)
                     for p in pools:
                         try:
-                            p.send_resview({
+                            view = {
                                 "accept": bool(GLOBAL_CONFIG.local_dispatch),
                                 "p2p": bool(GLOBAL_CONFIG.actor_p2p),
                                 "cap": int(GLOBAL_CONFIG.local_queue_depth),
@@ -1221,7 +1266,10 @@ class Worker:
                                           and a is not None],
                                 "resident": self._residency_digest(
                                     p.node_index),
-                            })
+                            }
+                            if self.qos_plane is not None:
+                                view["wm"] = wm
+                            p.send_resview(view)
                         except Exception:
                             pass  # a dying link re-syncs after rejoin
             except Exception:
@@ -1417,6 +1465,9 @@ class Worker:
             self.reference_counter.add_submitted_task_references(deps)
             self._stamp_arg_sizes(spec, deps)
         self.task_manager.add_pending(spec, deps)
+        if self.qos_plane is not None:
+            self.qos_plane.note_queued(spec.task_id, spec.tenant,
+                                       spec.priority)
         self.events.record(spec.task_id, spec.name, "submitted",
                            attempt=spec.attempt_number)
         # trace stamping runs BEFORE the task-event record so the
@@ -1468,6 +1519,10 @@ class Worker:
             all_deps.extend(deps)
         self.reference_counter.register_submit_batch(owned, all_deps)
         self.task_manager.add_pending_batch(specs)
+        if self.qos_plane is not None:
+            for spec in specs:
+                self.qos_plane.note_queued(spec.task_id, spec.tenant,
+                                           spec.priority)
         self.events.record_batch(((s.task_id, s.name) for s in specs),
                                  "submitted")
         # trace stamping BEFORE the task-event records (detail rows
@@ -2981,6 +3036,75 @@ class Worker:
             retry = self._handle_task_failure(spec, return_ids, err)
             if retry is not None:
                 self._submit_retry(retry)
+
+    # ------------------------------------------------------------------
+    # Supervision: QoS preemption (config.qos)
+    # ------------------------------------------------------------------
+    def _qos_loop(self) -> None:
+        """Preemption monitor: once the plane reports a starved higher
+        tier (past preempt_grace_s), kill the lowest-tier running
+        victim through the same paths the deadline watcher uses — the
+        failure is a synthetic worker death, so the victim retries with
+        a bumped attempt under its original return ids (journaled
+        lease, exactly-once), never a double execution."""
+        while self.alive:
+            time.sleep(0.05)
+            plane = self.qos_plane
+            if plane is None or not self.alive:
+                continue
+            victim = plane.check_preempt(time.monotonic())
+            if victim is None:
+                continue
+            tid, tenant, tier, starved_tier = victim
+            try:
+                if self._preempt_task(tid, tier, starved_tier):
+                    plane.note_preempted(tenant, tier)
+                    self.note_two_level("preempts")
+            except Exception:
+                logger.exception("preemption failed for task %s",
+                                 tid.hex()[:16])
+
+    def _preempt_task(self, tid: TaskID, tier: int,
+                      starved_tier: int) -> bool:
+        """Kill one running attempt to make room for a starved higher
+        tier. Returns True when a kill was delivered (the retry is
+        owned by whichever failure path runs it)."""
+        spec = self.task_manager.get_pending_spec(tid)
+        if spec is None or spec.task_id != tid:
+            return False  # attempt resolved (or retried) under the wire
+        # the preemption contract: a victim is re-queued, never
+        # terminally failed — grant the synthetic death an attempt if
+        # the victim had none left
+        if spec.attempt_number >= spec.max_retries:
+            spec.max_retries = spec.attempt_number + 1
+        err = rex.WorkerCrashedError(
+            f"task {spec.name} preempted by tier-{starved_tier} work "
+            f"(was running at tier {tier}); attempt will retry")
+        return_ids = (getattr(spec, "_retry_return_ids", None)
+                      or spec.return_ids())
+        # (a) leased to a process/remote pool: force-kill the attempt
+        #     there — the pool failure path classifies it retriable
+        pools = list(self._node_pools.values())
+        if self.process_pool is not None and self.process_pool not in pools:
+            pools.append(self.process_pool)
+        for pool in pools:
+            c = getattr(pool, "cancel_for_preemption", None)
+            if c is not None and c(tid):
+                return True
+        # (b) thread mode: flag the attempt as supervisor-failed (the
+        #     cooperative zombie's results are suppressed, exactly like
+        #     a deadline kill) and synthesize the worker death
+        synthesize = False
+        with self._running_lock:
+            if self._running_tasks.get(tid) is False:
+                self._running_tasks[tid] = "timeout"
+                synthesize = True
+        if synthesize:
+            retry = self._handle_task_failure(spec, return_ids, err)
+            if retry is not None:
+                self._submit_retry(retry)
+            return True
+        return False
 
     # ------------------------------------------------------------------
     # Lifecycle

@@ -103,6 +103,10 @@ id="fg-link">download flamegraph (speedscope json)</a></div>
 <div class="panel"><h2>Task summary</h2><div id="tasks"></div></div>
 <div class="panel"><h2>Recent tasks (dep-wait &middot; queue &middot; exec)</h2>
 <div id="taskdetail"></div></div>
+<div class="panel"><h2>Tenants</h2>
+<div class="sub">QoS plane fair-share state per tenant (empty when the
+plane is off, qos=False)</div>
+<div id="tenants"></div></div>
 <div class="panel"><h2>Traces</h2><div id="traces"></div></div>
 <div class="panel"><h2>Actors</h2><div id="actors"></div></div>
 <div class="panel"><h2>Data streams</h2><div id="streams"></div></div>
@@ -112,6 +116,7 @@ id="fg-link">download flamegraph (speedscope json)</a></div>
 <a href="/api/summary">summary</a><a href="/api/tasks">tasks</a>
 <a href="/api/actors">actors</a><a href="/api/objects">objects</a>
 <a href="/api/nodes">nodes</a><a href="/api/placement_groups">pgs</a>
+<a href="/api/tenants">tenants</a>
 <a href="/api/data_streams">streams</a>
 <a href="/api/task_events">task_events</a>
 <a href="/api/timeline">timeline</a>
@@ -171,7 +176,7 @@ function taskDetailRows(list) {
   const done = (list || []).filter(r => r.end_at)
     .sort((a, b) => (b.end_at || 0) - (a.end_at || 0)).slice(0, 25);
   if (!done.length) { return '<div class="sub">none yet</div>'; }
-  const head = ["task", "state", "node", "attempt", "dep-wait",
+  const head = ["task", "state", "node", "attempt", "tier", "dep-wait",
                 "queue", "exec", "breakdown", "error"]
     .map(c => `<th>${c}</th>`).join("");
   const body = done.map(r => {
@@ -188,6 +193,7 @@ function taskDetailRows(list) {
       `<td class="st-${cls}">${esc(r.state)}</td>` +
       `<td>${Number(r.node_index)}</td>` +
       `<td>${Number(r.attempt) || 0}</td>` +
+      `<td>${Number(r.tier) || 0}</td>` +
       `<td>${fmtS(d)}</td><td>${fmtS(q)}</td><td>${fmtS(ex)}</td>` +
       `<td>${bar}</td><td>${esc(r.error_type || "")}</td></tr>`;
   }).join("");
@@ -321,12 +327,13 @@ async function viewLog(f) {
 
 async function refresh() {
   try {
-    const [s, actors, taskEvents, traces, util] = await Promise.all([
+    const [s, actors, taskEvents, traces, util, tenants] = await Promise.all([
       fetch("/api/summary").then(r => r.json()),
       fetch("/api/actors").then(r => r.json()),
       fetch("/api/task_events").then(r => r.json()).catch(() => []),
       fetch("/api/traces").then(r => r.json()).catch(() => []),
       fetch("/api/utilization").then(r => r.json()).catch(() => []),
+      fetch("/api/tenants").then(r => r.json()).catch(() => []),
     ]);
     refreshLogs().catch(() => {});
     const nodes = s.nodes || [];
@@ -380,6 +387,17 @@ async function refresh() {
     }
     document.getElementById("taskdetail").innerHTML =
       taskDetailRows(taskEvents);
+    // QoS plane: weighted fair-share state per tenant; deficit > 0
+    // means the tenant is running behind its share
+    document.getElementById("tenants").innerHTML = rows(
+      (tenants || []).map(tn => ({
+        tenant: tn.tenant, weight: tn.weight,
+        share: (100 * (tn.share || 0)).toFixed(0) + "%",
+        deficit: Number(tn.deficit || 0).toFixed(1),
+        served: tn.served ?? 0, queued: tn.queued ?? 0,
+        running: tn.running ?? 0, preempted: tn.preempted ?? 0,
+      })), ["tenant", "weight", "share", "deficit", "served",
+            "queued", "running", "preempted"]);
     document.getElementById("nodes").innerHTML = rows(nodes.map(n => ({
       node: (n.node_id || "").slice(0, 12), state: n.state || "ALIVE",
       kind: n.kind || "", resources: JSON.stringify(n.resources || {}),
@@ -498,6 +516,9 @@ class Dashboard:
             "/api/nodes": lambda: state.list_nodes(),
             "/api/placement_groups":
                 lambda: state.list_placement_groups(),
+            # QoS plane: per-tenant fair-share/deficit rows (the
+            # Tenants panel source); empty when qos=False
+            "/api/tenants": lambda: state.list_tenants(),
             "/api/data_streams": lambda: state.list_data_streams(),
             "/api/logs": lambda: state.list_logs(),
             # profile plane: per-node utilization series + folded

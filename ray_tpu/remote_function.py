@@ -29,6 +29,11 @@ _DEFAULT_OPTIONS = dict(
     placement_group=None,
     placement_group_bundle_index=-1,
     runtime_env=None,
+    # QoS plane (config.qos): strict priority tier (higher wins; may
+    # preempt) and owning tenant for weighted fair-share. Inert when
+    # the plane is off.
+    priority=0,
+    tenant=None,
 )
 
 
@@ -174,6 +179,8 @@ class RemoteFunction:
             serialized_func=self._fn_blob,
             func_id=self._fn_id,
             class_key=self._class_key,
+            priority=opts["priority"],
+            tenant=opts["tenant"] or "default",
         ) for a in args_list]
         return [refs[0] for refs in worker.submit_task_batch(specs)]
 
@@ -199,6 +206,8 @@ class RemoteFunction:
                     serialized_func=self._fn_blob,
                     func_id=self._fn_id,
                     class_key=self._class_key,
+                    priority=opts["priority"],
+                    tenant=opts["tenant"] or "default",
                 )
                 refs = worker.submit_task(spec)
                 return refs[0] if num_returns == 1 else refs
@@ -270,6 +279,8 @@ class RemoteFunction:
             # the precomputed key only describes the no-group case; an
             # inherited/explicit placement group changes the class
             class_key=self._class_key if pg_id is None else None,
+            priority=opts["priority"],
+            tenant=opts["tenant"] or "default",
         )
         refs = worker.submit_task(spec)
         return refs[0] if spec.num_returns == 1 else refs

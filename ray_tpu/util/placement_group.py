@@ -75,12 +75,17 @@ def _manager():
 
 def placement_group(bundles: List[Dict[str, float]],
                     strategy: str = "PACK",
-                    name: str = "") -> PlacementGroup:
+                    name: str = "",
+                    priority: int = 0) -> PlacementGroup:
     """Reserve resource bundles across the cluster.
 
     strategy: PACK | SPREAD | STRICT_PACK | STRICT_SPREAD (reference
-    semantics: STRICT_* fail rather than degrade)."""
-    entry = _manager().create(bundles, strategy, name)
+    semantics: STRICT_* fail rather than degrade).
+
+    priority: QoS tier of the gang — while the group is pending, freed
+    or autoscaled capacity goes to higher tiers first (FIFO within a
+    tier). Inert at the default 0."""
+    entry = _manager().create(bundles, strategy, name, priority=priority)
     return PlacementGroup(entry.pg_id, list(entry.bundles))
 
 

@@ -184,6 +184,22 @@ def list_placement_groups() -> List[Dict[str, Any]]:
 
 
 @_client_dispatch
+def list_tenants() -> List[Dict[str, Any]]:
+    """QoS plane tenants (config.qos), one row per tenant seen this
+    session: fair-share weight and share, served/queued/running/
+    preempted counts, and the deficit (positive = underserved relative
+    to the tenant's weight share of all dispatches so far). Empty when
+    the plane is off."""
+    w = worker_mod.get_worker()
+    plane = getattr(w, "qos_plane", None)
+    if plane is None:
+        return []
+    stats = plane.stats()
+    return [dict(info, tenant=name)
+            for name, info in sorted(stats["tenants"].items())]
+
+
+@_client_dispatch
 def list_data_streams() -> List[Dict[str, Any]]:
     """Streaming-split ingest stats: one row per live
     Dataset.streaming_split coordinator plus the last few shut-down

@@ -23,6 +23,8 @@ from ray_tpu._private.analysis import (blocking_calls, closure_capture,
                                        runtime_sanitizer, shared_state,
                                        wire_protocol)
 from ray_tpu._private.analysis.wire_protocol import (ChannelSpec,
+                                                     FrameFieldSpec,
+                                                     FrameVarSpec,
                                                      OpChannelSpec,
                                                      RecvSpec, SendSpec)
 
@@ -609,6 +611,67 @@ class TestWireProtocol:
                    for k in keys), keys
         assert any(k.startswith("wire:sent-unhandled:")
                    and "node_dead" in k for k in keys), keys
+
+    def test_resview_watermark_field_drift_caught(self, tmp_path):
+        """QoS satellite: the top-spilled-tier watermark rides the
+        resview push/gossip frames as a dict FIELD ("wm"), invisible
+        to the tag+arity check — ("resview", view) stays a healthy
+        2-tuple whatever keys the dict carries. The frame-field table
+        compares producer dict keys against consumer reads. This
+        fixture injects both drift directions: the daemon reads a
+        watermark key the head stopped shipping (admission would
+        silently never spill on tier again), and the head ships a
+        deadline key nothing reads (dead payload)."""
+        _write(tmp_path, "head.py", """
+            def push_loop(self):
+                for p in self.pools():
+                    view = {"accept": True, "cap": 8, "job": b"j",
+                            "deadline": 0.5}
+                    if self.qos_plane is not None:
+                        view["watermark"] = self.qos_plane.top()
+                    p.send_resview(view)
+            """)
+        _write(tmp_path, "daemon.py", """
+            def admit(self, view, d):
+                # reads the RENAMED key the producer no longer writes
+                wm = view.get("wm")
+                if wm is not None and d.get("priority", 0) < wm:
+                    return "spill"
+                if not view.get("accept") or view.get("cap") is None:
+                    return "spill"
+                return view["job"]
+            """)
+        tables = [FrameFieldSpec(
+            name="resview_fixture",
+            producers=[FrameVarSpec("head.py", "push_loop", "view")],
+            consumers=[FrameVarSpec("daemon.py", "admit", "view")])]
+        keys = _keys(wire_protocol.analyze(str(tmp_path), _mk,
+                                           channels=[], op_channels=[],
+                                           frame_fields=tables))
+        assert "wire:field-unproduced:resview_fixture:wm" in keys, keys
+        assert ("wire:field-unread:resview_fixture:deadline"
+                in keys), keys
+        assert ("wire:field-unread:resview_fixture:watermark"
+                in keys), keys
+        # the healthy rows (accept/cap/job) raise nothing
+        assert not any(k.endswith(":accept") or k.endswith(":cap")
+                       or k.endswith(":job") for k in keys), keys
+        # fix the drift (consumer reads the shipped names) -> clean
+        _write(tmp_path, "daemon.py", """
+            def admit(self, view, d):
+                wm = view.get("watermark")
+                if wm is not None and d.get("priority", 0) < wm:
+                    return "spill"
+                if not view.get("accept") or view.get("cap") is None:
+                    return "spill"
+                if view.get("deadline"):
+                    return "spill"
+                return view["job"]
+            """)
+        keys = _keys(wire_protocol.analyze(str(tmp_path), _mk,
+                                           channels=[], op_channels=[],
+                                           frame_fields=tables))
+        assert keys == [], keys
 
     def test_real_channels_have_no_drift(self):
         # satellite (f): remote_pool<->node_daemon (and the other three
