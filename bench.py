@@ -219,6 +219,34 @@ def _qos_subprocess(qos: bool, n_per_tenant: int,
         f"qos child produced no result: {out.stderr[-2000:]}")
 
 
+_SERVING_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from ray_tpu._private import perf
+r = perf.serving_ab({disagg}, sessions={sessions}, turns={turns})
+print("SERVING_JSON:" + json.dumps(r))
+"""
+
+
+def _serving_subprocess(disagg: bool, sessions: int, turns: int) -> dict:
+    """One serving A/B arm in a fresh interpreter (each arm deploys
+    its own serve controller + engines; a clean process keeps the
+    arms' compile caches and actor planes independent)."""
+    env = spawn_env.child_env()
+    env["JAX_PLATFORMS"] = "cpu"  # the serving A/B is a routing
+    #                               benchmark, not a kernel benchmark
+    code = _SERVING_CHILD.format(repo=REPO, disagg=disagg,
+                                 sessions=sessions, turns=turns)
+    timeout = max(60.0, min(300.0, _remaining() - 10.0))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    for line in out.stdout.splitlines():
+        if line.startswith("SERVING_JSON:"):
+            return json.loads(line[len("SERVING_JSON:"):])
+    raise RuntimeError(
+        f"serving child produced no result: {out.stderr[-2000:]}")
+
+
 _FAILOVER_CHILD = """
 import json, os, re, signal, subprocess, sys, time
 sys.path.insert(0, {repo!r})
@@ -1008,6 +1036,41 @@ def main() -> int:
         except Exception:
             traceback.print_exc()
             OUT["llm_decode"] = None
+        _emit()
+
+    # --- serving at traffic scale: disaggregation A/B ------------------
+    # mono (2 LLM replicas, prefill shares each replica's continuous
+    # batch) vs split (1 prefill + 1 decode replica) under a sustained
+    # concurrent-streams load with follow-up turns. Claims under test:
+    # the split arm's p95 TTFT beats mono under saturation (a new
+    # prompt's first token streams off the prefill handoff instead of
+    # queueing behind whole decodes), and follow-up turns route back
+    # to the KV-holding decode replica (affinity hit rate). CPU-host
+    # caveat rides in the record: both arms share one host's cores,
+    # so TTFT ordering is the honest signal, not tokens/s.
+    if section("serving", 60):
+        sv = {}
+        sessions, turns = (4, 2) if smoke else (8, 2)
+        try:
+            mono = _serving_subprocess(False, sessions, turns)
+            split = _serving_subprocess(True, sessions, turns)
+            sv["mono"] = mono
+            sv["split"] = split
+            sv["equal_tokens"] = (mono["total_tokens"]
+                                  == split["total_tokens"])
+            sv["ttft_p95_speedup"] = round(
+                mono["ttft_p95_ms"] / max(split["ttft_p95_ms"], 1e-9), 2)
+            sv["affinity_hit_rate"] = split["affinity_hit_rate"]
+            print(f"  serving: split p95 TTFT {split['ttft_p95_ms']}ms "
+                  f"vs {mono['ttft_p95_ms']}ms mono "
+                  f"({sv['ttft_p95_speedup']}x); "
+                  f"{split['tokens_per_sec_per_replica']} tok/s/replica "
+                  f"split vs {mono['tokens_per_sec_per_replica']} mono; "
+                  f"affinity hit rate {split['affinity_hit_rate']}",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+        OUT["serving"] = sv or None
         _emit()
 
     # decode slot sweep (32/128 beyond the 64 above) — opportunistic:

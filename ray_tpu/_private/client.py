@@ -61,7 +61,7 @@ _STATE_VERBS = frozenset({
     "list_placement_groups", "summarize_tasks", "list_data_streams",
     "list_faults", "list_logs", "get_log", "task_timeline",
     "list_traces", "get_trace", "profile_stacks", "list_utilization",
-    "list_tenants",
+    "list_tenants", "list_serve_deployments",
 })
 
 

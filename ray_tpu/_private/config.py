@@ -394,6 +394,20 @@ _d("profile_stacks_max", int, 20000,
    "evicted (counted in ray_tpu_profile_samples_dropped_total's "
    "sibling summary)")
 
+# -- serving at scale ------------------------------------------------------
+_d("serve_slo_ttft_p95_s", float, 0.0,
+   "SLO-aware admission target: when > 0 and the recent p95 "
+   "time-to-first-token exceeds it while streams are in flight, new "
+   "streams are shed at ingress (503 / AdmissionShedError) instead of "
+   "timing out mid-stream; 0 disables shedding")
+_d("serve_ttft_window", int, 256,
+   "TTFT samples kept in the sliding window that admission and the "
+   "ttft-mode pool autoscaler read their quantiles from")
+_d("serve_kv_cache_sessions", int, 16,
+   "per-decode-replica LRU bound on cached session KV handoffs "
+   "(cache-affinity routing: a follow-up turn that re-sends the same "
+   "prompt replays from this cache with zero prefill work)")
+
 # -- testing / fault injection --------------------------------------------
 _d("testing_inject_task_failure_prob", float, 0.0,
    "probability a task raises a simulated worker failure (chaos testing)")

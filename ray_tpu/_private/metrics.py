@@ -165,6 +165,68 @@ class Histogram(_Metric):
         return out
 
 
+def _render_serve() -> List[str]:
+    """Serving-plane families (ray_tpu.serve.core._ServeMetrics).
+
+    Looked up through sys.modules rather than imported: pulling in the
+    serve package from a metrics scrape would be a heavy side effect,
+    and most clusters never serve. When serve was never imported the
+    families still render as schema-stable zeros — dashboards and
+    alert rules keyed on these names see the full set either way.
+    ray_tpu_serve_ttft_seconds is a prometheus histogram: bucket
+    counts in _ServeMetrics are already cumulative per boundary, and
+    le="+Inf" equals the observation count.
+    """
+    import sys
+
+    core = sys.modules.get("ray_tpu.serve.core")
+    if core is not None:
+        snap = core.metrics.snapshot()
+        bounds = core._TTFT_BUCKETS
+    else:
+        snap = {}
+        bounds = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                  5.0, 10.0)
+    buckets = snap.get("ttft_buckets") or [0] * len(bounds)
+    count = snap.get("ttft_count", 0)
+    lines = [
+        "# HELP ray_tpu_serve_ttft_seconds time-to-first-token of "
+        "serving streams (first non-empty frame, includes prefill + "
+        "KV handoff on the disaggregated path)",
+        "# TYPE ray_tpu_serve_ttft_seconds histogram",
+    ]
+    for b, c in zip(bounds, buckets):
+        lines.append(f'ray_tpu_serve_ttft_seconds_bucket{{le="{b}"}} {c}')
+    lines.append(
+        f'ray_tpu_serve_ttft_seconds_bucket{{le="+Inf"}} {count}')
+    lines.append(f"ray_tpu_serve_ttft_seconds_sum "
+                 f"{snap.get('ttft_sum', 0.0)}")
+    lines.append(f"ray_tpu_serve_ttft_seconds_count {count}")
+
+    def emit(name, desc, value):
+        lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {value}")
+
+    emit("ray_tpu_serve_affinity_hit_total",
+         "follow-up turns routed to the decode replica already "
+         "holding the session's KV pages",
+         snap.get("affinity_hit", 0))
+    emit("ray_tpu_serve_affinity_miss_total",
+         "follow-up turns whose KV-holding replica was gone "
+         "(re-prefill or directory promotion)",
+         snap.get("affinity_miss", 0))
+    emit("ray_tpu_serve_admission_shed_total",
+         "streams shed at ingress by the SLO admission gate "
+         "(recent p95 TTFT over serve_slo_ttft_p95_s)",
+         snap.get("admission_shed", 0))
+    emit("ray_tpu_kv_pages_transferred_bytes_total",
+         "KV-cache bytes handed from prefill to decode replicas "
+         "through the object plane",
+         snap.get("kv_bytes", 0))
+    return lines
+
+
 # -- the endpoint -------------------------------------------------------
 
 # fixed spill-reason label set: one per LocalScheduler admission check
@@ -435,6 +497,8 @@ def _render_core(worker) -> List[str]:
             lines.append(f'{name}{{node="{node}"}} {v}')
             total += v
         lines.append(f"{name} {round(total, 2)}")
+
+    lines.extend(_render_serve())
 
     from ray_tpu._private.chaos import get_controller
     chaos = get_controller().counters()

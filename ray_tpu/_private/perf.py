@@ -1032,3 +1032,145 @@ def llm_decode_throughput(smoke: bool = False,
         "n_params": int(n_params),
         "seconds": dt,
     }
+
+
+def serving_ab(disagg: bool, sessions: int = 8, turns: int = 2,
+               max_new: int = 48) -> Dict[str, Any]:
+    """One arm of the serving-plane A/B: mono (N LLMDeployment
+    replicas, prefill and decode share each replica's continuous
+    batch) vs disaggregated (1 prefill + 1 decode replica — the same
+    TWO replicas of hardware) under a mixed interactive load:
+    ``sessions`` concurrent sessions, each streaming ``turns`` turns
+    of ``max_new`` tokens, follow-up turns reusing the session id so
+    the disaggregated arm exercises cache-affinity routing.
+
+    Engine batches are deliberately SMALLER than the offered load
+    (batch_size=2 per engine, sessions > total slots): in the mono
+    arm a new prompt's first token waits for a continuous-batch slot
+    behind whole ongoing decodes, while the disaggregated arm streams
+    the first token straight off the prefill handoff — the TTFT
+    contrast under saturation is exactly what the split buys.
+
+    TTFT is measured CLIENT-side (first non-empty frame) so both arms
+    are scored by the same clock. CPU-host caveat: both arms share
+    one host's cores, so tokens/s differences are scheduling effects,
+    not accelerator effects; the TTFT ordering is the honest signal.
+
+    Returns {mode, sessions, turns, max_new, replicas, ttft_p50_ms/
+    p95/p99, tokens_per_sec, tokens_per_sec_per_replica, total_tokens,
+    seconds, affinity_hit_rate, kv_bytes, sheds}."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models.inference import InferenceConfig
+    from ray_tpu.models.transformer import Transformer, TransformerConfig
+    from ray_tpu.serve import core
+    from ray_tpu.serve.llm import build_llm_app, run_disagg_llm
+
+    mcfg = TransformerConfig(vocab_size=128, d_model=32, n_layers=2,
+                             n_heads=2, n_kv_heads=2, d_ff=64,
+                             max_seq_len=128)
+    icfg = InferenceConfig(batch_size=2, page_size=4,
+                           max_pages_per_seq=16, num_pages=64,
+                           prefill_buckets=(16,),
+                           max_new_tokens=max_new, decode_chunk=1)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    replicas = 2  # both arms: two engine-hosting replicas
+    ray_tpu.init(num_workers=2)
+    try:
+        if disagg:
+            handle = run_disagg_llm(params, mcfg, icfg,
+                                    prefill_replicas=1,
+                                    decode_replicas=1)
+
+            def frames(prompt, session):
+                return handle.stream_frames(prompt, max_new,
+                                            session_id=session)
+        else:
+            h = serve.run(build_llm_app(params, mcfg, icfg,
+                                        num_replicas=replicas))
+            st = h._state()
+
+            def frames(prompt, session):
+                return core._sticky_stream_frames(st, prompt, max_new,
+                                                  start_timeout=300.0)
+
+        # warm the compile caches with the run's own shapes (prefill
+        # bucket, decode chunk, KV import) before the timed window;
+        # one concurrent stream per replica reaches both mono engines
+        warm = [threading.Thread(
+            target=lambda i=i: [None for _ in frames([i + 1] * 4, None)])
+            for i in range(replicas)]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join()
+        core.metrics.reset()
+
+        results: list = []
+        lock = threading.Lock()
+
+        def run_session(i: int) -> None:
+            session = f"bench-s{i}"
+            prompt = [(i * 7 + j) % 100 + 1 for j in range(6)]
+            for _turn in range(turns):
+                t0 = time.perf_counter()
+                ttft = None
+                n = 0
+                for fr in frames(prompt, session):
+                    toks = fr.get("tokens") or ()
+                    if toks and ttft is None:
+                        ttft = time.perf_counter() - t0
+                    n += len(toks)
+                with lock:
+                    results.append((ttft, n))
+
+        threads = [threading.Thread(target=run_session, args=(i,),
+                                    daemon=True)
+                   for i in range(sessions)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+
+        ttfts = sorted(t for t, _ in results if t is not None)
+
+        def _pct(q: float) -> Optional[float]:
+            if not ttfts:
+                return None
+            return ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))]
+
+        total_tokens = sum(n for _, n in results)
+        snap = core.metrics.snapshot()
+        aff = snap["affinity_hit"] + snap["affinity_miss"]
+        return {
+            "mode": "disagg" if disagg else "mono",
+            "sessions": sessions,
+            "turns": turns,
+            "max_new": max_new,
+            "replicas": replicas,
+            "n_streams": len(results),
+            "ttft_p50_ms": round(_pct(0.50) * 1e3, 2) if ttfts else None,
+            "ttft_p95_ms": round(_pct(0.95) * 1e3, 2) if ttfts else None,
+            "ttft_p99_ms": round(_pct(0.99) * 1e3, 2) if ttfts else None,
+            "tokens_per_sec": round(total_tokens / wall, 1),
+            "tokens_per_sec_per_replica":
+                round(total_tokens / wall / replicas, 1),
+            "total_tokens": total_tokens,
+            "seconds": round(wall, 3),
+            "affinity_hit_rate": (round(snap["affinity_hit"] / aff, 3)
+                                  if aff else None),
+            "kv_bytes": snap["kv_bytes"],
+            "sheds": snap["admission_shed"],
+        }
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()

@@ -107,6 +107,10 @@ id="fg-link">download flamegraph (speedscope json)</a></div>
 <div class="sub">QoS plane fair-share state per tenant (empty when the
 plane is off, qos=False)</div>
 <div id="tenants"></div></div>
+<div class="panel"><h2>Serving</h2>
+<div class="sub">prefill/decode pools: TTFT percentiles, KV-affinity
+hit rate, SLO sheds (empty when serve never started)</div>
+<div id="serve"></div></div>
 <div class="panel"><h2>Traces</h2><div id="traces"></div></div>
 <div class="panel"><h2>Actors</h2><div id="actors"></div></div>
 <div class="panel"><h2>Data streams</h2><div id="streams"></div></div>
@@ -117,6 +121,7 @@ plane is off, qos=False)</div>
 <a href="/api/actors">actors</a><a href="/api/objects">objects</a>
 <a href="/api/nodes">nodes</a><a href="/api/placement_groups">pgs</a>
 <a href="/api/tenants">tenants</a>
+<a href="/api/serve">serve</a>
 <a href="/api/data_streams">streams</a>
 <a href="/api/task_events">task_events</a>
 <a href="/api/timeline">timeline</a>
@@ -327,13 +332,15 @@ async function viewLog(f) {
 
 async function refresh() {
   try {
-    const [s, actors, taskEvents, traces, util, tenants] = await Promise.all([
+    const [s, actors, taskEvents, traces, util, tenants, serve] =
+      await Promise.all([
       fetch("/api/summary").then(r => r.json()),
       fetch("/api/actors").then(r => r.json()),
       fetch("/api/task_events").then(r => r.json()).catch(() => []),
       fetch("/api/traces").then(r => r.json()).catch(() => []),
       fetch("/api/utilization").then(r => r.json()).catch(() => []),
       fetch("/api/tenants").then(r => r.json()).catch(() => []),
+      fetch("/api/serve").then(r => r.json()).catch(() => null),
     ]);
     refreshLogs().catch(() => {});
     const nodes = s.nodes || [];
@@ -398,6 +405,33 @@ async function refresh() {
         running: tn.running ?? 0, preempted: tn.preempted ?? 0,
       })), ["tenant", "weight", "share", "deficit", "served",
             "queued", "running", "preempted"]);
+    // serving plane: plane-wide tiles + one row per deployment; the
+    // affinity hit rate only counts follow-up turns (first-ever
+    // session turns are neither hit nor miss)
+    const deps = (serve && serve.deployments) || [];
+    if (deps.length || (serve && serve.streams)) {
+      const aff = (serve.affinity_hit || 0) + (serve.affinity_miss || 0);
+      document.getElementById("serve").innerHTML =
+        tile("streams", serve.streams || 0) +
+        tile("TTFT p50 / p95", fmtS(serve.ttft_p50) + " / " +
+             fmtS(serve.ttft_p95)) +
+        tile("affinity hits", aff ? (100 * (serve.affinity_hit || 0) /
+             aff).toFixed(0) + "%" : "–") +
+        tile("SLO sheds", serve.admission_shed || 0,
+             serve.admission_shed ? "critical" : null) +
+        tile("KV moved", fmtBytes(serve.kv_bytes || 0)) +
+        tile("resumed", serve.resumed || 0,
+             serve.resumed ? "critical" : null) +
+        rows(deps.map(d => ({
+          deployment: d.name, replicas: d.replicas,
+          ongoing: d.ongoing, sessions: d.sessions,
+          autoscaling: d.autoscaling_metric || "–",
+          version: d.version,
+        })), ["deployment", "replicas", "ongoing", "sessions",
+              "autoscaling", "version"]);
+    } else {
+      document.getElementById("serve").innerHTML = "";
+    }
     document.getElementById("nodes").innerHTML = rows(nodes.map(n => ({
       node: (n.node_id || "").slice(0, 12), state: n.state || "ALIVE",
       kind: n.kind || "", resources: JSON.stringify(n.resources || {}),
@@ -494,6 +528,18 @@ class Dashboard:
                         ring[k] += rs.get(k, 0)
             return ring
 
+        def serve_snapshot() -> dict:
+            """Serving-plane counters + per-deployment rows (the
+            Serving panel source). sys.modules lookup, not an import:
+            a dashboard poll must not drag the serve package in, and
+            the panel stays empty-but-valid when serve never started."""
+            import sys
+
+            core = sys.modules.get("ray_tpu.serve.core")
+            if core is None:
+                return {"deployments": []}
+            return core.serving_stats()
+
         def flamegraph() -> dict:
             """Speedscope document over every resident folded stack —
             save the response and drop it on speedscope.app."""
@@ -519,6 +565,10 @@ class Dashboard:
             # QoS plane: per-tenant fair-share/deficit rows (the
             # Tenants panel source); empty when qos=False
             "/api/tenants": lambda: state.list_tenants(),
+            # serving plane: TTFT/affinity/shed counters + deployment
+            # rows (the Serving panel source); empty when serve was
+            # never started
+            "/api/serve": serve_snapshot,
             "/api/data_streams": lambda: state.list_data_streams(),
             "/api/logs": lambda: state.list_logs(),
             # profile plane: per-node utilization series + folded

@@ -232,7 +232,7 @@ class TokenStream:
 
 class _Request:
     __slots__ = ("prompt", "max_new", "future", "out", "emitted", "stream",
-                 "streamed")
+                 "streamed", "kv")
 
     def __init__(self, prompt: List[int], max_new: int):
         self.prompt = prompt
@@ -242,6 +242,10 @@ class _Request:
         self.emitted = 0           # tokens produced on device (>= len(out))
         self.stream: Optional[TokenStream] = None
         self.streamed = 0          # tokens already pushed to the stream
+        # disaggregated handoff: (k [L,S,KV,D], v, first_token) host
+        # arrays from a prefill replica's export; admission imports the
+        # pages instead of running the prompt pass
+        self.kv: Optional[Tuple[Any, Any, int]] = None
 
 
 class _Slot:
@@ -254,17 +258,52 @@ class _Slot:
 
 
 class InferenceEngine:
-    """Continuous-batching decode loop over a paged KV cache."""
+    """Continuous-batching decode loop over a paged KV cache.
+
+    ``mode`` disaggregates the engine for split-pool serving:
+
+    - ``"both"`` (default): the monolithic engine — prompt passes and
+      the continuous decode batch in one process.
+    - ``"prefill"``: prompt passes only. No paged cache, no decode
+      programs, no loop thread; ``prefill_export`` runs the bucketed
+      prompt pass synchronously and hands the K/V pages + first token
+      to the caller for shipping through the object plane.
+    - ``"decode"``: the continuous batch only. Requests join via
+      ``submit_stream_from_kv`` (imported pages); plain ``submit`` is
+      rejected so a misrouted prompt fails loudly instead of silently
+      paying an un-provisioned prefill.
+    """
 
     def __init__(self, params: Dict[str, Any], model_cfg: TransformerConfig,
-                 cfg: InferenceConfig = InferenceConfig()):
+                 cfg: InferenceConfig = InferenceConfig(),
+                 mode: str = "both"):
+        if mode not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown engine mode {mode!r}")
         if "params" in params and "embedding" not in params:
             params = params["params"]
         self.params = params
         self.mcfg = model_cfg
         self.cfg = cfg
+        self.mode = mode
         L = model_cfg.n_layers
         KV, D = model_cfg.n_kv_heads, model_cfg.head_dim
+        if mode == "prefill":
+            # single-prompt bucketed prompt pass; compiles lazily per
+            # bucket on first use. Everything decode-shaped is absent.
+            mcfg = self.mcfg
+            self._export_jits = {
+                b: jax.jit(lambda p, t: prefill(p, mcfg, t))
+                for b in cfg.prefill_buckets
+            }
+            self._slots = []
+            self._free_pages = []
+            self._queue = queue.Queue()
+            self._lock = threading.Lock()
+            self._shutdown = False
+            self._thread = None
+            self.num_steps = 0
+            self.max_concurrent = 0
+            return
         # per-layer tuple (pytree), NOT a stacked [L,...] array: in-place
         # scatter updates per layer under the donated decode program
         self._k_pages = tuple(
@@ -340,11 +379,29 @@ class InferenceEngine:
             toks_vec = toks_vec.at[slots].set(nxt)
             return nxt, toks_vec, tuple(new_k), tuple(new_v)
 
-        self._prefill_many = {
+        self._prefill_many = ({} if mode == "decode" else {
             b: jax.jit(functools.partial(prefill_write_many, bucket=b),
                        donate_argnums=(2, 3, 4))
             for b in cfg.prefill_buckets
-        }
+        })
+
+        # KV-page IMPORT: write a prefill replica's exported K/V
+        # sequence into this engine's pages and scatter the already-
+        # computed first token into the device feedback vector — the
+        # decode-pool half of the disaggregated handoff. One request
+        # per dispatch (handoffs arrive one at a time off the object
+        # plane); jit specializes per bucket like prefill.
+        def kv_import_one(kp, vp, toks_vec, k_seq, v_seq, pages,
+                          slot_first):
+            new_k, new_v = list(kp), list(vp)
+            for i in range(mcfg.n_layers):
+                new_k[i], new_v[i] = write_prefill_kv(
+                    new_k[i], new_v[i], k_seq[i], v_seq[i], pages)
+            toks_vec = toks_vec.at[slot_first[0]].set(slot_first[1])
+            return toks_vec, tuple(new_k), tuple(new_v)
+
+        # one jit, respecialized per padded bucket shape
+        self._kv_import = jax.jit(kv_import_one, donate_argnums=(0, 1, 2))
         # persistent device-resident feedback state: admission scatters
         # the prefill's next-token in WITHOUT a host read (on tunneled
         # chips a sync costs ~90 ms; a dispatch ~2 ms)
@@ -375,9 +432,20 @@ class InferenceEngine:
                 f"{max(self.cfg.prefill_buckets)}")
         return max_new
 
+    def _check_mode(self, wants: str) -> None:
+        if self.mode not in ("both", wants):
+            raise RuntimeError(
+                f"engine is in {self.mode!r} mode; this entry point "
+                f"needs {wants!r}")
+
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None) -> Future:
         """Returns a Future resolving to the GENERATED token list."""
+        if self.mode != "both":
+            raise RuntimeError(
+                f"engine is in {self.mode!r} mode; plain submit needs "
+                f"the monolithic engine (prefill_export / "
+                f"submit_stream_from_kv are the split-pool entry points)")
         max_new = self._validate(prompt, max_new_tokens)
         req = _Request(list(prompt), max_new)
         self._queue.put(req)
@@ -389,10 +457,79 @@ class InferenceEngine:
         """Streaming variant: tokens arrive on the returned iterator as
         each device sync lands (chunk granularity), ending at EOS /
         budget; .result() still yields the final list."""
+        if self.mode != "both":
+            raise RuntimeError(
+                f"engine is in {self.mode!r} mode; plain submit_stream "
+                f"needs the monolithic engine")
         max_new = self._validate(prompt, max_new_tokens)
         req = _Request(list(prompt), max_new)
         stream = TokenStream(req.future)
         req.stream = stream
+        self._queue.put(req)
+        self._wake.set()
+        return stream
+
+    # -- disaggregated prefill/decode handoff --------------------------
+    def prefill_export(self, prompt: Sequence[int],
+                       max_new_tokens: Optional[int] = None
+                       ) -> Dict[str, Any]:
+        """Run the prompt pass and export the session's KV pages as
+        host arrays — the prefill-pool half of disaggregated serving.
+
+        Returns ``{"prompt", "prompt_len", "first_token", "k", "v",
+        "kv_bytes"}`` where k/v are numpy [L, prompt_len, KV, D] in the
+        model dtype (page-layout-free: the importing engine writes them
+        into ITS pages, so pools need not share page geometry). The
+        first token is argmax of the last prompt position, i.e. the
+        entire TTFT-critical work happens here; decode-side import adds
+        one page write."""
+        self._check_mode("prefill")
+        max_new = self._validate(prompt, max_new_tokens)
+        plen = len(prompt)
+        bucket = next(b for b in sorted(self.cfg.prefill_buckets)
+                      if b >= plen)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :plen] = list(prompt)
+        jit = (self._export_jits[bucket] if self.mode == "prefill"
+               else None)
+        if jit is None:
+            # "both"-mode engines export through the same functional
+            # prefill, jitted lazily per bucket
+            jits = getattr(self, "_export_jits", None)
+            if jits is None:
+                mcfg = self.mcfg
+                jits = self._export_jits = {
+                    b: jax.jit(lambda p, t: prefill(p, mcfg, t))
+                    for b in self.cfg.prefill_buckets}
+            jit = jits[bucket]
+        logits, k_seq, v_seq = jit(self.params, jnp.asarray(toks))
+        first = int(jnp.argmax(logits[plen - 1]))
+        k = np.asarray(k_seq[:, :plen])
+        v = np.asarray(v_seq[:, :plen])
+        return {"prompt": list(prompt), "prompt_len": plen,
+                "first_token": first, "k": k, "v": v,
+                "kv_bytes": int(k.nbytes + v.nbytes),
+                "max_new": max_new}
+
+    def submit_stream_from_kv(self, kv: Dict[str, Any],
+                              max_new_tokens: Optional[int] = None,
+                              emit_first: bool = True) -> TokenStream:
+        """Join the continuous batch from an exported KV handoff
+        (``prefill_export`` dict) instead of a prompt pass. The first
+        token is already known; with ``emit_first=False`` the stream
+        treats it as already delivered (the ingress driver streamed it
+        straight off the handoff) and yields only subsequent tokens."""
+        self._check_mode("decode")
+        prompt = list(kv["prompt"])
+        max_new = self._validate(
+            prompt, kv.get("max_new") if max_new_tokens is None
+            else max_new_tokens)
+        req = _Request(prompt, max_new)
+        req.kv = (kv["k"], kv["v"], int(kv["first_token"]))
+        stream = TokenStream(req.future)
+        req.stream = stream
+        if not emit_first:
+            req.streamed = 1
         self._queue.put(req)
         self._wake.set()
         return stream
@@ -405,6 +542,7 @@ class InferenceEngine:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
+                "mode": self.mode,
                 "num_steps": self.num_steps,
                 "max_concurrent": self.max_concurrent,
                 "free_pages": len(self._free_pages),
@@ -414,6 +552,8 @@ class InferenceEngine:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        if self._thread is None:      # prefill-only engine: no loop
+            return
         self._wake.set()
         self._thread.join(timeout=5.0)
         self._fail_outstanding(RuntimeError("engine shut down"))
@@ -454,6 +594,7 @@ class InferenceEngine:
         scatter into the device feedback vector and sync with the next
         burst's combined fetch."""
         admits: List[Tuple[_Slot, _Request, List[int]]] = []
+        imports: List[Tuple[_Slot, _Request, List[int]]] = []
         while True:
             free_slot = next((s for s in self._slots if s.req is None),
                              None)
@@ -472,7 +613,10 @@ class InferenceEngine:
             free_slot.pages = pages
             free_slot.seq_len = plen
             req.emitted = 1
-            admits.append((free_slot, req, pages))
+            (imports if req.kv is not None else admits).append(
+                (free_slot, req, pages))
+        for slot, req, pages in imports:
+            self._import_group(slot, req, pages)
         if not admits:
             return
         by_bucket: Dict[int, List[Tuple[_Slot, _Request, List[int]]]] = {}
@@ -520,6 +664,43 @@ class InferenceEngine:
                 self.params, jnp.asarray(packed), self._k_pages,
                 self._v_pages, self._dev_toks)
         self._pending_firsts.append((nxt, rows))
+
+    def _import_group(self, slot: _Slot, req: _Request,
+                      pages: List[int]) -> None:
+        """Admit one KV handoff: pad the exported sequence to its
+        bucket, write it into this engine's pages, scatter the known
+        first token into the device feedback vector. The request joins
+        the next burst exactly as if it had prefilled here."""
+        k, v, first = req.kv
+        req.kv = None  # drop the host copy as soon as it's uploaded
+        plen = len(req.prompt)
+        bucket = next(b for b in sorted(self.cfg.prefill_buckets)
+                      if b >= plen)
+        n_prog = -(-bucket // self.cfg.page_size)
+        L = self.mcfg.n_layers
+        KV, D = self.mcfg.n_kv_heads, self.mcfg.head_dim
+        k_pad = np.zeros((L, bucket, KV, D), k.dtype)
+        v_pad = np.zeros((L, bucket, KV, D), v.dtype)
+        k_pad[:, :plen] = k
+        v_pad[:, :plen] = v
+        # pad rows past the prompt are DON'T-CARE (appends overwrite,
+        # attention masks by seq_len); pages past the allocation park
+        page_list = (pages + [self._parking_page] * n_prog)[:n_prog]
+        slot_idx = self._slots.index(slot)
+        self._dev_toks, self._k_pages, self._v_pages = self._kv_import(
+            self._k_pages, self._v_pages, self._dev_toks,
+            jnp.asarray(k_pad), jnp.asarray(v_pad),
+            jnp.asarray(np.asarray(page_list, np.int32)),
+            jnp.asarray(np.asarray([slot_idx, first], np.int32)))
+        req.out = [first]
+        self._maybe_finish(slot)  # max_new == 1 finishes at admission
+        if req.stream is not None:
+            new = req.out[req.streamed:]
+            if new:
+                req.stream._q.put(new)
+            req.streamed += len(new)
+            if req.future.done():
+                req.stream._q.put(_STREAM_END)
 
     def _maybe_finish(self, slot: _Slot) -> None:
         req = slot.req

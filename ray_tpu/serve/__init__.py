@@ -9,15 +9,16 @@ through bind(), replica crash recovery, redeploy/scaling, and a small
 JSON HTTP ingress.
 """
 
-from ray_tpu.serve.core import (Application, AutoscalingConfig,  # noqa: F401
+from ray_tpu.serve.core import (AdmissionShedError,  # noqa: F401
+                                Application, AutoscalingConfig,
                                 Deployment, DeploymentHandle, deployment,
                                 get_app_handle, get_multiplexed_model_id,
-                                multiplexed, run, shutdown, start_grpc,
-                                start_http, status)
+                                multiplexed, run, serving_stats, shutdown,
+                                start_grpc, start_http, status)
 
 __all__ = [
     "deployment", "run", "shutdown", "status", "get_app_handle",
     "Deployment", "DeploymentHandle", "Application", "start_http",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
-    "start_grpc",
+    "start_grpc", "AdmissionShedError", "serving_stats",
 ]

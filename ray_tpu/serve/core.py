@@ -26,15 +26,38 @@ _controller: Optional["_Controller"] = None
 
 class AutoscalingConfig:
     """Queue-driven replica autoscaling (reference: serve autoscaling
-    from ongoing-request metrics)."""
+    from ongoing-request metrics).
+
+    ``metric`` selects the pressure signal so disaggregated pools scale
+    independently:
+
+    - ``"ongoing"`` (default): in-flight requests per replica, the
+      reference signal.
+    - ``"ttft"``: the serving plane's recent p95 time-to-first-token
+      against ``target_ttft_s`` — the prefill pool's signal (TTFT is
+      prefill + one page handoff, so a missed target means the prompt
+      pass is the bottleneck). Grows one replica per interval while
+      p95 > target; shrinks when p95 < target/2.
+    - ``"sessions"``: open sticky streams per replica against
+      ``target_ongoing_requests`` — the decode pool's signal (a stream
+      occupies a continuous-batching slot between polls, which plain
+      ongoing-request counts cannot see).
+    """
 
     def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
                  target_ongoing_requests: float = 2.0,
-                 interval_s: float = 0.2):
+                 interval_s: float = 0.2, metric: str = "ongoing",
+                 target_ttft_s: Optional[float] = None):
+        if metric not in ("ongoing", "ttft", "sessions"):
+            raise ValueError(f"unknown autoscaling metric {metric!r}")
+        if metric == "ttft" and not target_ttft_s:
+            raise ValueError("metric='ttft' needs target_ttft_s")
         self.min_replicas = min_replicas
         self.max_replicas = max_replicas
         self.target_ongoing_requests = target_ongoing_requests
         self.interval_s = interval_s
+        self.metric = metric
+        self.target_ttft_s = target_ttft_s
 
 
 class Deployment:
@@ -229,6 +252,15 @@ class _Replica:
             check()
         return "ok"
 
+    def shutdown_replica(self) -> None:
+        """Explicit retirement hook: runs the deployment's shutdown()
+        when it defines one, BEFORE the actor is killed — engine loops
+        and device state release deterministically instead of riding
+        __del__ (which a SIGKILLed worker never runs)."""
+        hook = getattr(self.instance, "shutdown", None)
+        if callable(hook):
+            hook()
+
     def handle_request(self, method: str, args, kwargs,
                        model_id: Optional[str] = None):
         target = (self.instance if method == "__call__"
@@ -330,11 +362,28 @@ class _DeploymentState:
                 return
             with self._lock:
                 ongoing = sum(r.ongoing for r in self._replicas)
+                sessions = len(self._sticky)
                 n = len(self._replicas)
-            desired = max(
-                cfg.min_replicas,
-                min(cfg.max_replicas,
-                    math.ceil(ongoing / cfg.target_ongoing_requests)))
+            if cfg.metric == "ttft":
+                # latency-driven: one step per interval, damped — TTFT
+                # reacts to capacity with a lag (in-flight prefills
+                # finish on the old pool size), so proportional jumps
+                # would oscillate
+                p95 = metrics.ttft_quantile(0.95)
+                if p95 is None:
+                    desired = n
+                elif p95 > cfg.target_ttft_s:
+                    desired = min(cfg.max_replicas, n + 1)
+                elif p95 < cfg.target_ttft_s / 2:
+                    desired = max(cfg.min_replicas, n - 1)
+                else:
+                    desired = n
+            else:
+                load = sessions if cfg.metric == "sessions" else ongoing
+                desired = max(
+                    cfg.min_replicas,
+                    min(cfg.max_replicas,
+                        math.ceil(load / cfg.target_ongoing_requests)))
             if desired != n:
                 try:
                     self._scale_to(desired)
@@ -459,6 +508,18 @@ class _DeploymentState:
             # replica (documented limit of the timeout)
             self._sticky = {sid: r for sid, r in self._sticky.items()
                             if r is not state}
+        self._retire_actor(state)
+
+    def _retire_actor(self, state: _ReplicaState) -> None:
+        """Graceful retirement: run the replica's explicit shutdown
+        hook (best-effort, bounded) before the kill — retired replicas
+        are HEALTHY, so relying on __del__ inside a killed worker would
+        leak engine threads until process exit."""
+        try:
+            ray_tpu.get(state.actor.shutdown_replica.remote(),
+                        timeout=5.0)
+        except Exception:
+            pass
         try:
             ray_tpu.kill(state.actor)
         except Exception:
@@ -518,24 +579,30 @@ class _DeploymentState:
             if victims:
                 self._prune_affinity_locked()
         for state in victims:
-            try:
-                ray_tpu.kill(state.actor)
-            except Exception:
-                pass
+            self._retire_actor(state)
 
-    def _pick(self, model_id: Optional[str] = None) -> _ReplicaState:
+    def _pick(self, model_id: Optional[str] = None,
+              prefer: Optional[_ReplicaState] = None) -> _ReplicaState:
         """Power-of-two-choices on tracked ongoing requests. RESERVES
         the chosen replica (ongoing += 1) under the same lock hold —
         otherwise the autoscaler could classify it idle and kill it in
         the window before the caller's increment. A multiplexed
         model_id prefers the least-loaded replica that served that
-        model before (warm cache), falling back to P2C."""
+        model before (warm cache), falling back to P2C. ``prefer``
+        (cache-affinity routing: the replica already holding a
+        session's KV pages) wins over both, under the same
+        yield-when-saturated rule — affinity must not pin a hot
+        session to an overloaded replica while the pool idles."""
         with self._lock:
             if not self._replicas:
                 raise rex.RayTpuError(
                     f"deployment {self.dep.name} has no replicas")
             chosen = None
-            if model_id is not None:
+            if prefer is not None and prefer in self._replicas:
+                idlest = min(r.ongoing for r in self._replicas)
+                if prefer.ongoing <= idlest + 2:
+                    chosen = prefer
+            if chosen is None and model_id is not None:
                 warm = [r for r in self._model_replicas.get(model_id, ())
                         if r in self._replicas]
                 if warm:
@@ -606,17 +673,19 @@ class _DeploymentState:
 
     def submit_sticky(self, method: str, args, kwargs,
                       session: Optional[str] = None,
-                      _retry: bool = True):
+                      _retry: bool = True,
+                      prefer: Optional[_ReplicaState] = None):
         """Replica-PINNED call: session=None picks a replica and opens
         a sticky session (returned token routes later calls to the
         same replica — replica-local state like token streams must not
         be load-balanced away). A dead PINNED replica raises (its
         session state died with it); opening a session retries once on
-        another replica, like submit. Returns (ref, token)."""
+        another replica, like submit. ``prefer`` biases the opening
+        pick (cache-affinity routing). Returns (ref, token)."""
         import uuid as _uuid
 
         if session is None:
-            state = self._pick()  # reserves (ongoing += 1)
+            state = self._pick(prefer=prefer)  # reserves (ongoing += 1)
             token = _uuid.uuid4().hex
             with self._lock:
                 self._sticky[token] = state
@@ -657,6 +726,13 @@ class _DeploymentState:
     def end_sticky(self, token: str) -> None:
         with self._lock:
             self._sticky.pop(token, None)
+
+    def sticky_replica(self, token: str) -> Optional[_ReplicaState]:
+        """The replica a sticky session is pinned to (None when the
+        session ended or its replica left) — cache-affinity routing
+        records this as the session's KV-page holder."""
+        with self._lock:
+            return self._sticky.get(token)
 
     def _replace(self, dead: _ReplicaState) -> None:
         with self._lock:
@@ -854,6 +930,265 @@ def shutdown() -> None:
         if _controller is not None:
             _controller.shutdown()
             _controller = None
+    metrics.reset()
+    kv_directory.reset()
+    _stream_drivers.clear()
+
+
+# ----------------------------------------------------------------------
+# serving-at-scale plane: TTFT window + counters, SLO admission, and
+# the KV-page directory behind cache-affinity routing
+# ----------------------------------------------------------------------
+
+class AdmissionShedError(rex.RayTpuError):
+    """New stream shed at ingress: recent p95 TTFT is over the
+    serve_slo_ttft_p95_s target while streams are in flight. Callers
+    should back off; the HTTP ingress maps this to 503."""
+
+
+# prometheus-convention boundaries for the TTFT histogram
+_TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                 5.0, 10.0)
+
+
+class _ServeMetrics:
+    """Process-wide serving counters + the TTFT sliding window the
+    admission gate and the ttft-mode autoscaler read. Counters are
+    cumulative (prometheus semantics, rendered by metrics.py); the
+    window is bounded by serve_ttft_window and resets with the
+    controller so tests see a clean plane per serve lifecycle."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        import collections
+
+        with self._lock:
+            self._window = collections.deque(maxlen=1024)
+            self.ttft_count = 0
+            self.ttft_sum = 0.0
+            self.ttft_buckets = [0] * len(_TTFT_BUCKETS)
+            self.affinity_hit = 0
+            self.affinity_miss = 0
+            self.admission_shed = 0
+            self.kv_bytes = 0
+            self.streams = 0
+            self.resumed = 0
+
+    def record_ttft(self, seconds: float) -> None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        try:
+            win = int(GLOBAL_CONFIG.serve_ttft_window)
+        except Exception:
+            win = 256
+        with self._lock:
+            self._window.append(seconds)
+            while len(self._window) > max(1, win):
+                self._window.popleft()
+            self.ttft_count += 1
+            self.ttft_sum += seconds
+            for i, b in enumerate(_TTFT_BUCKETS):
+                if seconds <= b:
+                    self.ttft_buckets[i] += 1
+
+    def ttft_quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._window:
+                return None
+            xs = sorted(self._window)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    def count(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + delta)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self._window)
+            xs = sorted(self._window)
+            quant = (lambda q: xs[min(n - 1, int(q * n))]) if n else \
+                (lambda q: None)
+            return {
+                "ttft_count": self.ttft_count,
+                "ttft_sum": self.ttft_sum,
+                "ttft_buckets": list(self.ttft_buckets),
+                "ttft_p50": quant(0.50),
+                "ttft_p95": quant(0.95),
+                "ttft_p99": quant(0.99),
+                "affinity_hit": self.affinity_hit,
+                "affinity_miss": self.affinity_miss,
+                "admission_shed": self.admission_shed,
+                "kv_bytes": self.kv_bytes,
+                "streams": self.streams,
+                "resumed": self.resumed,
+            }
+
+
+metrics = _ServeMetrics()
+
+
+class _KVDirectory:
+    """session id -> (deployment, replica, KV handoff object) — the
+    KV-page directory behind cache-affinity routing. It is a THIN
+    overlay on the multi-location object directory (gcs): the gcs rows
+    stay authoritative for WHERE the exported pages physically live
+    (primary + secondaries; node death drops locations), while this
+    map remembers WHICH replica imported them for a session.
+
+    lookup() resolves three ways:
+    - ``hit``: the holding replica is still in the pool — route there.
+    - ``promoted``: the replica is gone but the object directory still
+      knows a live location for the handoff bytes (a secondary copy
+      survived the node) — any replica can re-import without paying a
+      prefill; the entry re-pins on the next record().
+    - ``gone``: no live location remains (sole copy died with its
+      node) — the entry drops and the caller re-prefills.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, tuple] = {}  # sid -> (dep, replica, ref)
+        self._seen: set = set()  # sessions ever recorded (survives drop:
+        #                          distinguishes a follow-up turn whose
+        #                          entry was invalidated — an affinity
+        #                          MISS — from a first-ever turn, which
+        #                          cannot hit and counts as neither)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._seen.clear()
+
+    def known(self, session: str) -> bool:
+        with self._lock:
+            return session in self._seen
+
+    def record(self, session: str, dep_name: str, replica, kv_ref) -> None:
+        with self._lock:
+            self._entries[session] = (dep_name, replica, kv_ref)
+            self._seen.add(session)
+            while len(self._seen) > 65536:
+                self._seen.pop()
+            while len(self._entries) > 4096:
+                self._entries.pop(next(iter(self._entries)))
+
+    def drop(self, session: str) -> None:
+        with self._lock:
+            self._entries.pop(session, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _locations_alive(self, kv_ref) -> bool:
+        if kv_ref is None:
+            return False
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.get_worker()
+            return bool(w.gcs.object_locations(kv_ref.object_id()))
+        except Exception:
+            return False
+
+    def lookup(self, session: Optional[str],
+               dep_state: "_DeploymentState"):
+        """Returns (status, replica_or_None, kv_ref_or_None); status in
+        {"hit", "promoted", "gone", "none"}."""
+        if session is None:
+            return "none", None, None
+        with self._lock:
+            entry = self._entries.get(session)
+        if entry is None:
+            return "none", None, None
+        dep_name, replica, kv_ref = entry
+        with dep_state._lock:
+            alive = replica in dep_state._replicas
+        if alive:
+            return "hit", replica, kv_ref
+        if self._locations_alive(kv_ref):
+            return "promoted", None, kv_ref
+        self.drop(session)
+        return "gone", None, None
+
+
+kv_directory = _KVDirectory()
+
+
+def check_admission(state: Optional[_DeploymentState] = None) -> None:
+    """SLO-aware ingress gate: raise AdmissionShedError for a NEW
+    stream when the recent p95 TTFT is over target while load is in
+    flight. Sheds stop as soon as in-flight work drains (no load means
+    the next admit cannot be queue-bound) or fresh samples come back
+    under target — the gate reads the live window, so it self-heals
+    instead of latching shut on stale samples."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    try:
+        target = float(GLOBAL_CONFIG.serve_slo_ttft_p95_s)
+    except Exception:
+        return
+    if target <= 0:
+        return
+    p95 = metrics.ttft_quantile(0.95)
+    if p95 is None or p95 <= target:
+        return
+    if state is not None:
+        with state._lock:
+            busy = (sum(r.ongoing for r in state._replicas)
+                    + len(state._sticky))
+        if busy == 0:
+            return
+    metrics.count("admission_shed")
+    raise AdmissionShedError(
+        f"shedding at ingress: recent p95 TTFT {p95:.3f}s over the "
+        f"{target:.3f}s SLO target")
+
+
+def serving_stats() -> Dict[str, Any]:
+    """One snapshot for metrics/state/dashboard: plane counters plus
+    per-deployment rows (pool role is the deployment's declared
+    autoscaling metric when present)."""
+    snap = metrics.snapshot()
+    snap["kv_sessions"] = len(kv_directory)
+    deployments = []
+    c = _controller
+    if c is not None:
+        for name, st in list(c.deployments.items()):
+            auto = st.dep.autoscaling_config
+            with st._lock:
+                deployments.append({
+                    "name": name,
+                    "replicas": len(st._replicas),
+                    "ongoing": sum(r.ongoing for r in st._replicas),
+                    "sessions": len(st._sticky),
+                    "version": st.dep.version,
+                    "autoscaling_metric": auto.metric if auto else None,
+                })
+    snap["deployments"] = deployments
+    return snap
+
+
+# apps with a custom streaming topology (the disaggregated LLM app)
+# register a frames-driver under their public name; the HTTP SSE and
+# gRPC PredictStream routes consult this before falling back to the
+# single-deployment sticky protocol
+_stream_drivers: Dict[str, Callable] = {}
+
+
+def register_stream_driver(name: str, driver: Callable) -> None:
+    _stream_drivers[name] = driver
+
+
+def _frames_for(name: str, prompt, max_new_tokens):
+    driver = _stream_drivers.get(name)
+    if driver is not None:
+        return driver(prompt, max_new_tokens)
+    return _sticky_stream_frames(get_app_handle(name)._state(), prompt,
+                                 max_new_tokens)
 
 
 def _sticky_stream_frames(state: _DeploymentState, prompt,
@@ -863,7 +1198,16 @@ def _sticky_stream_frames(state: _DeploymentState, prompt,
     (start_stream / next_tokens until done) — the ONE driver both the
     HTTP SSE route and the gRPC PredictStream wrap. Sticky: every poll
     must hit the replica holding the stream; the session releases on
-    EVERY exit path, including a consumer that stops iterating."""
+    EVERY exit path, including a consumer that stops iterating.
+
+    This is also an ADMISSION POINT: new streams shed against the
+    p95-TTFT SLO before touching a replica, and the wait for the first
+    token burst is the TTFT sample the gate and the ttft autoscaler
+    read."""
+    check_admission(state)
+    metrics.count("streams")
+    t0 = time.monotonic()
+    first_seen = False
     ref, token = state.submit_sticky(
         "start_stream", (prompt, max_new_tokens), {})
     try:
@@ -872,6 +1216,9 @@ def _sticky_stream_frames(state: _DeploymentState, prompt,
             ref, _ = state.submit_sticky("next_tokens", (sid,), {},
                                          session=token)
             r = ray_tpu.get(ref, timeout=poll_timeout)
+            if not first_seen and r.get("tokens"):
+                first_seen = True
+                metrics.record_ttft(time.monotonic() - t0)
             yield r
             if r.get("done"):
                 return
@@ -923,14 +1270,23 @@ def start_http(port: int = 0) -> int:
             body = self.rfile.read(length) if length else b"null"
             try:
                 payload = json.loads(body) or {}
-                frames = _sticky_stream_frames(
-                    get_app_handle(name)._state(),
-                    payload.get("prompt"),
-                    payload.get("max_new_tokens"))
+                frames = _frames_for(name, payload.get("prompt"),
+                                     payload.get("max_new_tokens"))
                 # pull the FIRST burst before committing to SSE: a
                 # failed stream start must answer 500 JSON, not a
                 # half-open event stream
                 first = next(frames, None)
+            except AdmissionShedError as e:
+                # SLO shed is a load signal, not a server fault:
+                # 503 + Retry-After so well-behaved clients back off
+                data = json.dumps({"error": str(e), "shed": True}).encode()
+                self.send_response(503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             except Exception as e:  # noqa: BLE001
                 self._json_response(500, {"error": str(e)})
                 return
@@ -1029,11 +1385,12 @@ def start_grpc(port: int = 0, max_workers: int = 8) -> int:
     def predict_stream(request: bytes, context):
         try:
             payload = json.loads(request or b"null") or {}
-            state = _handle_of(payload)._state()
-            for r in _sticky_stream_frames(
-                    state, payload.get("prompt"),
-                    payload.get("max_new_tokens")):
+            name = payload.get("deployment") or _controller.ingress_name
+            for r in _frames_for(name, payload.get("prompt"),
+                                 payload.get("max_new_tokens")):
                 yield json.dumps(r).encode()
+        except AdmissionShedError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except Exception as e:  # noqa: BLE001
             context.abort(grpc.StatusCode.INTERNAL, str(e))
 

@@ -200,6 +200,21 @@ def list_tenants() -> List[Dict[str, Any]]:
 
 
 @_client_dispatch
+def list_serve_deployments() -> List[Dict[str, Any]]:
+    """Serving deployments from the live serve controller, one row per
+    deployment: replica count, in-flight calls, sticky sessions,
+    version, and the declared autoscaling metric (None = fixed-size;
+    "ttft"/"sessions" mark the disaggregated pools). Empty when
+    serve was never started in this session."""
+    import sys
+
+    core = sys.modules.get("ray_tpu.serve.core")
+    if core is None:
+        return []
+    return core.serving_stats()["deployments"]
+
+
+@_client_dispatch
 def list_data_streams() -> List[Dict[str, Any]]:
     """Streaming-split ingest stats: one row per live
     Dataset.streaming_split coordinator plus the last few shut-down

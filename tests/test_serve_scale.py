@@ -1,0 +1,630 @@
+"""Serving at traffic scale: disaggregated prefill/decode pools,
+KV-cache-affinity routing, SLO-aware admission, and the chaos soak.
+
+Parity strategy mirrors test_inference.py: whatever path a token takes
+(mono continuous batch, prefill-export -> decode-import handoff, cached
+session replay, or a mid-stream resume after replica loss), the client
+must receive EXACTLY the greedy tokens of the naive full-context
+forward — same params, tiny config. "Zero double-decodes" falls out of
+the same check: a duplicated or divergent token breaks exact equality.
+"""
+
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu import serve  # noqa: E402
+from ray_tpu.models.inference import InferenceConfig  # noqa: E402
+from ray_tpu.models.transformer import (Transformer,  # noqa: E402
+                                        TransformerConfig)
+from ray_tpu.serve import core  # noqa: E402
+from ray_tpu.serve.llm import run_disagg_llm  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=128, dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, variables["params"]
+
+
+def naive_greedy(model, params, prompt, n_new):
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = model.apply({"params": params},
+                             jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _engine_cfg(max_new=8, decode_chunk=2, batch=2):
+    return InferenceConfig(batch_size=batch, page_size=4,
+                           max_pages_per_seq=16, num_pages=64,
+                           prefill_buckets=(16,),
+                           max_new_tokens=max_new,
+                           decode_chunk=decode_chunk)
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_workers=8, scheduler="tensor")
+    yield ray_tpu
+    chaos.disarm()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _drive(handle, prompt, max_new, session, on_frame=None):
+    """Drain one disagg stream; returns the delivered token list."""
+    out = []
+    for fr in handle.stream_frames(prompt, max_new, session_id=session):
+        out.extend(fr.get("tokens") or ())
+        if on_frame is not None:
+            on_frame(out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# disaggregated parity + cache-affinity routing
+# ---------------------------------------------------------------------------
+
+class TestDisaggParity:
+    def test_split_pools_match_naive_greedy_and_route_affine(
+            self, rt, tiny_model):
+        """Every turn over the split pools is bit-identical to the
+        naive full-context forward, and follow-up turns route back to
+        the KV-holding decode replica: across 4 sessions x 2
+        follow-ups the affinity hit rate is 100% (>= the 80% bar),
+        with first-ever turns counting neither hit nor miss."""
+        cfg, model, params = tiny_model
+        max_new = 8
+        handle = run_disagg_llm(params, cfg, _engine_cfg(max_new),
+                                prefill_replicas=1, decode_replicas=2)
+        prompts = {f"sess-{i}": [3 + i, 14, 15, 9 + i, 2]
+                   for i in range(4)}
+        want = {s: naive_greedy(model, params, p, max_new)
+                for s, p in prompts.items()}
+        # first turns: prefill-pool path (no entries to hit yet)
+        for s, p in prompts.items():
+            assert _drive(handle, p, max_new, s) == want[s], s
+        snap = core.metrics.snapshot()
+        assert snap["affinity_hit"] == 0 and snap["affinity_miss"] == 0
+        assert snap["kv_bytes"] > 0
+        # follow-up turns: exact-prompt cached replay on the affine
+        # replica — still bit-identical, zero additional prefill bytes
+        kv_before = snap["kv_bytes"]
+        for _turn in range(2):
+            for s, p in prompts.items():
+                assert _drive(handle, p, max_new, s) == want[s], s
+        snap = core.metrics.snapshot()
+        hits, misses = snap["affinity_hit"], snap["affinity_miss"]
+        assert hits + misses == 8, snap
+        assert hits / (hits + misses) >= 0.8, snap
+        assert snap["kv_bytes"] == kv_before, (
+            "cached replays must not re-export KV pages")
+        # every session shows in the directory + serving_stats
+        stats = serve.serving_stats()
+        assert stats["kv_sessions"] == 4
+        names = {d["name"] for d in stats["deployments"]}
+        assert {"llm_prefill", "llm_decode"} <= names
+
+    def test_mid_stream_replica_kill_resumes_bit_identical(
+            self, rt, tiny_model):
+        """The resume drill at tier-1 size: SIGKILL the decode replica
+        that holds the stream after >=2 tokens are with the client.
+        The driver re-prefills prompt+delivered on the survivor and
+        the client's final sequence is EXACTLY the naive reference —
+        zero double-delivered, zero divergent tokens."""
+        cfg, model, params = tiny_model
+        max_new = 24
+        handle = run_disagg_llm(params, cfg,
+                                _engine_cfg(max_new, decode_chunk=1),
+                                prefill_replicas=1, decode_replicas=2)
+        prompt = [4, 8, 15, 16, 23]
+        want = naive_greedy(model, params, prompt, max_new)
+        dec_state = core.get_app_handle("llm_decode")._state()
+
+        killed = []
+
+        def kill_once(delivered):
+            if killed or len(delivered) < 2:
+                return
+            # the directory knows which replica holds the session
+            status, replica, _ = core.kv_directory.lookup(
+                "res-1", dec_state)
+            victim = replica
+            if victim is None:
+                with dec_state._lock:
+                    victim = dec_state._replicas[0]
+            ray_tpu.kill(victim.actor)
+            killed.append(victim)
+
+        got = _drive(handle, prompt, max_new, "res-1",
+                     on_frame=kill_once)
+        assert killed, "kill never armed — stream finished too fast"
+        assert got == want, (got, want)
+        snap = core.metrics.snapshot()
+        assert snap["resumed"] >= 1, snap
+        # the killed replica's directory entry was invalidated: the
+        # session is still KNOWN (so its next turn counts as a miss,
+        # not a first turn), and a follow-up re-prefills correctly
+        assert core.kv_directory.known("res-1")
+        assert _drive(handle, prompt, max_new, "res-1") == want
+
+
+# ---------------------------------------------------------------------------
+# SLO-aware admission: shed at ingress, self-heal when load drains
+# ---------------------------------------------------------------------------
+
+class TestSLOAdmission:
+    def test_shed_over_target_then_recover(self, tiny_model):
+        """With recent p95 TTFT over serve_slo_ttft_p95_s AND streams
+        in flight, a NEW stream sheds at ingress before touching a
+        replica; once in-flight load drains the gate self-heals (an
+        idle pool cannot be queue-bound)."""
+        cfg, _model, params = tiny_model
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=8, scheduler="tensor",
+                     _system_config={"serve_slo_ttft_p95_s": 0.05})
+        try:
+            max_new = 4
+            handle = run_disagg_llm(params, cfg, _engine_cfg(max_new),
+                                    prefill_replicas=1,
+                                    decode_replicas=1)
+            dec_state = core.get_app_handle("llm_decode")._state()
+            assert float(GLOBAL_CONFIG.serve_slo_ttft_p95_s) == 0.05
+            # warm (an IDLE pool never sheds, whatever the window says)
+            assert len(handle.generate([1, 2, 3], max_new)) == max_new
+            for _ in range(8):
+                core.metrics.record_ttft(1.0)  # way over target
+            # hold a sticky session open: the pool is "busy" (the call
+            # itself completes — the open SESSION is the load)
+            ref, token = dec_state.submit_sticky(
+                "engine_stats", (), {})
+            ray_tpu.get(ref, timeout=30)
+            with pytest.raises(serve.AdmissionShedError):
+                next(handle.stream_frames([1, 2, 3], max_new))
+            shed = core.metrics.snapshot()["admission_shed"]
+            assert shed >= 1
+            # load drains -> the same request admits
+            dec_state.end_sticky(token)
+
+            def busy():
+                with dec_state._lock:
+                    return (sum(r.ongoing
+                                for r in dec_state._replicas)
+                            + len(dec_state._sticky))
+
+            deadline = time.monotonic() + 10
+            while busy() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert busy() == 0
+            assert len(handle.generate([1, 2, 3], max_new)) == max_new
+            assert core.metrics.snapshot()["admission_shed"] == shed
+        finally:
+            serve.shutdown()
+            ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# KV-page directory vs node death: promotion / gone / re-prefill
+# ---------------------------------------------------------------------------
+
+_KV_PRODUCE_SRC = """
+def produce_kv():
+    # > the inline threshold: the sole copy stays in the producing
+    # node's shm arena; the head holds a placeholder only
+    return bytes(range(256)) * 1024
+"""
+
+
+def _load_src(src, name):
+    ns: dict = {}
+    exec(src, ns)
+    return ns[name]
+
+
+class TestKVDirectoryNodeDeath:
+    def test_promotion_then_gone_when_sole_copy_node_dies(self):
+        """Directory semantics under replica and node loss, against
+        the REAL object directory: a dead replica whose handoff bytes
+        survive elsewhere resolves "promoted" (re-import, no prefill);
+        when the sole-copy node dies too, the entry resolves "gone",
+        drops, and the session stays KNOWN — its next turn counts as
+        an affinity miss (re-prefill), never as a first turn."""
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=2,
+                     _system_config={"worker_mode": "process",
+                                     "node_heartbeat_timeout_s": 20.0,
+                                     "health_check_timeout_s": 5.0})
+        from ray_tpu._private import worker as worker_mod
+        w = worker_mod.get_worker()
+        ea = w.add_remote_cluster_node(num_cpus=2.0, num_workers=1,
+                                       resources={"a": 2})
+        try:
+            @serve.deployment(num_replicas=2)
+            class Stub:  # decode-pool stand-in: directory semantics
+                def __call__(self, x):  # don't need a real engine
+                    return x
+
+            h = serve.run(Stub.bind())
+            st = h._state()
+            with st._lock:
+                both = list(st._replicas)
+            # retire one replica so the recorded holder is GONE
+            st._scale_to(1)
+            with st._lock:
+                live = st._replicas[0]
+            retired = next(r for r in both if r is not live)
+
+            producer = ray_tpu.remote(
+                _load_src(_KV_PRODUCE_SRC, "produce_kv"))
+
+            @ray_tpu.remote(resources={"a": 1.0})
+            def make():
+                import ray_tpu
+                ref = producer.remote()
+                ray_tpu.get(ref, timeout=60.0)  # completes ON the node
+                return ref
+
+            ref = ray_tpu.get(make.remote(), timeout=120.0)
+            assert w.gcs.object_locations(ref.object_id())
+
+            core.kv_directory.record("s1", "Stub", retired, ref)
+            # holder dead, bytes alive on node a -> promoted (entry
+            # retained: any replica can re-import without a prefill)
+            status, rep, got_ref = core.kv_directory.lookup("s1", st)
+            assert status == "promoted" and rep is None
+            assert got_ref is ref
+            assert len(core.kv_directory) == 1
+
+            # the sole-copy node dies -> gone; entry drops, seen stays
+            ea.pool.simulate_machine_death()
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if not w.gcs.object_locations(ref.object_id()):
+                    break
+                time.sleep(0.1)
+            status, rep, got_ref = core.kv_directory.lookup("s1", st)
+            assert status == "gone" and rep is None and got_ref is None
+            assert len(core.kv_directory) == 0
+            assert core.kv_directory.known("s1")
+            # a live holder still resolves "hit"
+            core.kv_directory.record("s2", "Stub", live, None)
+            assert core.kv_directory.lookup("s2", st)[0] == "hit"
+        finally:
+            chaos.disarm()
+            serve.shutdown()
+            ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# multiplexed loader cache: every-slot-mid-load under eviction pressure
+# ---------------------------------------------------------------------------
+
+class TestMultiplexedEverySlotMidLoad:
+    def test_cap_holds_when_every_slot_is_loading(self):
+        """The loader LRU's hardest corner: cap=2 and BOTH slots hold
+        in-flight placeholder events when more loads arrive. The cap
+        is a MEMORY bound — the late loaders must wait for a slot
+        instead of inserting a third placeholder — loaded models are
+        never double-loaded, and the cache never exceeds cap."""
+        gate = threading.Event()
+        started = []
+        loads = []
+        lock = threading.Lock()
+
+        class Holder:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                with lock:
+                    started.append(model_id)
+                gate.wait(timeout=30)
+                with lock:
+                    loads.append(model_id)
+                return f"model:{model_id}"
+
+        h = Holder()
+        results = {}
+
+        def load(mid):
+            results[mid] = h.get_model(mid)
+
+        # two loads occupy BOTH slots mid-load
+        t1 = threading.Thread(target=load, args=("a",), daemon=True)
+        t2 = threading.Thread(target=load, args=("b",), daemon=True)
+        t1.start(), t2.start()
+        deadline = time.monotonic() + 10
+        while len(started) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert sorted(started) == ["a", "b"]
+        # four MORE arrivals while every slot is mid-load: two new
+        # models (must wait, no placeholder) and two duplicates of the
+        # in-flight ones (must coalesce, not double-load)
+        late = [threading.Thread(target=load, args=(m,), daemon=True)
+                for m in ("c", "d", "a", "b")]
+        for t in late:
+            t.start()
+        time.sleep(0.2)
+        cache = h.__dict__["_ray_tpu_mux_get_model"]
+        assert len(cache) <= 2, dict(cache)
+        # nothing new started while the cap was saturated
+        assert sorted(started) == ["a", "b"]
+        gate.set()
+        for t in [t1, t2] + late:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        assert results == {m: f"model:{m}" for m in "abcd"}
+        # the new models loaded exactly once each; "a"/"b" may load a
+        # SECOND time if c/d evicted them before their duplicate
+        # waiter re-entered (correct LRU behavior), but never more —
+        # concurrent duplicate loads always coalesce on the event
+        assert loads.count("c") == 1 and loads.count("d") == 1, loads
+        assert loads.count("a") <= 2 and loads.count("b") <= 2, loads
+        assert len(cache) <= 2
+
+
+# ---------------------------------------------------------------------------
+# schema-stable metric families when serving is unused
+# ---------------------------------------------------------------------------
+
+def test_serve_metric_families_render_zeros_without_serve():
+    """A scrape on a cluster that NEVER imported ray_tpu.serve still
+    renders every serving family (histogram buckets included) as
+    zeros — dashboards and alert rules see a stable schema. Run in a
+    fresh interpreter so the no-import precondition actually holds."""
+    code = """
+import sys
+import ray_tpu
+ray_tpu.init(num_workers=1)
+from ray_tpu._private import metrics, worker
+text = metrics.render_all(worker.get_worker())
+assert "ray_tpu.serve.core" not in sys.modules
+for needle in (
+        'ray_tpu_serve_ttft_seconds_bucket{le="+Inf"} 0',
+        "ray_tpu_serve_ttft_seconds_sum 0",
+        "ray_tpu_serve_ttft_seconds_count 0",
+        "ray_tpu_serve_affinity_hit_total 0",
+        "ray_tpu_serve_affinity_miss_total 0",
+        "ray_tpu_serve_admission_shed_total 0",
+        "ray_tpu_kv_pages_transferred_bytes_total 0"):
+    assert needle in text, needle
+ray_tpu.shutdown()
+print("OK")
+"""
+    from ray_tpu._private import spawn_env
+    out = subprocess.run([sys.executable, "-c", code],
+                         env=spawn_env.child_env(),
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0 and "OK" in out.stdout, out.stderr[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# chaos serving soak
+# ---------------------------------------------------------------------------
+
+class TestChaosServingSoakSeeded:
+    def test_seeded_soak_bit_correct_with_zero_double_decodes(
+            self, rt, tiny_model):
+        """Tier-1 soak (seeded, < 60 s): concurrent sessions stream
+        over the split pools while a seeded task-site plan injects
+        exceptions and hangs into the cluster's plain-task lane (the
+        serve path itself is actor calls, which carry no thread-mode
+        chaos site) AND a decode replica is SIGKILLed mid-stream.
+        Every session's final sequence must equal the naive reference
+        exactly — a double-decoded, dropped, or divergent token
+        anywhere breaks it."""
+        cfg, model, params = tiny_model
+        max_new = 16
+        handle = run_disagg_llm(params, cfg,
+                                _engine_cfg(max_new, decode_chunk=1),
+                                prefill_replicas=1, decode_replicas=2)
+        prompts = {f"soak-{i}": [1 + i, 9, 33, 7 + i] for i in range(3)}
+        want = {s: naive_greedy(model, params, p, max_new)
+                for s, p in prompts.items()}
+        # warm pass (compiles) before the faults arm
+        for s, p in prompts.items():
+            assert _drive(handle, p, max_new, s) == want[s]
+
+        chaos.arm(chaos.FaultPlan(4242, faults=[
+            ("task", 5, "exception"),
+            ("task", 11, "hang", {"hang_s": 0.1}),
+            ("task", 19, "exception"),
+        ]))
+
+        # noise lane: plain tasks sharing the cluster with the serve
+        # traffic — these traverse the thread-mode ``task`` site, so
+        # the armed plan fires while the sessions stream
+        @ray_tpu.remote
+        def _noise(x):
+            return x * 3
+
+        noise_ok = []
+
+        def noise_lane():
+            for i in range(30):
+                try:
+                    if ray_tpu.get(_noise.remote(i), timeout=30) == i * 3:
+                        noise_ok.append(i)
+                except Exception:  # noqa: BLE001 — injected crash
+                    pass
+
+        dec_state = core.get_app_handle("llm_decode")._state()
+        killed = []
+        kill_lock = threading.Lock()
+
+        def kill_once(delivered):
+            with kill_lock:
+                if killed or len(delivered) < 2:
+                    return
+                # kill the replica actually holding the soak-0 stream
+                _status, victim, _ = core.kv_directory.lookup(
+                    "soak-0", dec_state)
+                if victim is None:
+                    with dec_state._lock:
+                        victim = dec_state._replicas[0]
+                ray_tpu.kill(victim.actor)
+                killed.append(victim)
+
+        got = {}
+        errs = []
+
+        def session(s, with_kill):
+            try:
+                got[s] = _drive(handle, prompts[s], max_new, s,
+                                on_frame=kill_once if with_kill
+                                else None)
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                errs.append((s, e))
+
+        threads = [threading.Thread(target=session,
+                                    args=(s, i == 0), daemon=True)
+                   for i, s in enumerate(prompts)]
+        threads.append(threading.Thread(target=noise_lane, daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "soak session hung"
+        chaos.disarm()
+        assert not errs, errs
+        assert killed, "the mid-stream kill never armed"
+        for s in prompts:
+            assert got[s] == want[s], (s, got[s], want[s])
+        snap = core.metrics.snapshot()
+        assert snap["resumed"] >= 1, snap
+        ctr = chaos.counters()
+        assert ctr["injected_total"] >= 1, ctr
+        # the noise lane made real progress despite the injections
+        assert len(noise_ok) >= 20, (len(noise_ok), ctr)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosServingSoakFull:
+    def test_multi_site_soak_survives_node_loss(self, tiny_model):
+        """The full drill: process-mode cluster with remote nodes,
+        chaos armed across the head (flap), peer_link (sever), worker
+        (kill) and node (kill) sites while sessions stream over the
+        split pools, plus a deterministic mid-stream decode-replica
+        SIGKILL. Every delivered sequence must equal the naive
+        reference exactly; the armed infrastructure faults must have
+        fired and been recovered from."""
+        cfg, model, params = tiny_model
+        ray_tpu.shutdown()
+        ray_tpu.init(num_workers=4,
+                     _system_config={"worker_mode": "process",
+                                     "node_heartbeat_timeout_s": 20.0,
+                                     "health_check_timeout_s": 5.0})
+        from ray_tpu._private import worker as worker_mod
+        w = worker_mod.get_worker()
+        ea = w.add_remote_cluster_node(num_cpus=2.0, num_workers=1,
+                                       resources={"a": 2})
+        try:
+            max_new = 16
+            handle = run_disagg_llm(
+                params, cfg, _engine_cfg(max_new, decode_chunk=1),
+                prefill_replicas=1, decode_replicas=2)
+            prompts = {f"full-{i}": [2 + i, 40, 5, 11 + i]
+                       for i in range(3)}
+            want = {s: naive_greedy(model, params, p, max_new)
+                    for s, p in prompts.items()}
+            # warm before arming (process workers compile here)
+            for s, p in prompts.items():
+                assert _drive(handle, p, max_new, s) == want[s]
+
+            # peer-lane traffic so the peer_link site is consulted:
+            # an actor pinned to the remote node, called during the
+            # soak (decentralized dispatch routes it worker-to-peer)
+            @ray_tpu.remote(resources={"a": 1.0})
+            class Pinned:
+                def bump(self, x):
+                    return x + 1
+
+            pinned = Pinned.remote()
+            assert ray_tpu.get(pinned.bump.remote(1), timeout=60) == 2
+
+            chaos.arm(chaos.FaultPlan(7321, faults=[
+                ("head", 1, "flap"),
+                ("peer_link", 1, "sever"),
+                ("worker", 3, "kill"),
+                ("node", 4, "kill", {"node": ea.index}),
+            ]))
+            dec_state = core.get_app_handle("llm_decode")._state()
+            killed = []
+            kill_lock = threading.Lock()
+
+            def kill_once(delivered):
+                with kill_lock:
+                    if killed or len(delivered) < 2:
+                        return
+                    _status, victim, _ = core.kv_directory.lookup(
+                        "full-0", dec_state)
+                    if victim is None:
+                        with dec_state._lock:
+                            victim = dec_state._replicas[0]
+                    ray_tpu.kill(victim.actor)
+                    killed.append(victim)
+
+            got = {}
+            errs = []
+
+            def session(s, with_kill):
+                try:
+                    got[s] = _drive(handle, prompts[s], max_new, s,
+                                    on_frame=kill_once if with_kill
+                                    else None)
+                except Exception as e:  # noqa: BLE001
+                    errs.append((s, e))
+
+            def peer_lane():
+                # keeps the worker->peer lane hot so peer_link is
+                # consulted; the armed node kill takes this actor down
+                # BY DESIGN, so failures here are expected, not errors
+                for _ in range(20):
+                    try:
+                        ray_tpu.get(pinned.bump.remote(0), timeout=15)
+                    except Exception:  # noqa: BLE001
+                        return
+                    time.sleep(0.1)
+
+            threads = [threading.Thread(target=session,
+                                        args=(s, i == 0), daemon=True)
+                       for i, s in enumerate(prompts)]
+            threads.append(threading.Thread(target=peer_lane,
+                                            daemon=True))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+                assert not t.is_alive(), "soak session hung"
+            assert not errs, errs
+            assert killed
+            for s in prompts:
+                assert got[s] == want[s], (s, got[s], want[s])
+            snap = core.metrics.snapshot()
+            assert snap["resumed"] >= 1, snap
+            ctr = chaos.counters()
+            assert ctr["injected_total"] >= 1, ctr
+            # streams opened after the soak still serve correctly
+            for s, p in prompts.items():
+                assert _drive(handle, p, max_new, s) == want[s]
+        finally:
+            chaos.disarm()
+            serve.shutdown()
+            ray_tpu.shutdown()
